@@ -1,0 +1,23 @@
+"""Test config: run on a virtual 8-device CPU mesh (no TPU needed).
+
+Mirrors the reference's LocalQueryRunner/DistributedQueryRunner testing tiers
+(SURVEY.md §4): full engine in one process, multi-"chip" via XLA host devices.
+"""
+
+import os
+
+_platform = os.environ.get("TRINO_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may pin jax_platforms to a TPU backend
+# after env vars are read; force the test platform explicitly.
+jax.config.update("jax_platforms", _platform)
+
+import trino_tpu  # noqa: E402,F401  (enables x64)

@@ -1,0 +1,368 @@
+"""Golden tests for the kernel substrate vs NumPy reference computations."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column, Dictionary
+from trino_tpu.compiler import ExprCompiler, days_from_civil
+from trino_tpu.ir import call, const, input_ref, special
+from trino_tpu.ops.aggregation import AggSpec, global_aggregate, group_aggregate
+from trino_tpu.ops.join import (
+    build_side,
+    hash_keys,
+    probe_join,
+    verify_equal,
+    MISSING,
+)
+from trino_tpu.ops.sort import SortKey, sort_indices
+
+
+def _col(t, values):
+    return Column.from_values(t, values)
+
+
+class TestColumnar:
+    def test_roundtrip_ints(self):
+        b = Batch([_col(T.BIGINT, [1, None, 3])], 3)
+        assert b.to_pylist() == [(1,), (None,), (3,)]
+
+    def test_roundtrip_strings(self):
+        b = Batch([_col(T.VARCHAR, ["a", "b", "a", None])], 4)
+        assert b.to_pylist() == [("a",), ("b",), ("a",), (None,)]
+
+    def test_roundtrip_decimal(self):
+        from decimal import Decimal
+
+        b = Batch([_col(T.decimal(10, 2), ["1.25", None, "3.5"])], 3)
+        assert b.to_pylist() == [(Decimal("1.25"),), (None,), (Decimal("3.50"),)]
+
+    def test_roundtrip_date(self):
+        b = Batch([_col(T.DATE, ["1995-03-15", None])], 2)
+        assert b.to_pylist() == [("1995-03-15",), (None,)]
+
+    def test_compact_with_sel(self):
+        col = _col(T.BIGINT, [1, 2, 3, 4])
+        b = Batch([col], 4, sel=np.array([True, False, True, False]))
+        assert b.compact().to_pylist() == [(1,), (3,)]
+
+
+class TestExprCompiler:
+    def test_arith_add(self):
+        cols = [_col(T.BIGINT, [1, 2, None]), _col(T.BIGINT, [10, None, 30])]
+        e = call(
+            "add", T.BIGINT, input_ref(0, T.BIGINT), input_ref(1, T.BIGINT)
+        )
+        data, valid = ExprCompiler(cols).evaluate(e)
+        np.testing.assert_array_equal(np.asarray(data)[:1], [11])
+        np.testing.assert_array_equal(np.asarray(valid), [True, False, False])
+
+    def test_decimal_multiply(self):
+        dec = T.decimal(10, 2)
+        cols = [_col(dec, ["2.50"]), _col(dec, ["0.10"])]
+        rt = T.decimal(18, 4)
+        e = call("multiply", rt, input_ref(0, dec), input_ref(1, dec))
+        data, valid = ExprCompiler(cols).evaluate(e)
+        assert int(data[0]) == 2500  # 0.2500 at scale 4
+
+    def test_decimal_add_mixed_scale(self):
+        a = T.decimal(10, 2)
+        b = T.decimal(10, 0)
+        rt = T.decimal(18, 2)
+        cols = [_col(a, ["1.25"]), _col(b, ["3"])]
+        e = call("add", rt, input_ref(0, a), input_ref(1, b))
+        data, _ = ExprCompiler(cols).evaluate(e)
+        assert int(data[0]) == 425
+
+    def test_comparison_null_semantics(self):
+        cols = [_col(T.BIGINT, [1, None, 3])]
+        e = call("lt", T.BOOLEAN, input_ref(0, T.BIGINT), const(2, T.BIGINT))
+        c = ExprCompiler(cols)
+        mask = c.predicate_mask(e)
+        np.testing.assert_array_equal(np.asarray(mask), [True, False, False])
+
+    def test_kleene_and_or(self):
+        cols = [_col(T.BOOLEAN, [True, False, None])]
+        x = input_ref(0, T.BOOLEAN)
+        e_and = special("and", T.BOOLEAN, x, const(True, T.BOOLEAN))
+        d, v = ExprCompiler(cols).evaluate(e_and)
+        np.testing.assert_array_equal(np.asarray(v), [True, True, False])
+        e_or = special("or", T.BOOLEAN, x, const(False, T.BOOLEAN))
+        d, v = ExprCompiler(cols).evaluate(e_or)
+        np.testing.assert_array_equal(np.asarray(v), [True, True, False])
+        # NULL AND FALSE is FALSE
+        e2 = special("and", T.BOOLEAN, x, const(False, T.BOOLEAN))
+        d, v = ExprCompiler(cols).evaluate(e2)
+        assert bool(v[2]) and not bool(d[2] & v[2])
+
+    def test_string_eq_and_like(self):
+        cols = [_col(T.VARCHAR, ["BUILDING", "MACHINERY", "BUILDING"])]
+        e = call(
+            "eq", T.BOOLEAN, input_ref(0, T.VARCHAR), const("BUILDING", T.VARCHAR)
+        )
+        mask = ExprCompiler(cols).predicate_mask(e)
+        np.testing.assert_array_equal(np.asarray(mask), [True, False, True])
+        e2 = call(
+            "like", T.BOOLEAN, input_ref(0, T.VARCHAR), const("%CHIN%", T.VARCHAR)
+        )
+        mask2 = ExprCompiler(cols).predicate_mask(e2)
+        np.testing.assert_array_equal(np.asarray(mask2), [False, True, False])
+
+    def test_string_order_compare(self):
+        cols = [_col(T.VARCHAR, ["apple", "pear", "fig"])]
+        e = call(
+            "lt", T.BOOLEAN, input_ref(0, T.VARCHAR), const("grape", T.VARCHAR)
+        )
+        mask = ExprCompiler(cols).predicate_mask(e)
+        np.testing.assert_array_equal(np.asarray(mask), [True, False, True])
+
+    def test_date_compare_and_extract(self):
+        cols = [_col(T.DATE, ["1995-03-15", "1998-12-01", "1992-01-02"])]
+        cutoff = days_from_civil(1995, 3, 15)
+        e = call("le", T.BOOLEAN, input_ref(0, T.DATE), const(cutoff, T.DATE))
+        mask = ExprCompiler(cols).predicate_mask(e)
+        np.testing.assert_array_equal(np.asarray(mask), [True, False, True])
+        ey = call("year", T.BIGINT, input_ref(0, T.DATE))
+        data, _ = ExprCompiler(cols).evaluate(ey)
+        np.testing.assert_array_equal(np.asarray(data), [1995, 1998, 1992])
+        em = call("month", T.BIGINT, input_ref(0, T.DATE))
+        data, _ = ExprCompiler(cols).evaluate(em)
+        np.testing.assert_array_equal(np.asarray(data), [3, 12, 1])
+
+    def test_cast_decimal_to_double(self):
+        dec = T.decimal(10, 2)
+        cols = [_col(dec, ["1.25"])]
+        e = call("cast", T.DOUBLE, input_ref(0, dec))
+        data, _ = ExprCompiler(cols).evaluate(e)
+        assert float(data[0]) == 1.25
+
+    def test_between(self):
+        cols = [_col(T.BIGINT, [1, 5, 10])]
+        e = special(
+            "between",
+            T.BOOLEAN,
+            input_ref(0, T.BIGINT),
+            const(2, T.BIGINT),
+            const(9, T.BIGINT),
+        )
+        mask = ExprCompiler(cols).predicate_mask(e)
+        np.testing.assert_array_equal(np.asarray(mask), [False, True, False])
+
+    def test_division_by_zero_yields_null(self):
+        cols = [_col(T.BIGINT, [10]), _col(T.BIGINT, [0])]
+        e = call("divide", T.BIGINT, input_ref(0, T.BIGINT), input_ref(1, T.BIGINT))
+        _, valid = ExprCompiler(cols).evaluate(e)
+        assert not bool(valid[0])
+
+
+class TestGroupAggregate:
+    def test_sum_count_by_key(self):
+        rng = np.random.default_rng(0)
+        n = 1000
+        keys = rng.integers(0, 7, n)
+        vals = rng.integers(0, 100, n)
+        sel = rng.random(n) < 0.8
+        (kd, kv), results, num_groups, overflow = group_aggregate(
+            keys=[(jnp.asarray(keys), jnp.ones(n, bool))],
+            sel=jnp.asarray(sel),
+            agg_inputs=[(jnp.asarray(vals), jnp.ones(n, bool)), None],
+            agg_specs=[AggSpec("sum"), AggSpec("count_star")],
+            max_groups=16,
+        )
+        assert not bool(overflow)
+        got = {}
+        ng = int(num_groups)
+        ssum, scnt = results[0]
+        for g in range(ng):
+            got[int(kd[0][g])] = (int(ssum[g]), int(results[1][g]))
+        expect = {}
+        for k in np.unique(keys[sel]):
+            m = sel & (keys == k)
+            expect[int(k)] = (int(vals[m].sum()), int(m.sum()))
+        assert got == expect
+
+    def test_null_keys_form_one_group(self):
+        keys = jnp.asarray([1, 1, 2, 0, 0])
+        kvalid = jnp.asarray([True, True, True, False, False])
+        vals = jnp.asarray([10, 20, 30, 40, 50])
+        (kd, kv), results, num_groups, _ = group_aggregate(
+            keys=[(keys, kvalid)],
+            sel=jnp.ones(5, bool),
+            agg_inputs=[(vals, jnp.ones(5, bool))],
+            agg_specs=[AggSpec("sum")],
+            max_groups=8,
+        )
+        assert int(num_groups) == 3
+        by_key = {}
+        ssum, cnt = results[0]
+        for g in range(3):
+            key = int(kd[0][g]) if bool(kv[0][g]) else None
+            by_key[key] = int(ssum[g])
+        assert by_key == {1: 30, 2: 30, None: 90}
+
+    def test_min_max_avg(self):
+        keys = jnp.asarray([0, 0, 1, 1])
+        vals = jnp.asarray([3.0, 1.0, 8.0, 2.0])
+        valid = jnp.asarray([True, True, True, True])
+        (kd, kv), results, ng, _ = group_aggregate(
+            keys=[(keys, valid)],
+            sel=jnp.ones(4, bool),
+            agg_inputs=[(vals, valid), (vals, valid), (vals, valid)],
+            agg_specs=[AggSpec("min"), AggSpec("max"), AggSpec("avg")],
+            max_groups=4,
+        )
+        mins = {int(kd[0][g]): float(results[0][0][g]) for g in range(2)}
+        maxs = {int(kd[0][g]): float(results[1][0][g]) for g in range(2)}
+        avgs = {
+            int(kd[0][g]): float(results[2][0][g]) / float(results[2][1][g])
+            for g in range(2)
+        }
+        assert mins == {0: 1.0, 1: 2.0}
+        assert maxs == {0: 3.0, 1: 8.0}
+        assert avgs == {0: 2.0, 1: 5.0}
+
+    def test_global_aggregate(self):
+        vals = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        valid = jnp.asarray([True, True, False, True])
+        sel = jnp.asarray([True, True, True, False])
+        res = global_aggregate(
+            sel, [(vals, valid), None], [AggSpec("sum"), AggSpec("count_star")]
+        )
+        s, cnt = res[0]
+        assert float(s) == 3.0 and int(cnt) == 2
+        assert int(res[1]) == 3
+
+
+class TestJoin:
+    def test_inner_join_with_duplicates(self):
+        build_keys = np.array([1, 2, 2, 3, 5], dtype=np.int64)
+        probe_keys = np.array([2, 3, 4, 2, 1], dtype=np.int64)
+        bk = [(jnp.asarray(build_keys), jnp.ones(5, bool))]
+        pk = [(jnp.asarray(probe_keys), jnp.ones(5, bool))]
+        bh, bv = hash_keys(bk)
+        ph, pv = hash_keys(pk)
+        sbk, sbi, cnt = build_side(bh, bv, jnp.ones(5, bool))
+        ppos, bpos, osel, total, ovf = probe_join(
+            sbk, sbi, cnt, ph, pv, jnp.ones(5, bool), out_capacity=16
+        )
+        osel = verify_equal(pk, bk, ppos, bpos, osel)
+        assert not bool(ovf)
+        pairs = sorted(
+            (int(probe_keys[ppos[i]]), int(build_keys[bpos[i]]))
+            for i in range(16)
+            if bool(osel[i])
+        )
+        assert pairs == [(1, 1), (2, 2), (2, 2), (2, 2), (2, 2), (3, 3)]
+
+    def test_left_join_emits_unmatched(self):
+        build_keys = np.array([1], dtype=np.int64)
+        probe_keys = np.array([1, 7], dtype=np.int64)
+        bk = [(jnp.asarray(build_keys), jnp.ones(1, bool))]
+        pk = [(jnp.asarray(probe_keys), jnp.ones(2, bool))]
+        bh, bv = hash_keys(bk)
+        ph, pv = hash_keys(pk)
+        sbk, sbi, cnt = build_side(bh, bv, jnp.ones(1, bool))
+        ppos, bpos, osel, total, ovf = probe_join(
+            sbk, sbi, cnt, ph, pv, jnp.ones(2, bool), out_capacity=8, join_type="left"
+        )
+        osel = verify_equal(pk, bk, ppos, bpos, osel)
+        rows = [
+            (int(ppos[i]), int(bpos[i])) for i in range(8) if bool(osel[i])
+        ]
+        assert (0, 0) in rows
+        assert (1, MISSING) in rows
+
+    def test_null_keys_never_match(self):
+        bk = [(jnp.asarray([1, 2]), jnp.asarray([True, False]))]
+        pk = [(jnp.asarray([2, 1]), jnp.asarray([False, True]))]
+        bh, bv = hash_keys(bk)
+        ph, pv = hash_keys(pk)
+        sbk, sbi, cnt = build_side(bh, bv, jnp.ones(2, bool))
+        ppos, bpos, osel, total, ovf = probe_join(
+            sbk, sbi, cnt, ph, pv, jnp.ones(2, bool), out_capacity=8
+        )
+        osel = verify_equal(pk, bk, ppos, bpos, osel)
+        matches = [(int(ppos[i]), int(bpos[i])) for i in range(8) if bool(osel[i])]
+        assert matches == [(1, 0)]
+
+    def test_overflow_reported(self):
+        bkeys = np.ones(8, dtype=np.int64)
+        pkeys = np.ones(8, dtype=np.int64)
+        bk = [(jnp.asarray(bkeys), jnp.ones(8, bool))]
+        pk = [(jnp.asarray(pkeys), jnp.ones(8, bool))]
+        bh, bv = hash_keys(bk)
+        ph, pv = hash_keys(pk)
+        sbk, sbi, cnt = build_side(bh, bv, jnp.ones(8, bool))
+        _, _, _, total, ovf = probe_join(
+            sbk, sbi, cnt, ph, pv, jnp.ones(8, bool), out_capacity=16
+        )
+        assert bool(ovf) and int(total) == 64
+
+
+class TestSort:
+    def test_multikey_asc_desc(self):
+        a = np.array([2, 1, 2, 1], dtype=np.int64)
+        b = np.array([10.0, 20.0, 30.0, 40.0])
+        perm = sort_indices(
+            [(jnp.asarray(a), jnp.ones(4, bool)), (jnp.asarray(b), jnp.ones(4, bool))],
+            [SortKey(ascending=True), SortKey(ascending=False)],
+            jnp.ones(4, bool),
+        )
+        order = [int(i) for i in perm]
+        assert [int(a[i]) for i in order] == [1, 1, 2, 2]
+        assert [float(b[i]) for i in order] == [40.0, 20.0, 30.0, 10.0]
+
+    def test_nulls_last_default(self):
+        a = np.array([3, 1, 2], dtype=np.int64)
+        valid = np.array([True, False, True])
+        perm = sort_indices(
+            [(jnp.asarray(a), jnp.asarray(valid))],
+            [SortKey(ascending=True)],
+            jnp.ones(3, bool),
+        )
+        assert [int(i) for i in perm] == [2, 0, 1]
+
+    def test_negative_floats_desc(self):
+        b = np.array([-1.5, 2.0, -3.0, 0.0])
+        perm = sort_indices(
+            [(jnp.asarray(b), jnp.ones(4, bool))],
+            [SortKey(ascending=False)],
+            jnp.ones(4, bool),
+        )
+        assert [float(b[int(i)]) for i in perm] == [2.0, 0.0, -1.5, -3.0]
+
+
+class TestReviewRegressions:
+    def test_float_modulus(self):
+        cols = [_col(T.DOUBLE, [7.5]), _col(T.DOUBLE, [2.0])]
+        e = call("modulus", T.DOUBLE, input_ref(0, T.DOUBLE), input_ref(1, T.DOUBLE))
+        d, v = ExprCompiler(cols).evaluate(e)
+        assert float(d[0]) == 1.5
+
+    def test_date_vs_timestamp_compare(self):
+        dcol = _col(T.DATE, ["1995-03-15"])
+        ts = _col(T.TIMESTAMP, [days_from_civil(1995, 3, 15) * 86_400_000_000 + 1])
+        e = call("le", T.BOOLEAN, input_ref(0, T.DATE), input_ref(1, T.TIMESTAMP))
+        mask = ExprCompiler([dcol, ts]).predicate_mask(e)
+        assert bool(mask[0])
+        e2 = call("gt", T.BOOLEAN, input_ref(0, T.DATE), input_ref(1, T.TIMESTAMP))
+        assert not bool(ExprCompiler([dcol, ts]).predicate_mask(e2)[0])
+
+    def test_round_half_up_double(self):
+        cols = [_col(T.DOUBLE, [2.5, 3.5, -2.5])]
+        e = call("round", T.DOUBLE, input_ref(0, T.DOUBLE))
+        d, _ = ExprCompiler(cols).evaluate(e)
+        assert [float(x) for x in d] == [3.0, 4.0, -3.0]
+        e2 = call("cast", T.BIGINT, input_ref(0, T.DOUBLE))
+        d2, _ = ExprCompiler(cols).evaluate(e2)
+        assert [int(x) for x in d2] == [3, 4, -3]
+
+    def test_exact_decimal_ingest_large(self):
+        from decimal import Decimal
+
+        v = "12345678901234567.89"
+        c = _col(T.decimal(18, 2), [v])
+        assert int(c.data[0]) == 1234567890123456789
+        b = Batch([c], 1)
+        assert b.to_pylist() == [(Decimal(v),)]

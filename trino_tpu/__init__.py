@@ -1,0 +1,22 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A from-scratch reimplementation of the capabilities of Trino (reference:
+jirassimok/trino, Trino 356-SNAPSHOT) designed TPU-first:
+
+- Columnar batches are structs of fixed-width device arrays with validity
+  masks (reference: ``core/trino-spi/src/main/java/io/trino/spi/Page.java``).
+- The "codegen tier" (reference: ``core/trino-main/.../sql/gen/``) is XLA:
+  expression IR is traced into jnp ops and jit-compiled.
+- Group-by/joins use sort + segment-reduce formulations that map to the MXU
+  and avoid scatter-heavy hash tables (reference hash specs:
+  ``operator/MultiChannelGroupByHash.java``, ``operator/PagesHash.java``).
+- Distribution is SPMD over a ``jax.sharding.Mesh``; Trino's HTTP shuffle
+  (reference: ``execution/buffer/``, ``operator/ExchangeClient.java``)
+  becomes ``lax.all_to_all``/``psum`` collectives over ICI.
+"""
+
+from trino_tpu.config import enable_x64
+
+enable_x64()
+
+__version__ = "0.1.0"

@@ -1,0 +1,330 @@
+"""Columnar batch model: the TPU-native analog of Trino's Page/Block.
+
+Reference: ``core/trino-spi/src/main/java/io/trino/spi/Page.java:53-85`` and
+the 14 Block implementations under ``spi/block/``.
+
+Design (TPU-first):
+- A :class:`Column` is a fixed-width device array plus an optional validity
+  mask. Strings carry a host-side :class:`Dictionary` (int32 codes on device).
+- A :class:`Batch` is a list of equal-capacity columns plus a *selection*
+  mask. Filters AND into the selection instead of compacting (static shapes
+  for XLA); compaction happens at exchange/output boundaries where we are on
+  the host anyway.
+- Batches are registered as JAX pytrees so whole batches flow through
+  ``jax.jit`` boundaries; dictionaries/types are static aux data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+
+
+class Dictionary:
+    """Host-side string dictionary. Code i <-> string values[i].
+
+    Codes are dense int32. ``sorted_ranks`` supports order comparisons on
+    codes (rank[code] preserves lexicographic order) without device strings.
+    """
+
+    __slots__ = ("values", "_index", "_ranks")
+
+    def __init__(self, values: Sequence[str]):
+        self.values: list[str] = list(values)
+        self._index: dict[str, int] | None = None
+        self._ranks: np.ndarray | None = None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def decode(self, code: int) -> str | None:
+        if code < 0:
+            return None
+        return self.values[code]
+
+    def index(self) -> dict[str, int]:
+        if self._index is None:
+            self._index = {v: i for i, v in enumerate(self.values)}
+        return self._index
+
+    def encode(self, value: str) -> int:
+        """Code for value, or -1 if absent (useful for predicates)."""
+        return self.index().get(value, -1)
+
+    def ranks(self) -> np.ndarray:
+        """rank[code] gives the lexicographic rank of each dictionary entry."""
+        if self._ranks is None:
+            order = np.argsort(np.asarray(self.values, dtype=object), kind="stable")
+            ranks = np.empty(len(self.values), dtype=np.int32)
+            ranks[order] = np.arange(len(self.values), dtype=np.int32)
+            self._ranks = ranks
+        return self._ranks
+
+    @staticmethod
+    def from_strings(strings: Iterable[str]) -> tuple["Dictionary", np.ndarray]:
+        """Build a dictionary and the code array for a string sequence."""
+        values: list[str] = []
+        index: dict[str, int] = {}
+        codes = []
+        for s in strings:
+            code = index.get(s)
+            if code is None:
+                code = len(values)
+                index[s] = code
+                values.append(s)
+            codes.append(code)
+        d = Dictionary(values)
+        d._index = index
+        return d, np.asarray(codes, dtype=np.int32)
+
+    def merged(self, other: "Dictionary") -> tuple["Dictionary", np.ndarray]:
+        """Merge other into a new dictionary; returns (merged, remap) where
+        remap[old_other_code] = new code."""
+        values = list(self.values)
+        index = dict(self.index())
+        remap = np.empty(len(other.values), dtype=np.int32)
+        for i, v in enumerate(other.values):
+            code = index.get(v)
+            if code is None:
+                code = len(values)
+                index[v] = code
+                values.append(v)
+            remap[i] = code
+        d = Dictionary(values)
+        d._index = index
+        return d, remap
+
+
+@dataclasses.dataclass
+class Column:
+    """One column: device data + optional validity + optional dictionary."""
+
+    type: T.SqlType
+    data: jax.Array | np.ndarray
+    valid: jax.Array | np.ndarray | None = None  # None = all valid
+    dictionary: Dictionary | None = None
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def valid_mask(self) -> jax.Array:
+        if self.valid is None:
+            return jnp.ones(self.data.shape[0], dtype=jnp.bool_)
+        return self.valid
+
+    def to_numpy(self) -> tuple[np.ndarray, np.ndarray]:
+        data = np.asarray(self.data)
+        valid = (
+            np.ones(data.shape[0], dtype=np.bool_)
+            if self.valid is None
+            else np.asarray(self.valid)
+        )
+        return data, valid
+
+    @staticmethod
+    def from_values(type_: T.SqlType, values: Sequence[Any]) -> "Column":
+        """Build a column from Python values (None = NULL). Test/glue path."""
+        n = len(values)
+        valid = np.asarray([v is not None for v in values], dtype=np.bool_)
+        if T.is_string(type_):
+            strings = [v if v is not None else "" for v in values]
+            dictionary, codes = Dictionary.from_strings(strings)
+            codes = np.where(valid, codes, -1).astype(np.int32)
+            return Column(type_, codes, None if valid.all() else valid, dictionary)
+        dtype = type_.storage_dtype
+        if isinstance(type_, T.DecimalType):
+            from decimal import Decimal
+
+            # exact: go through Decimal, not float (float loses >2^53)
+            filled = [
+                int(Decimal(str(v)).scaleb(type_.scale).to_integral_value())
+                if v is not None
+                else 0
+                for v in values
+            ]
+        elif isinstance(type_, T.DateType):
+            import datetime
+
+            epoch = datetime.date(1970, 1, 1)
+            filled = [
+                (datetime.date.fromisoformat(v) - epoch).days
+                if isinstance(v, str)
+                else (0 if v is None else int(v))
+                for v in values
+            ]
+        else:
+            filled = [0 if v is None else v for v in values]
+        data = np.asarray(filled, dtype=dtype)
+        return Column(type_, data, None if valid.all() else valid, None)
+
+
+@dataclasses.dataclass
+class Batch:
+    """A batch of rows: equal-capacity columns + selection mask + row count.
+
+    ``num_rows`` is the count of *physical* rows (leading); rows past it are
+    padding. ``sel`` (optional, shape (capacity,)) marks rows surviving
+    filters. Logical rows = first num_rows AND sel.
+    """
+
+    columns: list[Column]
+    num_rows: int
+    sel: jax.Array | np.ndarray | None = None
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def width(self) -> int:
+        return len(self.columns)
+
+    def selection_mask(self) -> jax.Array:
+        """Full boolean mask over capacity combining num_rows and sel."""
+        base = jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+        if self.sel is not None:
+            base = base & self.sel
+        return base
+
+    def count_rows(self) -> int:
+        """Logical row count (host sync if sel is set)."""
+        if self.sel is None:
+            return self.num_rows
+        return int(np.asarray(self.selection_mask()).sum())
+
+    def compact(self) -> "Batch":
+        """Materialize selection: gather surviving rows to the front (host)."""
+        if self.sel is None and all(c.capacity == self.num_rows for c in self.columns):
+            return self
+        mask = np.asarray(self.selection_mask())
+        idx = np.nonzero(mask)[0]
+        cols = []
+        for c in self.columns:
+            data, valid = c.to_numpy()
+            cols.append(
+                Column(c.type, data[idx], None if valid[idx].all() else valid[idx], c.dictionary)
+            )
+        return Batch(cols, len(idx), None)
+
+    def to_pylist(self) -> list[tuple]:
+        """Rows as Python tuples (client output/testing)."""
+        b = self.compact()
+        out_cols = []
+        for c in b.columns:
+            data, valid = c.to_numpy()
+            col = [
+                c.type.to_python(data[i], c.dictionary) if valid[i] else None
+                for i in range(b.num_rows)
+            ]
+            out_cols.append(col)
+        return [tuple(col[i] for col in out_cols) for i in range(b.num_rows)]
+
+    @staticmethod
+    def from_pylist(schema: Sequence[tuple[str, T.SqlType]], rows: Sequence[Sequence[Any]]):
+        """Build (names, Batch) from row-major Python data."""
+        cols = []
+        for j, (_, t) in enumerate(schema):
+            cols.append(Column.from_values(t, [r[j] for r in rows]))
+        return Batch(cols, len(rows), None)
+
+
+def concat_batches(batches: Sequence[Batch]) -> Batch:
+    """Host-side concatenation (compacting). Used at stage boundaries."""
+    if not batches:
+        raise ValueError("concat of zero batches")
+    batches = [b.compact() for b in batches]
+    nonempty = [b for b in batches if b.num_rows > 0]
+    batches = nonempty or batches[:1]
+    if len(batches) == 1:
+        return batches[0]
+    width = batches[0].width
+    cols = []
+    for j in range(width):
+        parts = [b.columns[j] for b in batches]
+        t = parts[0].type
+        dictionary = None
+        if T.is_string(t):
+            dictionary = parts[0].dictionary or Dictionary([])
+            datas = []
+            valids = []
+            for p in parts:
+                data, valid = p.to_numpy()
+                if p.dictionary is not None and p.dictionary is not dictionary:
+                    dictionary, remap = dictionary.merged(p.dictionary)
+                    data = np.where(data >= 0, remap[np.maximum(data, 0)], -1).astype(np.int32)
+                datas.append(data)
+                valids.append(valid)
+            data = np.concatenate(datas)
+            valid = np.concatenate(valids)
+        else:
+            pairs = [p.to_numpy() for p in parts]
+            data = np.concatenate([d for d, _ in pairs])
+            valid = np.concatenate([v for _, v in pairs])
+        cols.append(Column(t, data, None if valid.all() else valid, dictionary))
+    return Batch(cols, sum(b.num_rows for b in batches), None)
+
+
+def pad_batch(batch: Batch, capacity: int) -> Batch:
+    """Pad physical rows up to capacity (power-of-two bucketing lives above)."""
+    b = batch
+    if b.capacity == capacity:
+        return b
+    if b.capacity > capacity:
+        raise ValueError(f"batch capacity {b.capacity} > target {capacity}")
+    pad = capacity - b.capacity
+    cols = []
+    for c in b.columns:
+        data = np.asarray(c.data)
+        data = np.concatenate([data, np.zeros(pad, dtype=data.dtype)])
+        if c.valid is not None:
+            valid = np.concatenate([np.asarray(c.valid), np.zeros(pad, dtype=np.bool_)])
+        else:
+            valid = None
+        cols.append(Column(c.type, data, valid, c.dictionary))
+    sel = batch.sel
+    if sel is not None:
+        sel = np.concatenate([np.asarray(sel), np.zeros(pad, dtype=np.bool_)])
+    return Batch(cols, b.num_rows, sel)
+
+
+def bucket_capacity(n: int, minimum: int = 1024) -> int:
+    """Round up to a power of two (recompile-avoidance shape bucketing)."""
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+# --- pytree registration ---------------------------------------------------
+# Columns/Batches cross jit boundaries with (type, dictionary) static.
+
+
+def _column_flatten(c: Column):
+    return (c.data, c.valid), (c.type, c.dictionary)
+
+
+def _column_unflatten(aux, children):
+    t, dictionary = aux
+    data, valid = children
+    return Column(t, data, valid, dictionary)
+
+
+def _batch_flatten(b: Batch):
+    return (b.columns, b.sel), (b.num_rows,)
+
+
+def _batch_unflatten(aux, children):
+    (num_rows,) = aux
+    columns, sel = children
+    return Batch(list(columns), num_rows, sel)
+
+
+jax.tree_util.register_pytree_node(Column, _column_flatten, _column_unflatten)
+jax.tree_util.register_pytree_node(Batch, _batch_flatten, _batch_unflatten)

@@ -1,0 +1,65 @@
+"""Global configuration and session properties.
+
+Reference: Trino's session property system
+(``core/trino-main/src/main/java/io/trino/SystemSessionProperties.java:50``)
+and airlift ``@Config`` classes. Here: a plain dataclass of typed session
+properties, overridable per query.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar
+
+_X64_ENABLED = False
+
+
+def enable_x64() -> None:
+    """Enable 64-bit types in JAX.
+
+    SQL semantics need int64 (BIGINT, scaled DECIMAL) and float64 (DOUBLE).
+    TPUs emulate i64/f64; hot paths deliberately stay in i32/f32/bf16.
+    """
+    global _X64_ENABLED
+    if not _X64_ENABLED:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        _X64_ENABLED = True
+
+
+@dataclasses.dataclass
+class Session:
+    """Per-query session (reference: ``io.trino.Session``).
+
+    ``properties`` mirrors SET SESSION overrides
+    (``SystemSessionProperties.java``); only properties our engine consults
+    are defined, with typed defaults.
+    """
+
+    user: str = "user"
+    catalog: str | None = "tpch"
+    schema: str | None = "tiny"
+    properties: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    # --- defaults for recognised properties -------------------------------
+    DEFAULTS: ClassVar[tuple[tuple[str, Any], ...]] = (
+        ("join_distribution_type", "AUTOMATIC"),  # BROADCAST | PARTITIONED
+        ("join_reordering_strategy", "AUTOMATIC"),
+        ("task_concurrency", 1),
+        ("batch_capacity", 1 << 16),  # padded kernel batch rows
+        ("broadcast_join_threshold_rows", 1 << 22),
+        ("enable_dynamic_filtering", True),
+        ("tpu_enabled", True),
+    )
+
+    def get(self, name: str) -> Any:
+        if name in self.properties:
+            return self.properties[name]
+        for key, default in self.DEFAULTS:
+            if key == name:
+                return default
+        raise KeyError(f"unknown session property: {name}")
+
+    def set(self, name: str, value: Any) -> None:
+        self.properties[name] = value

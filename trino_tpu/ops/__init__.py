@@ -1,0 +1,7 @@
+"""Device kernels: filter/project, aggregation, sort, topN, join.
+
+These are the TPU-native equivalents of Trino's hot operators
+(``core/trino-main/src/main/java/io/trino/operator/``): pure functions over
+fixed-shape arrays, designed to be jit-compiled and XLA-fused, using
+sort/segment-reduce formulations instead of scatter-heavy hash tables.
+"""

@@ -1,0 +1,204 @@
+"""Group-by aggregation via sort + segment-reduce.
+
+Reference semantics: ``operator/HashAggregationOperator.java:49`` +
+``operator/MultiChannelGroupByHash.java:55`` (open-addressing hash group-by)
+and the aggregation function triple input/combine/output
+(``operator/aggregation/LongSumAggregation.java:29-55``).
+
+TPU-first design: instead of a linear-probing hash table (scatter-heavy,
+serial), we lexicographically sort rows by the group keys with ``lax.sort``
+(TPU has a fast bitonic sort), mark group boundaries, assign dense group ids
+with a cumulative sum, and reduce with ``jax.ops.segment_sum``-family ops —
+all MXU/VPU-friendly, fully static shapes.
+
+Partial/final split: the same kernel serves both; COUNT partials re-aggregate
+with SUM, AVG decomposes into SUM+COUNT (exactly Trino's
+input/combine/output contract for distributed aggregation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu import types as T
+
+# Supported aggregate kinds and their (partial, final-combine) decomposition.
+AGG_KINDS = ("sum", "count", "count_star", "min", "max", "avg")
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: kind + input channel (None for count(*))."""
+
+    kind: str
+    input_dtype: object | None = None  # storage dtype of the input
+
+
+def _sortable_keys(keys: Sequence[tuple[jnp.ndarray, jnp.ndarray]], sel: jnp.ndarray):
+    """Build lax.sort operand list: selection first (selected rows to the
+    front), then per-key (valid, data) pairs so NULL keys form one group."""
+    ops = [~sel]  # False (selected) sorts before True
+    for data, valid in keys:
+        ops.append(~valid)  # non-null first; all nulls group together
+        ops.append(jnp.where(valid, data, jnp.zeros_like(data)))
+    return ops
+
+
+def group_aggregate(
+    keys: Sequence[tuple[jnp.ndarray, jnp.ndarray]],
+    sel: jnp.ndarray,
+    agg_inputs: Sequence[tuple[jnp.ndarray, jnp.ndarray] | None],
+    agg_specs: Sequence[AggSpec],
+    max_groups: int,
+):
+    """Sort-based grouped aggregation.
+
+    Args:
+      keys: per key column (data, valid), each shape (n,).
+      sel: bool (n,) — rows participating.
+      agg_inputs: per agg (data, valid) or None for count(*).
+      agg_specs: kinds aligned with agg_inputs.
+      max_groups: static output capacity (groups beyond are dropped —
+        caller must size from stats; overflow is reported).
+
+    Returns:
+      (group_key_data, group_key_valid): lists of (max_groups,) arrays
+      agg_results: list of result arrays (max_groups,) —
+        for 'avg' returns (sum, count) pair folded by caller
+      num_groups: int32 scalar
+      overflow: bool scalar (true if groups were dropped)
+    """
+    n = sel.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ops = _sortable_keys(keys, sel)
+    num_keys = len(ops)
+    sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=num_keys)
+    perm = sorted_ops[-1]
+    s_sel = ~sorted_ops[0]
+
+    # boundary: first row, or any sort key changed vs previous row
+    changed = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+    for k in sorted_ops[:num_keys]:
+        prev = jnp.concatenate([k[:1], k[:-1]])
+        changed = changed | (k != prev)
+    changed = changed & s_sel
+    group_id = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    # unselected rows -> out-of-range id (dropped by segment ops/'drop' mode)
+    group_id = jnp.where(s_sel, group_id, max_groups)
+    num_groups = jnp.sum(changed.astype(jnp.int32))
+    overflow = num_groups > max_groups
+
+    # group key output: scatter first-row-of-group values
+    out_key_data, out_key_valid = [], []
+    for ki, (data, valid) in enumerate(keys):
+        s_valid = ~sorted_ops[1 + 2 * ki]
+        s_data = sorted_ops[2 + 2 * ki]
+        kd = jnp.zeros((max_groups,), dtype=data.dtype).at[group_id].set(
+            s_data, mode="drop"
+        )
+        kv = jnp.zeros((max_groups,), dtype=jnp.bool_).at[group_id].set(
+            s_valid, mode="drop"
+        )
+        out_key_data.append(kd)
+        out_key_valid.append(kv)
+
+    results = []
+    for spec, pair in zip(agg_specs, agg_inputs):
+        if spec.kind == "count_star":
+            ones = jnp.ones(n, dtype=jnp.int64)
+            results.append(
+                jax.ops.segment_sum(ones, group_id, num_segments=max_groups)
+            )
+            continue
+        data, valid = pair
+        s_data = data[perm]
+        s_valid = valid[perm]
+        if spec.kind == "count":
+            results.append(
+                jax.ops.segment_sum(
+                    s_valid.astype(jnp.int64), group_id, num_segments=max_groups
+                )
+            )
+        elif spec.kind in ("sum", "avg"):
+            contrib = jnp.where(s_valid, s_data, jnp.zeros_like(s_data))
+            ssum = jax.ops.segment_sum(contrib, group_id, num_segments=max_groups)
+            if spec.kind == "sum":
+                cnt = jax.ops.segment_sum(
+                    s_valid.astype(jnp.int64), group_id, num_segments=max_groups
+                )
+                # SQL: sum over empty/all-null group is NULL — caller uses cnt
+                results.append((ssum, cnt))
+            else:
+                cnt = jax.ops.segment_sum(
+                    s_valid.astype(jnp.int64), group_id, num_segments=max_groups
+                )
+                results.append((ssum, cnt))
+        elif spec.kind == "min":
+            masked = jnp.where(s_valid, s_data, _max_ident(s_data.dtype))
+            m = jax.ops.segment_min(masked, group_id, num_segments=max_groups)
+            cnt = jax.ops.segment_sum(
+                s_valid.astype(jnp.int64), group_id, num_segments=max_groups
+            )
+            results.append((m, cnt))
+        elif spec.kind == "max":
+            masked = jnp.where(s_valid, s_data, _min_ident(s_data.dtype))
+            m = jax.ops.segment_max(masked, group_id, num_segments=max_groups)
+            cnt = jax.ops.segment_sum(
+                s_valid.astype(jnp.int64), group_id, num_segments=max_groups
+            )
+            results.append((m, cnt))
+        else:
+            raise NotImplementedError(spec.kind)
+    return (out_key_data, out_key_valid), results, num_groups, overflow
+
+
+def global_aggregate(
+    sel: jnp.ndarray,
+    agg_inputs: Sequence[tuple[jnp.ndarray, jnp.ndarray] | None],
+    agg_specs: Sequence[AggSpec],
+):
+    """Aggregation without GROUP BY: single group, plain reductions."""
+    results = []
+    for spec, pair in zip(agg_specs, agg_inputs):
+        if spec.kind == "count_star":
+            results.append(jnp.sum(sel.astype(jnp.int64)))
+            continue
+        data, valid = pair
+        use = valid & sel
+        cnt = jnp.sum(use.astype(jnp.int64))
+        if spec.kind == "count":
+            results.append(cnt)
+        elif spec.kind in ("sum", "avg"):
+            s = jnp.sum(jnp.where(use, data, jnp.zeros_like(data)))
+            results.append((s, cnt))
+        elif spec.kind == "min":
+            results.append((jnp.min(jnp.where(use, data, _max_ident(data.dtype))), cnt))
+        elif spec.kind == "max":
+            results.append((jnp.max(jnp.where(use, data, _min_ident(data.dtype))), cnt))
+        else:
+            raise NotImplementedError(spec.kind)
+    return results
+
+
+def _max_ident(dtype):
+    import numpy as np
+
+    if np.issubdtype(dtype, np.integer):
+        return jnp.asarray(np.iinfo(dtype).max, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(True)
+    return jnp.asarray(np.inf, dtype=dtype)
+
+
+def _min_ident(dtype):
+    import numpy as np
+
+    if np.issubdtype(dtype, np.integer):
+        return jnp.asarray(np.iinfo(dtype).min, dtype=dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(False)
+    return jnp.asarray(-np.inf, dtype=dtype)

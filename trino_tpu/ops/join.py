@@ -1,0 +1,150 @@
+"""Equi-join kernels: sort build side + vectorized binary search probe.
+
+Reference: Trino's hash join — ``operator/HashBuilderOperator.java:51``,
+``operator/PagesHash.java:34`` (linear-probe table over synthetic addresses),
+``operator/LookupJoinOperator.java:71``.
+
+TPU-first design: no pointer-chasing hash table. Instead:
+1. Hash each side's key columns into one int64 key (mix64 per column,
+   combined), with NULL keys mapped to a never-matching sentinel.
+2. Sort the build side by hashed key (``lax.sort`` — fast bitonic on TPU).
+3. Probe with two vectorized binary searches (searchsorted left/right) to
+   get per-probe match ranges — fully parallel, no data-dependent loops.
+4. Expand matches into a fixed output capacity via cumsum offsets +
+   searchsorted "which probe row owns output slot t" — static shapes.
+5. Exactness: hashing may collide, so after expansion the caller re-checks
+   the real key columns and ANDs mismatches out of the selection. This makes
+   the kernel exact without needing perfect packing (Trino's 8-bit raw-hash
+   prefilter + full key compare, taken to its vectorized conclusion).
+
+Overflow: if total matches exceed capacity, the kernel reports it; the
+executor retries with a larger bucket (shape-bucketed recompile).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MISSING = jnp.iinfo(jnp.int32).max  # build position marking "no match" (left join)
+
+
+def mix64(x: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer — good avalanche, cheap on VPU."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    x = x ^ (x >> 31)
+    return x
+
+
+def hash_keys(keys, null_sentinel: bool = True) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Combine key columns [(data, valid), ...] into (hash int64, all_valid)."""
+    acc = jnp.zeros(keys[0][0].shape[0], dtype=jnp.uint64)
+    all_valid = None
+    for data, valid in keys:
+        h = mix64(data.astype(jnp.int64))
+        acc = mix64(acc ^ h)
+        all_valid = valid if all_valid is None else (all_valid & valid)
+    return acc.astype(jnp.int64), all_valid
+
+
+def build_side(key_hash: jnp.ndarray, valid: jnp.ndarray, sel: jnp.ndarray):
+    """Sort build rows by hashed key; invalid/unselected rows pushed to +inf.
+
+    Returns (sorted_keys, sorted_row_indices, build_count).
+    """
+    n = key_hash.shape[0]
+    use = valid & sel
+    maxv = jnp.iinfo(jnp.int64).max
+    keyed = jnp.where(use, key_hash, maxv)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sorted_keys, sorted_idx = jax.lax.sort((keyed, idx), num_keys=1)
+    count = jnp.sum(use.astype(jnp.int32))
+    return sorted_keys, sorted_idx, count
+
+
+def probe_join(
+    sorted_build_keys: jnp.ndarray,
+    sorted_build_idx: jnp.ndarray,
+    build_count: jnp.ndarray,
+    probe_hash: jnp.ndarray,
+    probe_valid: jnp.ndarray,
+    probe_sel: jnp.ndarray,
+    out_capacity: int,
+    join_type: str = "inner",
+):
+    """Expand probe x build matches into fixed-capacity gather indices.
+
+    Returns (probe_pos, build_pos, out_sel, total, overflow):
+      probe_pos/build_pos: (out_capacity,) int32 gather indices into the
+        original (unsorted) batches; build_pos == MISSING for outer rows.
+      out_sel: (out_capacity,) bool — which output slots are live.
+      total: int32 scalar — true number of output rows.
+      overflow: bool — total > out_capacity.
+    """
+    use = probe_valid & probe_sel
+    maxv = jnp.iinfo(jnp.int64).max
+    keys = jnp.where(use, probe_hash, maxv - 1)  # never matches sentinel maxv
+    lo = jnp.searchsorted(sorted_build_keys, keys, side="left")
+    hi = jnp.searchsorted(sorted_build_keys, keys, side="right")
+    hi = jnp.minimum(hi, build_count)
+    lo = jnp.minimum(lo, hi)
+    counts = jnp.where(use, hi - lo, 0)
+    if join_type == "left":
+        emit = jnp.where(probe_sel, jnp.maximum(counts, 1), 0)
+    elif join_type == "inner":
+        emit = counts
+    else:
+        raise NotImplementedError(join_type)
+    offsets = jnp.cumsum(emit) - emit  # exclusive prefix
+    total = offsets[-1] + emit[-1] if emit.shape[0] else jnp.int32(0)
+    overflow = total > out_capacity
+
+    # For each output slot t, find owning probe row: last p with offsets<=t.
+    t = jnp.arange(out_capacity, dtype=emit.dtype)
+    ends = offsets + emit  # inclusive end per probe row
+    probe_pos = jnp.searchsorted(ends, t, side="right").astype(jnp.int32)
+    probe_pos = jnp.minimum(probe_pos, emit.shape[0] - 1)
+    j = t - offsets[probe_pos]
+    matched = counts[probe_pos] > 0
+    build_slot = lo[probe_pos] + j.astype(lo.dtype)
+    build_pos = jnp.where(
+        matched,
+        sorted_build_idx[jnp.clip(build_slot, 0, sorted_build_idx.shape[0] - 1)],
+        MISSING,
+    ).astype(jnp.int32)
+    out_sel = t < total
+    return probe_pos, build_pos, out_sel, total, overflow
+
+
+def verify_equal(probe_keys, build_keys, probe_pos, build_pos, out_sel):
+    """Exactness pass: re-check real key equality after hash-based expansion.
+
+    probe_keys/build_keys: [(data, valid), ...] original (unsorted) columns.
+    Rows where build_pos == MISSING (left-outer padding) are kept.
+    """
+    ok = jnp.ones(probe_pos.shape[0], dtype=jnp.bool_)
+    is_outer = build_pos == MISSING
+    safe_build = jnp.where(is_outer, 0, build_pos)
+    for (pd, pv), (bd, bv) in zip(probe_keys, build_keys):
+        p_d = pd[probe_pos]
+        p_v = pv[probe_pos]
+        b_d = bd[safe_build]
+        b_v = bv[safe_build]
+        ok = ok & (p_d == b_d) & p_v & b_v
+    return out_sel & (ok | is_outer)
+
+
+def semi_join_mask(
+    sorted_build_keys, build_count, probe_hash, probe_valid,
+):
+    """EXISTS-style membership: does probe key appear in build? (hash-level;
+    caller verifies via a small second pass or accepts for dynamic filters).
+    """
+    maxv = jnp.iinfo(jnp.int64).max
+    keys = jnp.where(probe_valid, probe_hash, maxv - 1)
+    lo = jnp.searchsorted(sorted_build_keys, keys, side="left")
+    hi = jnp.searchsorted(sorted_build_keys, keys, side="right")
+    hi = jnp.minimum(hi, build_count)
+    return (hi > lo) & probe_valid

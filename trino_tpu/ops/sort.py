@@ -1,0 +1,75 @@
+"""ORDER BY / TopN kernels.
+
+Reference: ``operator/OrderByOperator.java:45`` (PagesIndex sort),
+``operator/TopNOperator.java:37``.
+
+TPU-first: multi-key lexicographic ``lax.sort`` over bit-transformed keys.
+Each key column is mapped to an unsigned-comparable integer form so that a
+single ascending sort realizes asc/desc and nulls-first/last:
+
+- integers: value (negated bitwise for desc)
+- floats: IEEE-754 total-order trick (flip sign bit or all bits)
+- strings: dictionary rank (host precomputed)
+- nulls: a separate leading key per column encodes null position
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    ascending: bool = True
+    nulls_first: bool = False  # Trino default: NULLS LAST for ASC
+
+
+def sortable_key(data: jnp.ndarray, valid: jnp.ndarray, key: SortKey, ranks=None):
+    """Return list of sort operand arrays for one key column (null key +
+    value key), already oriented for ascending lax.sort.
+
+    Floats are sorted as native float operands (lax.sort has a total order);
+    descending = negation. No bitcasts — f64 bitcast is unsupported under
+    TPU's x64 rewriting.
+    """
+    if ranks is not None:  # dictionary string: map codes to ranks
+        r = jnp.asarray(ranks)
+        value = r[jnp.maximum(data, 0)].astype(jnp.int64)
+        if not key.ascending:
+            value = -1 - value
+    elif np.issubdtype(np.dtype(data.dtype), np.floating):
+        value = data if key.ascending else -data
+    elif data.dtype == jnp.bool_:
+        value = data.astype(jnp.int32)
+        if not key.ascending:
+            value = -value
+    else:
+        value = data.astype(jnp.int64)
+        if not key.ascending:
+            value = -1 - value  # bitwise complement keeps total order reversed
+    # null ordering: nulls_first -> null key False sorts first for nulls
+    null_key = valid if key.nulls_first else ~valid
+    value = jnp.where(valid, value, jnp.zeros_like(value))
+    return [null_key, value]
+
+
+def sort_indices(
+    key_arrays: Sequence[tuple[jnp.ndarray, jnp.ndarray]],
+    keys: Sequence[SortKey],
+    sel: jnp.ndarray,
+    ranks_per_key: Sequence[np.ndarray | None] | None = None,
+) -> jnp.ndarray:
+    """Return permutation putting selected rows first in key order."""
+    n = sel.shape[0]
+    ops = [~sel]
+    for i, ((data, valid), k) in enumerate(zip(key_arrays, keys)):
+        ranks = ranks_per_key[i] if ranks_per_key else None
+        ops.extend(sortable_key(data, valid, k, ranks))
+    idx = jnp.arange(n, dtype=jnp.int32)
+    out = jax.lax.sort(tuple(ops) + (idx,), num_keys=len(ops), is_stable=True)
+    return out[-1]

@@ -1,0 +1,307 @@
+"""SQL type system mapped onto TPU-friendly storage dtypes.
+
+Reference: ``core/trino-spi/src/main/java/io/trino/spi/type/`` (40+ types).
+We cover the engine-relevant core: BOOLEAN, the integer ladder, REAL, DOUBLE,
+DECIMAL(p,s), VARCHAR/CHAR, DATE, TIMESTAMP, plus UNKNOWN (the NULL type).
+
+Storage design (TPU-first, not a port):
+- Every type has a fixed-width device representation. Strings are
+  dictionary-encoded int32 codes over a host-side dictionary (Trino's
+  ``DictionaryBlock`` is an optimization; here it is the *primary* string
+  representation since TPUs need fixed-width lanes).
+- DECIMAL(p<=18,s) is an int64 scaled integer (exact arithmetic; reference
+  semantics: ``spi/type/UnscaledDecimal128Arithmetic.java``). p>18 is
+  unsupported in v1 (TPC-H/TPC-DS fit in 18 digits).
+- DATE is int32 days since 1970-01-01; TIMESTAMP int64 microseconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import total_ordering
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SqlType:
+    """Base for all SQL types. Frozen + hashable so types are usable as keys."""
+
+    name: str
+
+    @property
+    def storage_dtype(self) -> np.dtype:
+        raise NotImplementedError
+
+    def __str__(self) -> str:
+        return self.name
+
+    # display helpers -----------------------------------------------------
+    def to_python(self, storage_value, dictionary=None):
+        """Convert one storage scalar to a Python value for client output."""
+        return storage_value
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanType(SqlType):
+    name: str = "boolean"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.bool_)
+
+    def to_python(self, v, dictionary=None):
+        return bool(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerLikeType(SqlType):
+    bits: int = 64
+
+    @property
+    def storage_dtype(self):
+        return np.dtype({8: np.int8, 16: np.int16, 32: np.int32, 64: np.int64}[self.bits])
+
+    def to_python(self, v, dictionary=None):
+        return int(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class RealType(SqlType):
+    name: str = "real"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.float32)
+
+    def to_python(self, v, dictionary=None):
+        return float(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleType(SqlType):
+    name: str = "double"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.float64)
+
+    def to_python(self, v, dictionary=None):
+        return float(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(SqlType):
+    """DECIMAL(precision, scale) stored as int64 scaled by 10**scale."""
+
+    precision: int = 18
+    scale: int = 0
+    name: str = ""
+
+    def __post_init__(self):
+        if self.precision > 18:
+            raise NotImplementedError("DECIMAL precision > 18 not supported in v1")
+        object.__setattr__(self, "name", f"decimal({self.precision},{self.scale})")
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int64)
+
+    @property
+    def unscale(self) -> int:
+        return 10**self.scale
+
+    def to_python(self, v, dictionary=None):
+        from decimal import Decimal
+
+        return Decimal(int(v)) / (10**self.scale) if self.scale else Decimal(int(v))
+
+
+@dataclasses.dataclass(frozen=True)
+class VarcharType(SqlType):
+    """VARCHAR(n): dictionary-encoded int32 codes. n is advisory."""
+
+    length: int | None = None
+    name: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "name", "varchar" if self.length is None else f"varchar({self.length})"
+        )
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+    def to_python(self, v, dictionary=None):
+        if dictionary is None:
+            raise ValueError("varchar column without dictionary")
+        return dictionary.decode(int(v))
+
+
+@dataclasses.dataclass(frozen=True)
+class CharType(SqlType):
+    length: int = 1
+    name: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", f"char({self.length})")
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+    def to_python(self, v, dictionary=None):
+        if dictionary is None:
+            raise ValueError("char column without dictionary")
+        return dictionary.decode(int(v))
+
+
+@dataclasses.dataclass(frozen=True)
+class DateType(SqlType):
+    name: str = "date"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+    def to_python(self, v, dictionary=None):
+        import datetime
+
+        return (datetime.date(1970, 1, 1) + datetime.timedelta(days=int(v))).isoformat()
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampType(SqlType):
+    name: str = "timestamp"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int64)
+
+    def to_python(self, v, dictionary=None):
+        import datetime
+
+        return (
+            datetime.datetime(1970, 1, 1) + datetime.timedelta(microseconds=int(v))
+        ).isoformat(sep=" ")
+
+
+@dataclasses.dataclass(frozen=True)
+class UnknownType(SqlType):
+    """The type of a bare NULL literal (reference: ``spi/type/UnknownType``)."""
+
+    name: str = "unknown"
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.bool_)
+
+
+BOOLEAN = BooleanType()
+TINYINT = IntegerLikeType("tinyint", 8)
+SMALLINT = IntegerLikeType("smallint", 16)
+INTEGER = IntegerLikeType("integer", 32)
+BIGINT = IntegerLikeType("bigint", 64)
+REAL = RealType()
+DOUBLE = DoubleType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+UNKNOWN = UnknownType()
+VARCHAR = VarcharType()
+
+
+def decimal(precision: int, scale: int) -> DecimalType:
+    return DecimalType(precision=precision, scale=scale)
+
+
+def varchar(length: int | None = None) -> VarcharType:
+    return VarcharType(length=length)
+
+
+def char(length: int) -> CharType:
+    return CharType(length=length)
+
+
+def is_integer(t: SqlType) -> bool:
+    return isinstance(t, IntegerLikeType)
+
+
+def is_numeric(t: SqlType) -> bool:
+    return isinstance(t, (IntegerLikeType, RealType, DoubleType, DecimalType))
+
+
+def is_string(t: SqlType) -> bool:
+    return isinstance(t, (VarcharType, CharType))
+
+
+def is_orderable(t: SqlType) -> bool:
+    return is_numeric(t) or is_string(t) or isinstance(t, (DateType, TimestampType, BooleanType))
+
+
+_INT_ORDER = {"tinyint": 0, "smallint": 1, "integer": 2, "bigint": 3}
+
+
+def common_super_type(a: SqlType, b: SqlType) -> SqlType | None:
+    """Implicit coercion lattice (reference: ``type/TypeCoercion.java``)."""
+    if a == b:
+        return a
+    if isinstance(a, UnknownType):
+        return b
+    if isinstance(b, UnknownType):
+        return a
+    if is_integer(a) and is_integer(b):
+        return a if _INT_ORDER[a.name] >= _INT_ORDER[b.name] else b
+    # integer + decimal -> decimal wide enough to hold the integer
+    if is_integer(a) and isinstance(b, DecimalType):
+        return DecimalType(precision=18, scale=b.scale)
+    if isinstance(a, DecimalType) and is_integer(b):
+        return DecimalType(precision=18, scale=a.scale)
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        scale = max(a.scale, b.scale)
+        return DecimalType(precision=18, scale=scale)
+    # anything numeric + double/real -> double
+    numeric = (IntegerLikeType, DecimalType, RealType, DoubleType)
+    if isinstance(a, numeric) and isinstance(b, numeric):
+        if DOUBLE in (a, b) or (isinstance(a, RealType) or isinstance(b, RealType)):
+            if isinstance(a, RealType) and isinstance(b, RealType):
+                return REAL
+            return DOUBLE
+    if is_string(a) and is_string(b):
+        return VARCHAR
+    if isinstance(a, DateType) and isinstance(b, TimestampType):
+        return TIMESTAMP
+    if isinstance(a, TimestampType) and isinstance(b, DateType):
+        return TIMESTAMP
+    return None
+
+
+def parse_type(text: str) -> SqlType:
+    """Parse a type name as it appears in SQL (CAST target, DDL)."""
+    t = text.strip().lower()
+    simple = {
+        "boolean": BOOLEAN,
+        "tinyint": TINYINT,
+        "smallint": SMALLINT,
+        "integer": INTEGER,
+        "int": INTEGER,
+        "bigint": BIGINT,
+        "real": REAL,
+        "double": DOUBLE,
+        "date": DATE,
+        "timestamp": TIMESTAMP,
+        "varchar": VARCHAR,
+    }
+    if t in simple:
+        return simple[t]
+    if t.startswith("decimal"):
+        inner = t[t.index("(") + 1 : t.index(")")]
+        p, s = ([int(x) for x in inner.split(",")] + [0])[:2]
+        return decimal(p, s)
+    if t.startswith("varchar"):
+        inner = t[t.index("(") + 1 : t.index(")")]
+        return varchar(int(inner))
+    if t.startswith("char"):
+        inner = t[t.index("(") + 1 : t.index(")")]
+        return char(int(inner))
+    raise ValueError(f"cannot parse type: {text!r}")
