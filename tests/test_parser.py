@@ -1,0 +1,258 @@
+"""Parser tests over the TPC-H query surface (public benchmark SQL)."""
+
+import pytest
+
+from trino_tpu.sql import parse_statement
+from trino_tpu.sql import tree as t
+from trino_tpu.sql.lexer import SqlSyntaxError
+
+TPCH_Q1 = """
+select
+    l_returnflag, l_linestatus,
+    sum(l_quantity) as sum_qty,
+    sum(l_extendedprice) as sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+    avg(l_quantity) as avg_qty,
+    avg(l_extendedprice) as avg_price,
+    avg(l_discount) as avg_disc,
+    count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+TPCH_Q3 = """
+select
+    l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+    and c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and o_orderdate < date '1995-03-15'
+    and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10
+"""
+
+TPCH_Q5 = """
+select n_name, sum(l_extendedprice * (1 - l_discount)) as revenue
+from customer, orders, lineitem, supplier, nation, region
+where c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and l_suppkey = s_suppkey
+    and c_nationkey = s_nationkey
+    and s_nationkey = n_nationkey
+    and n_regionkey = r_regionkey
+    and r_name = 'ASIA'
+    and o_orderdate >= date '1994-01-01'
+    and o_orderdate < date '1994-01-01' + interval '1' year
+group by n_name
+order by revenue desc
+"""
+
+TPCH_Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+    and l_shipdate < date '1994-01-01' + interval '1' year
+    and l_discount between 0.06 - 0.01 and 0.06 + 0.01
+    and l_quantity < 24
+"""
+
+TPCH_Q10 = """
+select
+    c_custkey, c_name,
+    sum(l_extendedprice * (1 - l_discount)) as revenue,
+    c_acctbal, n_name, c_address, c_phone, c_comment
+from customer, orders, lineitem, nation
+where c_custkey = o_custkey
+    and l_orderkey = o_orderkey
+    and o_orderdate >= date '1993-10-01'
+    and o_orderdate < date '1993-10-01' + interval '3' month
+    and l_returnflag = 'R'
+    and c_nationkey = n_nationkey
+group by c_custkey, c_name, c_acctbal, c_phone, n_name, c_address, c_comment
+order by revenue desc
+limit 20
+"""
+
+
+class TestTpchParsing:
+    @pytest.mark.parametrize(
+        "sql", [TPCH_Q1, TPCH_Q3, TPCH_Q5, TPCH_Q6, TPCH_Q10],
+        ids=["q1", "q3", "q5", "q6", "q10"],
+    )
+    def test_parses(self, sql):
+        q = parse_statement(sql)
+        assert isinstance(q, t.Query)
+        assert isinstance(q.body, t.QuerySpec)
+
+    def test_q1_shape(self):
+        q = parse_statement(TPCH_Q1)
+        spec = q.body
+        assert len(spec.select_items) == 10
+        assert spec.select_items[2].alias == "sum_qty"
+        assert len(spec.group_by) == 2
+        assert len(q.order_by) == 2
+        # where: l_shipdate <= date - interval
+        assert isinstance(spec.where, t.BinaryOp) and spec.where.op == "<="
+        rhs = spec.where.right
+        assert isinstance(rhs, t.BinaryOp) and rhs.op == "-"
+        assert isinstance(rhs.right, t.IntervalLiteral) and rhs.right.unit == "day"
+
+    def test_q3_implicit_cross_joins(self):
+        q = parse_statement(TPCH_Q3)
+        f = q.body.from_
+        assert isinstance(f, t.Join) and f.join_type == "CROSS"
+        assert q.limit == 10
+        assert q.order_by[0].ascending is False
+
+    def test_count_star(self):
+        q = parse_statement("select count(*) from t")
+        fc = q.body.select_items[0].expression
+        assert isinstance(fc, t.FunctionCall) and fc.name == "count"
+        assert isinstance(fc.args[0], t.Star)
+
+
+class TestGeneralParsing:
+    def test_explicit_join_on(self):
+        q = parse_statement(
+            "select * from a join b on a.x = b.y left join c on b.z = c.z"
+        )
+        f = q.body.from_
+        assert isinstance(f, t.Join) and f.join_type == "LEFT"
+        assert isinstance(f.left, t.Join) and f.left.join_type == "INNER"
+
+    def test_case_searched_and_simple(self):
+        q = parse_statement(
+            "select case when x > 1 then 'a' when x > 0 then 'b' else 'c' end, "
+            "case y when 1 then 'one' else 'many' end from t"
+        )
+        c1 = q.body.select_items[0].expression
+        c2 = q.body.select_items[1].expression
+        assert isinstance(c1, t.Case) and c1.operand is None and len(c1.whens) == 2
+        assert isinstance(c2, t.Case) and c2.operand is not None
+
+    def test_subquery_relation_and_scalar(self):
+        q = parse_statement(
+            "select * from (select a from t) u where a > (select avg(a) from t)"
+        )
+        assert isinstance(q.body.from_, t.AliasedRelation)
+        assert isinstance(q.body.from_.relation, t.SubqueryRelation)
+        assert isinstance(q.body.where.right, t.ScalarSubquery)
+
+    def test_in_list_and_subquery(self):
+        q = parse_statement(
+            "select * from t where a in (1, 2, 3) and b not in (select b from u)"
+        )
+        w = q.body.where
+        assert isinstance(w.left, t.InList) and len(w.left.items) == 3
+        assert isinstance(w.right, t.InSubquery) and w.right.negated
+
+    def test_exists_and_not(self):
+        q = parse_statement("select * from t where not exists (select 1 from u)")
+        w = q.body.where
+        assert isinstance(w, t.UnaryOp) and w.op == "NOT"
+        assert isinstance(w.operand, t.Exists)
+
+    def test_with_cte(self):
+        q = parse_statement(
+            "with r as (select a, b from t), s as (select * from r) select * from s"
+        )
+        assert len(q.with_queries) == 2
+        assert q.with_queries[0].name == "r"
+
+    def test_union_all(self):
+        q = parse_statement("select a from t union all select b from u")
+        assert isinstance(q.body, t.SetOperation)
+        assert q.body.op == "UNION" and not q.body.distinct
+
+    def test_cast_and_try_cast(self):
+        q = parse_statement(
+            "select cast(a as decimal(12,2)), try_cast(b as bigint) from t"
+        )
+        c1 = q.body.select_items[0].expression
+        c2 = q.body.select_items[1].expression
+        assert isinstance(c1, t.Cast) and c1.target == "decimal(12,2)" and not c1.safe
+        assert isinstance(c2, t.Cast) and c2.safe
+
+    def test_window_function(self):
+        q = parse_statement(
+            "select rank() over (partition by g order by x desc) from t"
+        )
+        fc = q.body.select_items[0].expression
+        assert fc.window is not None
+        assert len(fc.window.partition_by) == 1
+        assert fc.window.order_by[0].ascending is False
+
+    def test_extract(self):
+        q = parse_statement("select extract(year from d) from t")
+        e = q.body.select_items[0].expression
+        assert isinstance(e, t.Extract) and e.field == "year"
+
+    def test_like_escape_and_negation(self):
+        q = parse_statement(
+            "select * from t where a like 'x%' and b not like '%y'"
+        )
+        w = q.body.where
+        assert isinstance(w.left, t.Like) and not w.left.negated
+        assert isinstance(w.right, t.Like) and w.right.negated
+
+    def test_is_null(self):
+        q = parse_statement("select * from t where a is null and b is not null")
+        w = q.body.where
+        assert isinstance(w.left, t.IsNull) and not w.left.negated
+        assert isinstance(w.right, t.IsNull) and w.right.negated
+
+    def test_order_by_nulls(self):
+        q = parse_statement("select a from t order by a desc nulls first, b")
+        assert q.order_by[0].nulls_first is True
+        assert q.order_by[0].ascending is False
+        assert q.order_by[1].nulls_first is None
+
+    def test_quoted_identifiers_and_comments(self):
+        q = parse_statement(
+            'select "weird col" from "my table" -- comment\n where x = 1 /* block */'
+        )
+        assert isinstance(q.body.from_, t.Table)
+        assert q.body.from_.name == ("my table",)
+
+    def test_operator_precedence(self):
+        q = parse_statement("select 1 + 2 * 3 from t")
+        e = q.body.select_items[0].expression
+        assert e.op == "+" and e.right.op == "*"
+
+    def test_set_session_and_explain(self):
+        s = parse_statement("set session join_distribution_type = 'BROADCAST'")
+        assert isinstance(s, t.SetSession)
+        e = parse_statement("explain analyze select 1")
+        assert isinstance(e, t.Explain) and e.analyze
+
+    def test_show_statements(self):
+        assert isinstance(parse_statement("show tables"), t.ShowTables)
+        assert isinstance(parse_statement("show catalogs"), t.ShowCatalogs)
+        assert isinstance(parse_statement("show schemas from tpch"), t.ShowSchemas)
+
+    def test_values(self):
+        q = parse_statement("select * from (values (1, 'a'), (2, 'b')) v (id, name)")
+        ar = q.body.from_
+        assert isinstance(ar, t.AliasedRelation)
+        assert ar.column_aliases == ("id", "name")
+
+    def test_syntax_error_reports_location(self):
+        with pytest.raises(SqlSyntaxError):
+            parse_statement("select from where")
+
+    def test_limit_and_offset(self):
+        q = parse_statement("select a from t order by a offset 5 rows limit 10")
+        assert q.limit == 10 and q.offset == 5
+
+    def test_decimal_vs_integer_literals(self):
+        q = parse_statement("select 0.06, 24, 1e2 from t")
+        kinds = [i.expression.kind for i in q.body.select_items]
+        assert kinds == ["decimal", "integer", "double"]

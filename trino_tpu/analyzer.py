@@ -1,0 +1,1118 @@
+"""Semantic analysis + logical planning: AST -> typed logical plan.
+
+Reference: ``core/trino-main/src/main/java/io/trino/sql/analyzer/``
+(``Analyzer.java:44``, ``StatementAnalyzer.java:284``,
+``ExpressionAnalyzer.java``) and ``sql/planner/QueryPlanner.java:139`` /
+``RelationPlanner.java``. Trino splits analysis (side-tables) from planning;
+we fuse them: one pass resolves names/types and emits plan nodes whose
+expressions are RowExpr trees over Symbols.
+
+Typing rules implemented (Trino semantics, DECIMAL capped at precision 18):
+- integer literal -> bigint; '1.2' -> decimal(2,1); string -> varchar
+- decimal add/sub: s=max(s1,s2); mul: s=s1+s2; div: s=max(s1,s2) (Trino
+  keeps max scale and rounds half-up); anything with double -> double
+- sum(decimal(p,s)) -> decimal(18,s)  [Trino: (38,s)]
+- avg(decimal(p,s)) -> decimal(p,s); avg(int) -> double; count -> bigint
+- date +/- interval day -> date; +/- interval month/year -> calendar add
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from trino_tpu import types as T
+from trino_tpu.compiler import days_from_civil
+from trino_tpu.config import Session
+from trino_tpu.connectors.api import CatalogManager
+from trino_tpu.ir import (
+    Call,
+    Constant,
+    RowExpr,
+    SpecialForm,
+    Variable,
+    call,
+    const,
+    special,
+    variable,
+)
+from trino_tpu.planner import plan as P
+from trino_tpu.sql import tree as t
+
+
+class SemanticError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Field:
+    name: Optional[str]  # None for anonymous expressions
+    qualifier: Optional[str]
+    symbol: P.Symbol
+
+
+class Scope:
+    def __init__(self, fields: list[Field], parent: Optional["Scope"] = None):
+        self.fields = fields
+        self.parent = parent
+
+    def resolve(self, parts: tuple[str, ...]) -> P.Symbol:
+        name = parts[-1].lower()
+        qualifier = parts[-2].lower() if len(parts) > 1 else None
+        matches = [
+            f
+            for f in self.fields
+            if f.name == name and (qualifier is None or f.qualifier == qualifier)
+        ]
+        if len(matches) == 1:
+            return matches[0].symbol
+        if len(matches) > 1:
+            raise SemanticError(f"ambiguous column: {'.'.join(parts)}")
+        if self.parent is not None:
+            return self.parent.resolve(parts)
+        raise SemanticError(f"column not found: {'.'.join(parts)}")
+
+    def try_resolve(self, parts: tuple[str, ...]) -> Optional[P.Symbol]:
+        try:
+            return self.resolve(parts)
+        except SemanticError:
+            return None
+
+
+@dataclasses.dataclass
+class RelationPlan:
+    node: P.PlanNode
+    scope: Scope
+
+
+class Analyzer:
+    def __init__(self, catalogs: CatalogManager, session: Session):
+        self.catalogs = catalogs
+        self.session = session
+        self.ctes: dict[str, RelationPlan] = {}
+
+    # ==== entry =========================================================
+    def plan_statement(self, stmt: t.Node) -> P.PlanNode:
+        if isinstance(stmt, t.Query):
+            rp, names = self.plan_query(stmt)
+            return P.Output(rp.node, names, rp.node.output_symbols)
+        raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
+
+    # ==== queries =======================================================
+    def plan_query(self, q: t.Query) -> tuple[RelationPlan, list[str]]:
+        saved_ctes = dict(self.ctes)
+        try:
+            for wq in q.with_queries:
+                rp, names = self.plan_query(wq.query)
+                if wq.column_aliases:
+                    names = list(wq.column_aliases)
+                fields = [
+                    Field(n.lower(), wq.name.lower(), s)
+                    for n, s in zip(names, rp.node.output_symbols)
+                ]
+                self.ctes[wq.name.lower()] = RelationPlan(rp.node, Scope(fields))
+            rp, names = self._plan_query_body(q.body, q.order_by, q.limit, q.offset)
+            return rp, names
+        finally:
+            self.ctes = saved_ctes
+
+    def _plan_query_body(
+        self,
+        body: t.Node,
+        order_by: tuple[t.SortItem, ...],
+        limit: Optional[int],
+        offset: int,
+    ) -> tuple[RelationPlan, list[str]]:
+        if isinstance(body, t.QuerySpec):
+            return self._plan_query_spec(body, order_by, limit, offset)
+        if isinstance(body, t.SetOperation):
+            rp, names = self._plan_set_operation(body)
+            rp = self._apply_order_limit(rp, names, order_by, limit, offset)
+            return rp, names
+        if isinstance(body, t.Values):
+            rp, names = self._plan_values(body)
+            rp = self._apply_order_limit(rp, names, order_by, limit, offset)
+            return rp, names
+        if isinstance(body, t.Query):
+            return self.plan_query(body)
+        raise SemanticError(f"unsupported query body: {type(body).__name__}")
+
+    def _apply_order_limit(self, rp, names, order_by, limit, offset):
+        if order_by:
+            scope = Scope(
+                [Field(n.lower(), None, s) for n, s in zip(names, rp.node.output_symbols)]
+            )
+            orderings = []
+            for si in order_by:
+                e = self._rewrite(si.expression, scope)
+                if not isinstance(e, Variable):
+                    raise SemanticError("ORDER BY over set op must reference columns")
+                sym = P.Symbol(e.name, e.type)
+                orderings.append(self._ordering(sym, si))
+            node = P.Sort(rp.node, orderings)
+            rp = RelationPlan(node, rp.scope)
+        if limit is not None or offset:
+            rp = RelationPlan(P.Limit(rp.node, limit, offset), rp.scope)
+        return rp
+
+    def _ordering(self, sym: P.Symbol, si: t.SortItem) -> P.Ordering:
+        nulls_first = si.nulls_first
+        if nulls_first is None:
+            nulls_first = not si.ascending  # Trino: NULLS LAST for ASC, FIRST for DESC
+        return P.Ordering(sym, si.ascending, nulls_first)
+
+    def _plan_values(self, v: t.Values) -> tuple[RelationPlan, list[str]]:
+        rows = []
+        col_types: list[T.SqlType] = []
+        for row in v.rows:
+            vals = []
+            for j, e in enumerate(row):
+                ex = self._rewrite(e, Scope([]))
+                ex = _fold(ex)
+                if not isinstance(ex, Constant):
+                    raise SemanticError("VALUES entries must be constant")
+                vals.append(ex)
+                if j >= len(col_types):
+                    col_types.append(ex.type)
+                else:
+                    ct = T.common_super_type(col_types[j], ex.type)
+                    if ct is None:
+                        raise SemanticError("incompatible VALUES column types")
+                    col_types[j] = ct
+            rows.append(vals)
+        symbols = [
+            P.Symbol(P.fresh_name(f"col{j}"), ct) for j, ct in enumerate(col_types)
+        ]
+        storage_rows = []
+        for row in rows:
+            srow = []
+            for cexpr, ct in zip(row, col_types):
+                srow.append(_coerce_constant_value(cexpr, ct))
+            storage_rows.append(srow)
+        names = [f"_col{j}" for j in range(len(col_types))]
+        node = P.Values(symbols, storage_rows)
+        fields = [Field(None, None, s) for s in symbols]
+        return RelationPlan(node, Scope(fields)), names
+
+    def _plan_set_operation(self, op: t.SetOperation) -> tuple[RelationPlan, list[str]]:
+        left_rp, left_names = self._plan_query_body(op.left, (), None, 0)
+        right_rp, _ = self._plan_query_body(op.right, (), None, 0)
+        lsyms = left_rp.node.output_symbols
+        rsyms = right_rp.node.output_symbols
+        if len(lsyms) != len(rsyms):
+            raise SemanticError("set operation column count mismatch")
+        out_syms = []
+        for a, b in zip(lsyms, rsyms):
+            ct = T.common_super_type(a.type, b.type)
+            if ct is None:
+                raise SemanticError(f"set operation type mismatch: {a.type} vs {b.type}")
+            out_syms.append(P.Symbol(P.fresh_name(a.name), ct))
+        node = P.SetOp(op.op, op.distinct, [left_rp.node, right_rp.node], out_syms)
+        fields = [
+            Field(n.lower(), None, s) for n, s in zip(left_names, out_syms)
+        ]
+        return RelationPlan(node, Scope(fields)), left_names
+
+    # ==== SELECT core ===================================================
+    def _plan_query_spec(
+        self,
+        spec: t.QuerySpec,
+        order_by: tuple[t.SortItem, ...],
+        limit: Optional[int],
+        offset: int,
+    ) -> tuple[RelationPlan, list[str]]:
+        # FROM
+        if spec.from_ is not None:
+            rp = self._plan_relation(spec.from_)
+        else:
+            sym = P.Symbol(P.fresh_name("dual"), T.BIGINT)
+            rp = RelationPlan(P.Values([sym], [[0]]), Scope([]))
+        # WHERE
+        if spec.where is not None:
+            pred, rp = self._rewrite_with_subqueries(spec.where, rp)
+            pred = _fold(pred)
+            rp = RelationPlan(P.Filter(rp.node, pred), rp.scope)
+
+        # expand stars, gather select expressions
+        select_entries: list[tuple[t.Node, Optional[str]]] = []
+        for item in spec.select_items:
+            if isinstance(item.expression, t.Star):
+                q = item.expression.qualifier
+                for f in rp.scope.fields:
+                    if f.name is None:
+                        continue
+                    if q is not None and f.qualifier != q.lower():
+                        continue
+                    select_entries.append(
+                        (t.Identifier((f.qualifier, f.name) if f.qualifier else (f.name,)), f.name)
+                    )
+            else:
+                alias = item.alias
+                if alias is None and isinstance(item.expression, t.Identifier):
+                    alias = item.expression.parts[-1]
+                select_entries.append((item.expression, alias))
+
+        has_aggs = any(
+            _contains_aggregate(e) for e, _ in select_entries
+        ) or (spec.having is not None and _contains_aggregate(spec.having)) or bool(
+            spec.group_by
+        )
+
+        if has_aggs:
+            return self._plan_aggregation(
+                spec, rp, select_entries, order_by, limit, offset
+            )
+
+        # plain projection
+        out_syms: list[P.Symbol] = []
+        assignments: list[tuple[P.Symbol, RowExpr]] = []
+        names: list[str] = []
+        for e_ast, alias in select_entries:
+            ex, rp = self._rewrite_with_subqueries(e_ast, rp)
+            ex = _fold(ex)
+            name = (alias or "_col").lower()
+            sym = P.Symbol(P.fresh_name(name), ex.type)
+            assignments.append((sym, ex))
+            out_syms.append(sym)
+            names.append(alias.lower() if alias else f"_col{len(names)}")
+
+        # ORDER BY may reference hidden input columns: keep them through sort
+        sort_items = []
+        extra_syms: list[P.Symbol] = []
+        if order_by:
+            select_scope = Scope(
+                [Field(n, None, s) for (n, s) in zip(names, out_syms)],
+            )
+            for si in order_by:
+                sym = self._resolve_sort_symbol(
+                    si, select_scope, rp.scope, select_entries, out_syms
+                )
+                if sym is None:
+                    ex = self._rewrite(si.expression, rp.scope)
+                    ex = _fold(ex)
+                    sym = P.Symbol(P.fresh_name("sortkey"), ex.type)
+                    assignments.append((sym, ex))
+                    extra_syms.append(sym)
+                sort_items.append(self._ordering(sym, si))
+
+        node: P.PlanNode = P.Project(rp.node, assignments)
+        if spec.distinct:
+            if extra_syms:
+                raise SemanticError(
+                    "ORDER BY expression must appear in select list with DISTINCT"
+                )
+            node = P.Distinct(node)
+        if sort_items:
+            if limit is not None and offset == 0:
+                node = P.TopN(node, limit, sort_items)
+                limit = None
+            else:
+                node = P.Sort(node, sort_items)
+        if extra_syms:
+            node = P.Project(
+                node, [(s, variable(s.name, s.type)) for s in out_syms]
+            )
+        if limit is not None or offset:
+            node = P.Limit(node, limit, offset)
+        fields = [Field(n, None, s) for n, s in zip(names, out_syms)]
+        return RelationPlan(node, Scope(fields)), names
+
+    def _resolve_sort_symbol(
+        self, si, select_scope, input_scope, select_entries, out_syms
+    ) -> Optional[P.Symbol]:
+        e = si.expression
+        if isinstance(e, t.Literal) and e.kind == "integer":
+            idx = int(e.value) - 1
+            if not (0 <= idx < len(out_syms)):
+                raise SemanticError(f"ORDER BY ordinal {e.value} out of range")
+            return out_syms[idx]
+        if isinstance(e, t.Identifier):
+            sym = select_scope.try_resolve(e.parts)
+            if sym is not None:
+                return sym
+        # structural match against select expressions
+        for (se, _), sym in zip(select_entries, out_syms):
+            if se == e:
+                return sym
+        return None
+
+    # ==== aggregation ===================================================
+    def _plan_aggregation(
+        self, spec, rp, select_entries, order_by, limit, offset
+    ) -> tuple[RelationPlan, list[str]]:
+        input_scope = rp.scope
+        # resolve group keys (ordinals or expressions)
+        group_asts: list[t.Node] = []
+        for g in spec.group_by:
+            if isinstance(g, t.Literal) and g.kind == "integer":
+                idx = int(g.value) - 1
+                if not (0 <= idx < len(select_entries)):
+                    raise SemanticError(f"GROUP BY ordinal {g.value} out of range")
+                group_asts.append(select_entries[idx][0])
+            else:
+                group_asts.append(g)
+
+        # collect aggregate calls from select + having + order_by
+        agg_asts: list[t.FunctionCall] = []
+        for e, _ in select_entries:
+            _collect_aggregates(e, agg_asts)
+        if spec.having is not None:
+            _collect_aggregates(spec.having, agg_asts)
+        for si in order_by:
+            _collect_aggregates(si.expression, agg_asts)
+
+        # pre-projection: group key exprs + agg argument exprs
+        pre_assignments: list[tuple[P.Symbol, RowExpr]] = []
+        key_symbols: list[P.Symbol] = []
+        key_map: dict[t.Node, P.Symbol] = {}
+        for g_ast in group_asts:
+            if g_ast in key_map:
+                continue
+            ex = self._rewrite(g_ast, input_scope)
+            ex = _fold(ex)
+            sym = P.Symbol(P.fresh_name("gk"), ex.type)
+            pre_assignments.append((sym, ex))
+            key_symbols.append(sym)
+            key_map[g_ast] = sym
+
+        aggs: list[tuple[P.Symbol, P.AggFunction]] = []
+        agg_map: dict[t.Node, P.Symbol] = {}
+        for fc in agg_asts:
+            if fc in agg_map:
+                continue
+            kind = fc.name
+            if kind not in ("sum", "count", "avg", "min", "max"):
+                raise SemanticError(f"unsupported aggregate: {kind}")
+            if kind == "count" and len(fc.args) == 1 and isinstance(fc.args[0], t.Star):
+                arg_expr = None
+                result_type: T.SqlType = T.BIGINT
+                kind = "count_star"
+                arg_sym_expr = None
+            else:
+                arg = self._rewrite(fc.args[0], input_scope)
+                arg = _fold(arg)
+                if kind == "count":
+                    result_type = T.BIGINT
+                elif kind == "sum":
+                    if isinstance(arg.type, T.DecimalType):
+                        result_type = T.decimal(18, arg.type.scale)
+                    elif T.is_integer(arg.type):
+                        result_type = T.BIGINT
+                    else:
+                        result_type = arg.type
+                elif kind == "avg":
+                    if isinstance(arg.type, T.DecimalType):
+                        result_type = arg.type
+                    else:
+                        result_type = T.DOUBLE
+                else:  # min/max
+                    result_type = arg.type
+                sym_in = P.Symbol(P.fresh_name("aggarg"), arg.type)
+                pre_assignments.append((sym_in, arg))
+                arg_sym_expr = variable(sym_in.name, sym_in.type)
+            filt = None
+            if fc.filter is not None:
+                f_ex = self._rewrite(fc.filter, input_scope)
+                sym_f = P.Symbol(P.fresh_name("aggfilter"), T.BOOLEAN)
+                pre_assignments.append((sym_f, _fold(f_ex)))
+                filt = variable(sym_f.name, T.BOOLEAN)
+            out_sym = P.Symbol(P.fresh_name(fc.name), result_type)
+            aggs.append(
+                (out_sym, P.AggFunction(kind, arg_sym_expr, result_type, fc.distinct, filt))
+            )
+            agg_map[fc] = out_sym
+
+        pre_project = P.Project(rp.node, pre_assignments)
+        agg_node = P.Aggregate(pre_project, key_symbols, aggs, step="single")
+
+        # post-agg scope: group-by ASTs and agg ASTs -> symbols
+        post_replacements: dict[t.Node, P.Symbol] = {}
+        post_replacements.update(key_map)
+        post_replacements.update(agg_map)
+
+        def rewrite_post(e_ast: t.Node) -> RowExpr:
+            return self._rewrite(
+                e_ast, Scope([]), replacements=post_replacements
+            )
+
+        node: P.PlanNode = agg_node
+        if spec.having is not None:
+            pred = _fold(rewrite_post(spec.having))
+            node = P.Filter(node, pred)
+
+        out_syms: list[P.Symbol] = []
+        assignments = []
+        names = []
+        for e_ast, alias in select_entries:
+            ex = _fold(rewrite_post(e_ast))
+            name = (alias or "_col").lower()
+            sym = P.Symbol(P.fresh_name(name), ex.type)
+            assignments.append((sym, ex))
+            out_syms.append(sym)
+            names.append(alias.lower() if alias else f"_col{len(names)}")
+        sort_items = []
+        extra_syms: list[P.Symbol] = []
+        if order_by:
+            select_scope = Scope([Field(n, None, s) for n, s in zip(names, out_syms)])
+            for si in order_by:
+                sym = self._resolve_sort_symbol(
+                    si, select_scope, None, select_entries, out_syms
+                )
+                if sym is None:
+                    ex = _fold(rewrite_post(si.expression))
+                    sym = P.Symbol(P.fresh_name("sortkey"), ex.type)
+                    assignments.append((sym, ex))
+                    extra_syms.append(sym)
+                sort_items.append(self._ordering(sym, si))
+        node = P.Project(node, assignments)
+        if spec.distinct:
+            node = P.Distinct(node)
+        if sort_items:
+            if limit is not None and offset == 0:
+                node = P.TopN(node, limit, sort_items)
+                limit = None
+            else:
+                node = P.Sort(node, sort_items)
+        if extra_syms:
+            node = P.Project(node, [(s, variable(s.name, s.type)) for s in out_syms])
+        if limit is not None or offset:
+            node = P.Limit(node, limit, offset)
+        fields = [Field(n, None, s) for n, s in zip(names, out_syms)]
+        return RelationPlan(node, Scope(fields)), names
+
+    # ==== relations =====================================================
+    def _plan_relation(self, rel: t.Node) -> RelationPlan:
+        if isinstance(rel, t.Table):
+            return self._plan_table(rel)
+        if isinstance(rel, t.AliasedRelation):
+            inner = self._plan_relation(rel.relation)
+            alias = rel.alias.lower()
+            fields = []
+            for i, f in enumerate(inner.scope.fields):
+                name = (
+                    rel.column_aliases[i].lower()
+                    if i < len(rel.column_aliases)
+                    else f.name
+                )
+                fields.append(Field(name, alias, f.symbol))
+            return RelationPlan(inner.node, Scope(fields))
+        if isinstance(rel, t.SubqueryRelation):
+            rp, names = self.plan_query(rel.query)
+            fields = [
+                Field(n.lower(), None, s)
+                for n, s in zip(names, rp.node.output_symbols)
+            ]
+            return RelationPlan(rp.node, Scope(fields))
+        if isinstance(rel, t.Join):
+            return self._plan_join(rel)
+        raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_table(self, rel: t.Table) -> RelationPlan:
+        parts = tuple(p.lower() for p in rel.name)
+        if len(parts) == 1 and parts[0] in self.ctes:
+            cte = self.ctes[parts[0]]
+            return RelationPlan(cte.node, cte.scope)
+        if len(parts) == 3:
+            catalog, schema, table = parts
+        elif len(parts) == 2:
+            catalog = self.session.catalog
+            schema, table = parts
+        elif len(parts) == 1:
+            catalog = self.session.catalog
+            schema = self.session.schema
+            table = parts[0]
+        else:
+            raise SemanticError(f"invalid table name: {'.'.join(parts)}")
+        if catalog is None or schema is None:
+            raise SemanticError("no default catalog/schema set")
+        connector = self.catalogs.get(catalog)
+        ts = connector.get_table(schema, table)
+        if ts is None:
+            raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
+        symbols = [
+            P.Symbol(P.fresh_name(c.name), c.type) for c in ts.columns
+        ]
+        node = P.TableScan(catalog, schema, table, symbols, ts.column_names())
+        fields = [
+            Field(c.name.lower(), table, s) for c, s in zip(ts.columns, symbols)
+        ]
+        return RelationPlan(node, Scope(fields))
+
+    def _plan_join(self, rel: t.Join) -> RelationPlan:
+        left = self._plan_relation(rel.left)
+        right = self._plan_relation(rel.right)
+        combined_scope = Scope(left.scope.fields + right.scope.fields)
+        if rel.join_type == "CROSS":
+            node = P.Join("CROSS", left.node, right.node, [])
+            return RelationPlan(node, combined_scope)
+        criteria: list[tuple[P.Symbol, P.Symbol]] = []
+        residual: list[RowExpr] = []
+        if rel.using:
+            for col in rel.using:
+                ls = left.scope.resolve((col,))
+                rs = right.scope.resolve((col,))
+                criteria.append((ls, rs))
+        elif rel.criteria is not None:
+            conjuncts = _split_conjuncts(rel.criteria)
+            left_names = {f.symbol.name for f in left.scope.fields}
+            right_names = {f.symbol.name for f in right.scope.fields}
+            for c in conjuncts:
+                eq = self._as_equi_criterion(c, combined_scope, left_names, right_names)
+                if eq is not None:
+                    criteria.append(eq)
+                else:
+                    residual.append(_fold(self._rewrite(c, combined_scope)))
+        filt = None
+        if residual:
+            filt = residual[0]
+            for r in residual[1:]:
+                filt = special("and", T.BOOLEAN, filt, r)
+        node = P.Join(rel.join_type, left.node, right.node, criteria, filter=filt)
+        return RelationPlan(node, combined_scope)
+
+    def _as_equi_criterion(self, c, scope, left_names, right_names):
+        if not (isinstance(c, t.BinaryOp) and c.op == "="):
+            return None
+        a = self._try_symbol(c.left, scope)
+        b = self._try_symbol(c.right, scope)
+        if a is None or b is None:
+            return None
+        if a.name in left_names and b.name in right_names:
+            return (a, b)
+        if b.name in left_names and a.name in right_names:
+            return (b, a)
+        return None
+
+    def _try_symbol(self, e: t.Node, scope: Scope) -> Optional[P.Symbol]:
+        if isinstance(e, t.Identifier):
+            sym = scope.try_resolve(e.parts)
+            return sym
+        return None
+
+    # ==== subqueries in expressions =====================================
+    def _rewrite_with_subqueries(self, e: t.Node, rp: RelationPlan):
+        """Rewrite an expression, planning any subqueries into the relation:
+        - uncorrelated scalar subquery -> CROSS join of single-row subplan
+        - [NOT] IN (subquery) / EXISTS -> SEMI/ANTI join with mark symbol
+        Returns (RowExpr, updated RelationPlan)."""
+        state = {"rp": rp}
+
+        def handle(node: t.Node) -> Optional[RowExpr]:
+            if isinstance(node, t.ScalarSubquery):
+                sub_rp, _ = self.plan_query(node.query)
+                syms = sub_rp.node.output_symbols
+                if len(syms) != 1:
+                    raise SemanticError("scalar subquery must return one column")
+                cur = state["rp"]
+                join = P.Join("CROSS", cur.node, sub_rp.node, [])
+                state["rp"] = RelationPlan(join, cur.scope)
+                return variable(syms[0].name, syms[0].type)
+            if isinstance(node, (t.InSubquery, t.Exists)):
+                cur = state["rp"]
+                if isinstance(node, t.InSubquery):
+                    sub_rp, _ = self.plan_query(node.query)
+                    syms = sub_rp.node.output_symbols
+                    if len(syms) != 1:
+                        raise SemanticError("IN subquery must return one column")
+                    value = self._rewrite(node.value, cur.scope)
+                    if not isinstance(value, Variable):
+                        vsym = P.Symbol(P.fresh_name("inval"), value.type)
+                        proj = P.Project(
+                            cur.node,
+                            [
+                                (s, variable(s.name, s.type))
+                                for s in cur.node.output_symbols
+                            ]
+                            + [(vsym, value)],
+                        )
+                        cur = RelationPlan(proj, cur.scope)
+                        value = variable(vsym.name, vsym.type)
+                    mark = P.Symbol(P.fresh_name("in_mark"), T.BOOLEAN)
+                    jt = "ANTI" if node.negated else "SEMI"
+                    join = P.Join(
+                        jt,
+                        cur.node,
+                        sub_rp.node,
+                        [(P.Symbol(value.name, value.type), syms[0])],
+                        mark_symbol=mark,
+                    )
+                    state["rp"] = RelationPlan(join, cur.scope)
+                    return variable(mark.name, T.BOOLEAN)
+                # EXISTS: uncorrelated only in v1
+                sub_rp, _ = self.plan_query(node.query)
+                mark = P.Symbol(P.fresh_name("exists_mark"), T.BOOLEAN)
+                join = P.Join(
+                    "SEMI" if not node.negated else "ANTI",
+                    cur.node,
+                    sub_rp.node,
+                    [],
+                    mark_symbol=mark,
+                )
+                state["rp"] = RelationPlan(join, cur.scope)
+                return variable(mark.name, T.BOOLEAN)
+            return None
+
+        ex = self._rewrite(e, rp.scope, subquery_handler=handle, scope_getter=lambda: state["rp"].scope)
+        return ex, state["rp"]
+
+    # ==== expression rewriting ==========================================
+    def _rewrite(
+        self,
+        e: t.Node,
+        scope: Scope,
+        replacements: Optional[dict[t.Node, P.Symbol]] = None,
+        subquery_handler=None,
+        scope_getter=None,
+    ) -> RowExpr:
+        def rw(node: t.Node) -> RowExpr:
+            if replacements is not None and node in replacements:
+                s = replacements[node]
+                return variable(s.name, s.type)
+            if subquery_handler is not None:
+                out = subquery_handler(node)
+                if out is not None:
+                    return out
+            cur_scope = scope_getter() if scope_getter is not None else scope
+            return self._rewrite_node(node, cur_scope, rw)
+
+        return rw(e)
+
+    def _rewrite_node(self, e: t.Node, scope: Scope, rw) -> RowExpr:
+        if isinstance(e, t.Identifier):
+            sym = scope.resolve(e.parts)
+            return variable(sym.name, sym.type)
+        if isinstance(e, t.Literal):
+            return _literal(e)
+        if isinstance(e, t.IntervalLiteral):
+            return Constant(type=T.UNKNOWN, value=e)  # consumed by date arith
+        if isinstance(e, t.UnaryOp):
+            operand = rw(e.operand)
+            if e.op == "NOT":
+                return special("not", T.BOOLEAN, operand)
+            if e.op == "-":
+                return call("negate", operand.type, operand)
+            return operand
+        if isinstance(e, t.BinaryOp):
+            return self._binary(e, rw)
+        if isinstance(e, t.IsNull):
+            inner = special("is_null", T.BOOLEAN, rw(e.operand))
+            return special("not", T.BOOLEAN, inner) if e.negated else inner
+        if isinstance(e, t.Between):
+            v, lo, hi = rw(e.value), rw(e.low), rw(e.high)
+            v, lo = _coerce_pair(v, lo)
+            v, hi = _coerce_pair(v, hi)
+            out = special("between", T.BOOLEAN, v, lo, hi)
+            return special("not", T.BOOLEAN, out) if e.negated else out
+        if isinstance(e, t.InList):
+            v = rw(e.value)
+            items = []
+            for item in e.items:
+                iv = rw(item)
+                _, iv = _coerce_pair(v, iv)
+                items.append(iv)
+            out = special("in", T.BOOLEAN, v, *items)
+            return special("not", T.BOOLEAN, out) if e.negated else out
+        if isinstance(e, t.Like):
+            v = rw(e.value)
+            p = rw(e.pattern)
+            if not isinstance(p, Constant):
+                raise SemanticError("LIKE pattern must be constant")
+            out = call("like", T.BOOLEAN, v, p)
+            return special("not", T.BOOLEAN, out) if e.negated else out
+        if isinstance(e, t.Cast):
+            operand = rw(e.operand)
+            target = T.parse_type(e.target)
+            if isinstance(operand, Constant) and operand.type == T.UNKNOWN:
+                return Constant(type=target, value=None)
+            if isinstance(operand, Constant) and T.is_string(operand.type):
+                return _cast_string_constant(operand, target)
+            return call("cast", target, operand)
+        if isinstance(e, t.Extract):
+            operand = rw(e.operand)
+            if e.field not in ("year", "month", "day"):
+                raise SemanticError(f"EXTRACT({e.field}) unsupported")
+            return call(e.field, T.BIGINT, operand)
+        if isinstance(e, t.Case):
+            return self._case(e, rw)
+        if isinstance(e, t.FunctionCall):
+            return self._function(e, rw)
+        if isinstance(e, t.ScalarSubquery):
+            raise SemanticError("scalar subquery not allowed in this context")
+        if isinstance(e, (t.InSubquery, t.Exists)):
+            raise SemanticError("subquery predicate not allowed in this context")
+        raise SemanticError(f"unsupported expression: {type(e).__name__}")
+
+    def _case(self, e: t.Case, rw) -> RowExpr:
+        whens = []
+        result_type: Optional[T.SqlType] = None
+        results = []
+        for cond_ast, res_ast in e.whens:
+            res = rw(res_ast)
+            results.append(res)
+            result_type = (
+                res.type
+                if result_type is None
+                else (T.common_super_type(result_type, res.type) or result_type)
+            )
+        default = rw(e.default) if e.default is not None else None
+        if default is not None:
+            result_type = T.common_super_type(result_type, default.type) or result_type
+        if e.operand is not None:
+            op = rw(e.operand)
+            conds = [
+                _make_comparison("eq", op, rw(c_ast)) for c_ast, _ in e.whens
+            ]
+        else:
+            conds = [rw(c_ast) for c_ast, _ in e.whens]
+        out = (
+            _coerce_to(default, result_type)
+            if default is not None
+            else Constant(type=result_type, value=None)
+        )
+        for cond, res in reversed(list(zip(conds, results))):
+            out = special("if", result_type, cond, _coerce_to(res, result_type), out)
+        return out
+
+    def _function(self, e: t.FunctionCall, rw) -> RowExpr:
+        if e.window is not None:
+            raise SemanticError("window functions not yet supported in this context")
+        name = e.name
+        if name in ("sum", "count", "avg", "min", "max"):
+            raise SemanticError(f"aggregate {name} not allowed here")
+        args = [rw(a) for a in e.args]
+        if name == "coalesce":
+            rt = args[0].type
+            for a in args[1:]:
+                rt = T.common_super_type(rt, a.type) or rt
+            return special(
+                "coalesce", rt, *[_coerce_to(a, rt) for a in args]
+            )
+        if name == "nullif":
+            a, b = _coerce_pair(args[0], args[1])
+            return special("null_if", a.type, a, b)
+        if name == "abs":
+            return call("abs", args[0].type, args[0])
+        if name == "sqrt":
+            return call("sqrt", T.DOUBLE, _coerce_to(args[0], T.DOUBLE))
+        if name in ("floor", "ceil", "ceiling"):
+            n = "ceil" if name == "ceiling" else name
+            return call(n, args[0].type, args[0])
+        if name == "round":
+            return call("round", args[0].type, *args)
+        if name in ("year", "month", "day"):
+            return call(name, T.BIGINT, args[0])
+        if name == "mod":
+            a, b = _coerce_pair(args[0], args[1])
+            return call("modulus", a.type, a, b)
+        if name == "power" or name == "pow":
+            return call(
+                "power",
+                T.DOUBLE,
+                _coerce_to(args[0], T.DOUBLE),
+                _coerce_to(args[1], T.DOUBLE),
+            )
+        if name == "length":
+            return call("length", T.BIGINT, args[0])
+        if name == "substr":
+            return call("substr", T.VARCHAR, *args)
+        if name == "date":
+            return call("cast", T.DATE, args[0])
+        raise SemanticError(f"unknown function: {name}")
+
+    def _binary(self, e: t.BinaryOp, rw) -> RowExpr:
+        op = e.op
+        if op in ("AND", "OR"):
+            return special(op.lower(), T.BOOLEAN, rw(e.left), rw(e.right))
+        left = rw(e.left)
+        right = rw(e.right)
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            name = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}[op]
+            return _make_comparison(name, left, right)
+        if op == "||":
+            raise SemanticError("string concatenation not yet supported")
+        # arithmetic, with date/interval special cases
+        iv = None
+        other = None
+        if isinstance(left, Constant) and isinstance(left.value, t.IntervalLiteral):
+            iv, other = left.value, right
+        elif isinstance(right, Constant) and isinstance(right.value, t.IntervalLiteral):
+            iv, other = right.value, left
+        if iv is not None:
+            sign = 1 if op == "+" else -1
+            if isinstance(other.type, (T.DateType, T.TimestampType)):
+                return _date_interval(other, iv, sign)
+            raise SemanticError("interval arithmetic requires a date/timestamp")
+        name = {"+": "add", "-": "subtract", "*": "multiply", "/": "divide", "%": "modulus"}[op]
+        rt = _arith_type(name, left.type, right.type)
+        return call(name, rt, left, right)
+
+
+# ==== helpers ==========================================================
+
+
+def _literal(e: t.Literal) -> Constant:
+    if e.kind == "null":
+        return Constant(type=T.UNKNOWN, value=None)
+    if e.kind == "boolean":
+        return const(bool(e.value), T.BOOLEAN)
+    if e.kind == "integer":
+        return const(int(e.value), T.BIGINT)
+    if e.kind == "decimal":
+        text = str(e.value)
+        neg = text.startswith("-")
+        digits = text.lstrip("-+")
+        if "." in digits:
+            whole, frac = digits.split(".")
+        else:
+            whole, frac = digits, ""
+        scale = len(frac)
+        precision = max(1, len(whole.lstrip("0")) + scale)
+        unscaled = int((whole + frac) or "0") * (-1 if neg else 1)
+        return const(unscaled, T.decimal(min(precision, 18), scale))
+    if e.kind == "double":
+        return const(float(e.value), T.DOUBLE)
+    if e.kind == "string":
+        return const(str(e.value), T.VARCHAR)
+    if e.kind == "date":
+        y, m, d = (int(x) for x in str(e.value).split("-"))
+        return const(days_from_civil(y, m, d), T.DATE)
+    if e.kind == "timestamp":
+        import datetime
+
+        s = str(e.value)
+        dt = datetime.datetime.fromisoformat(s)
+        epoch = datetime.datetime(1970, 1, 1)
+        return const(int((dt - epoch).total_seconds() * 1_000_000), T.TIMESTAMP)
+    raise SemanticError(f"unknown literal kind {e.kind}")
+
+
+def _cast_string_constant(c: Constant, target: T.SqlType) -> Constant:
+    s = str(c.value)
+    if isinstance(target, T.DateType):
+        y, m, d = (int(x) for x in s.split("-"))
+        return const(days_from_civil(y, m, d), T.DATE)
+    if isinstance(target, T.DecimalType):
+        from decimal import Decimal
+
+        return const(
+            int(Decimal(s).scaleb(target.scale).to_integral_value()), target
+        )
+    if T.is_integer(target):
+        return const(int(s), target)
+    if isinstance(target, (T.DoubleType, T.RealType)):
+        return const(float(s), target)
+    if T.is_string(target):
+        return const(s, target)
+    raise SemanticError(f"cannot cast string literal to {target}")
+
+
+def _arith_type(name: str, a: T.SqlType, b: T.SqlType) -> T.SqlType:
+    if isinstance(a, (T.DoubleType,)) or isinstance(b, (T.DoubleType,)):
+        return T.DOUBLE
+    if isinstance(a, T.RealType) or isinstance(b, T.RealType):
+        if isinstance(a, T.DecimalType) or isinstance(b, T.DecimalType):
+            return T.DOUBLE
+        return T.REAL
+    da = a if isinstance(a, T.DecimalType) else None
+    db = b if isinstance(b, T.DecimalType) else None
+    if da or db:
+        if da is None:
+            da = T.decimal(18, 0)
+        if db is None:
+            db = T.decimal(18, 0)
+        if name in ("add", "subtract"):
+            s = max(da.scale, db.scale)
+            return T.decimal(18, s)
+        if name == "multiply":
+            s = da.scale + db.scale
+            if s > 18:
+                raise SemanticError("decimal multiply scale overflow (>18)")
+            return T.decimal(18, s)
+        if name in ("divide", "modulus"):
+            return T.decimal(18, max(da.scale, db.scale))
+    if T.is_integer(a) and T.is_integer(b):
+        return T.common_super_type(a, b) or T.BIGINT
+    if isinstance(a, T.DateType) and isinstance(b, T.DateType) and name == "subtract":
+        return T.BIGINT  # date difference in days
+    raise SemanticError(f"cannot apply {name} to {a}, {b}")
+
+
+def _coerce_to(e: RowExpr, target: T.SqlType) -> RowExpr:
+    if e.type == target:
+        return e
+    if isinstance(e, Constant) and e.type == T.UNKNOWN:
+        return Constant(type=target, value=None)
+    if isinstance(e, Constant) and T.is_string(e.type) and isinstance(target, T.DateType):
+        return _cast_string_constant(e, target)
+    if T.is_string(e.type) and T.is_string(target):
+        return e  # varchar length variants share representation
+    return call("cast", target, e)
+
+
+def _coerce_pair(a: RowExpr, b: RowExpr) -> tuple[RowExpr, RowExpr]:
+    if a.type == b.type:
+        return a, b
+    # date vs string literal: parse the literal
+    if isinstance(a.type, T.DateType) and isinstance(b, Constant) and T.is_string(b.type):
+        return a, _cast_string_constant(b, T.DATE)
+    if isinstance(b.type, T.DateType) and isinstance(a, Constant) and T.is_string(a.type):
+        return _cast_string_constant(a, T.DATE), b
+    ct = T.common_super_type(a.type, b.type)
+    if ct is None:
+        raise SemanticError(f"cannot compare {a.type} and {b.type}")
+    # decimals: comparisons rescale inside the kernel; avoid materializing casts
+    if isinstance(ct, T.DecimalType):
+        return a, b
+    return _coerce_to(a, ct), _coerce_to(b, ct)
+
+
+def _make_comparison(name: str, left: RowExpr, right: RowExpr) -> RowExpr:
+    left, right = _coerce_pair(left, right)
+    return call(name, T.BOOLEAN, left, right)
+
+
+def _date_interval(operand: RowExpr, iv: t.IntervalLiteral, sign: int) -> RowExpr:
+    amount = iv.value * iv.sign * sign
+    if iv.unit == "day":
+        delta = const(amount, T.BIGINT)
+        return call("date_add_days", operand.type, operand, delta)
+    if iv.unit in ("month", "year"):
+        months = amount * (12 if iv.unit == "year" else 1)
+        return call("date_add_months", operand.type, operand, const(months, T.BIGINT))
+    raise SemanticError(f"interval unit {iv.unit} unsupported for dates")
+
+
+def _split_conjuncts(e: t.Node) -> list[t.Node]:
+    if isinstance(e, t.BinaryOp) and e.op == "AND":
+        return _split_conjuncts(e.left) + _split_conjuncts(e.right)
+    return [e]
+
+
+def _contains_aggregate(e: t.Node) -> bool:
+    found = []
+    _collect_aggregates(e, found)
+    return bool(found)
+
+
+def _collect_aggregates(e: t.Node, out: list) -> None:
+    if isinstance(e, t.FunctionCall):
+        if e.name in ("sum", "count", "avg", "min", "max") and e.window is None:
+            out.append(e)
+            return
+    for f in dataclasses.fields(e) if dataclasses.is_dataclass(e) else []:
+        v = getattr(e, f.name)
+        if isinstance(v, t.Node):
+            _collect_aggregates(v, out)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, t.Node):
+                    _collect_aggregates(item, out)
+                elif isinstance(item, tuple):
+                    for sub in item:
+                        if isinstance(sub, t.Node):
+                            _collect_aggregates(sub, out)
+
+
+def _coerce_constant_value(c: Constant, target: T.SqlType):
+    if c.value is None:
+        return None
+    if isinstance(target, T.DecimalType) and isinstance(c.type, T.DecimalType):
+        return c.value * 10 ** (target.scale - c.type.scale)
+    if isinstance(target, T.DecimalType) and T.is_integer(c.type):
+        return c.value * 10**target.scale
+    if isinstance(target, (T.DoubleType, T.RealType)) and not isinstance(
+        c.type, (T.DoubleType, T.RealType)
+    ):
+        if isinstance(c.type, T.DecimalType):
+            return float(c.value) / c.type.unscale
+        return float(c.value)
+    return c.value
+
+
+# ==== constant folding ==================================================
+
+
+def _fold(e: RowExpr) -> RowExpr:
+    """Host-side constant folding for date arithmetic and simple numeric ops
+    (pushdown-friendly: `date '1994-01-01' + interval '1' year` becomes a
+    plain date Constant)."""
+    from trino_tpu.ir import transform
+
+    def fn(node: RowExpr) -> RowExpr:
+        if isinstance(node, Call) and all(
+            isinstance(a, Constant) for a in node.args
+        ):
+            return _fold_call(node)
+        return node
+
+    return transform(e, fn)
+
+
+def _fold_call(node: Call) -> RowExpr:
+    args = node.args
+    vals = [a.value for a in args]
+    if any(v is None for v in vals) and node.name != "cast":
+        return Constant(type=node.type, value=None)
+    try:
+        if node.name == "date_add_days":
+            return const(int(vals[0]) + int(vals[1]), node.type)
+        if node.name == "date_add_months":
+            from trino_tpu.compiler import _civil_from_days
+            import numpy as np
+
+            y, m, d = _civil_from_days(np.asarray([int(vals[0])], dtype=np.int64))
+            y, m, d = int(y[0]), int(m[0]), int(d[0])
+            months_total = (y * 12 + (m - 1)) + int(vals[1])
+            y2, m2 = divmod(months_total, 12)
+            d2 = min(d, _days_in_month(y2, m2 + 1))
+            return const(days_from_civil(y2, m2 + 1, d2), node.type)
+        if node.name in ("add", "subtract", "multiply") and not isinstance(
+            node.type, T.DecimalType
+        ):
+            if T.is_integer(node.type):
+                a, b = int(vals[0]), int(vals[1])
+                r = {"add": a + b, "subtract": a - b, "multiply": a * b}[node.name]
+                return const(r, node.type)
+            if isinstance(node.type, T.DoubleType):
+                fa = _as_float(args[0])
+                fb = _as_float(args[1])
+                r = {"add": fa + fb, "subtract": fa - fb, "multiply": fa * fb}[node.name]
+                return const(r, node.type)
+        if node.name in ("add", "subtract", "multiply") and isinstance(
+            node.type, T.DecimalType
+        ):
+            sa = args[0].type.scale if isinstance(args[0].type, T.DecimalType) else 0
+            sb = args[1].type.scale if isinstance(args[1].type, T.DecimalType) else 0
+            rs = node.type.scale
+            a, b = int(vals[0]), int(vals[1])
+            if node.name == "multiply":
+                raw = a * b  # scale sa+sb
+                return const(_rescale_int(raw, sa + sb, rs), node.type)
+            av = _rescale_int(a, sa, rs)
+            bv = _rescale_int(b, sb, rs)
+            return const(av + bv if node.name == "add" else av - bv, node.type)
+        if node.name == "negate":
+            return const(-vals[0], node.type)
+    except Exception:
+        return node
+    return node
+
+
+def _as_float(c: Constant) -> float:
+    if isinstance(c.type, T.DecimalType):
+        return float(c.value) / c.type.unscale
+    return float(c.value)
+
+
+def _rescale_int(v: int, from_s: int, to_s: int) -> int:
+    if to_s >= from_s:
+        return v * 10 ** (to_s - from_s)
+    f = 10 ** (from_s - to_s)
+    half = f // 2
+    return (v + half) // f if v >= 0 else -((-v + half) // f)
+
+
+def _days_in_month(y: int, m: int) -> int:
+    import calendar
+
+    return calendar.monthrange(y, m)[1]

@@ -76,9 +76,15 @@ class ExprCompiler:
     constants, so host-evaluated string predicates become baked-in gathers.
     """
 
-    def __init__(self, columns: Sequence[Column]):
+    def __init__(
+        self, columns: Sequence[Column], string_dictionary: Dictionary | None = None
+    ):
         self.columns = list(columns)
         self.n = self.columns[0].capacity if self.columns else 1
+        # unified dictionary context: when set, string constants encode
+        # against it (the executor remaps referenced string columns into it
+        # first — see exec.local._unify_strings)
+        self.string_dictionary = string_dictionary
 
     # -- entry points -----------------------------------------------------
     def evaluate(self, expr: RowExpr) -> Pair:
@@ -96,13 +102,14 @@ class ExprCompiler:
             return c.data, c.valid_mask()
         if isinstance(expr, Constant):
             if T.is_string(expr.type) and expr.value is not None:
-                # String literals are only evaluable inside comparisons/LIKE,
-                # where the column's dictionary gives them a code (see
-                # _string_compare). Bare string projection needs dictionary
-                # propagation through evaluation — future work.
-                raise NotImplementedError(
-                    "string literal outside a comparison context"
-                )
+                if self.string_dictionary is None:
+                    # String literals are evaluable inside comparisons/LIKE
+                    # (column dictionary context) or under a unified
+                    # dictionary (string-valued projections).
+                    raise NotImplementedError(
+                        "string literal outside a comparison context"
+                    )
+                return _storage_constant(expr, self.string_dictionary, self.n)
             return _storage_constant(expr, None, self.n)
         if isinstance(expr, SpecialForm):
             return self._special(expr)
@@ -187,6 +194,24 @@ class ExprCompiler:
             return self._cast(expr)
         if name in ("year", "month", "day"):
             return self._extract(expr)
+        if name == "date_add_days":
+            d, v = self._eval(expr.args[0])
+            delta, dv = self._eval(expr.args[1])
+            return (d + delta.astype(d.dtype)), v & dv
+        if name == "date_add_months":
+            d, v = self._eval(expr.args[0])
+            months, mv = self._eval(expr.args[1])
+            y, m, dd = _civil_from_days(d.astype(jnp.int32))
+            total = y * 12 + (m - 1) + months.astype(jnp.int32)
+            y2 = total // 12
+            m2 = total % 12 + 1
+            dd2 = jnp.minimum(dd, _days_in_month_vec(y2, m2))
+            out = _days_from_civil_vec(y2, m2, dd2)
+            return out.astype(d.dtype), v & mv
+        if name == "power":
+            a, av = self._eval(expr.args[0])
+            b, bv = self._eval(expr.args[1])
+            return jnp.power(a, b), av & bv
         if name == "like":
             return self._like(expr)
         if name == "substr_pred":  # reserved for host-eval string predicates
@@ -468,6 +493,23 @@ def _civil_from_days(days: jnp.ndarray):
     m = mp + jnp.where(mp < 10, 3, -9)
     y = y + (m <= 2)
     return y, m, d
+
+
+def _days_in_month_vec(y: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    lengths = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31], dtype=jnp.int32)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    base = lengths[m - 1]
+    return jnp.where((m == 2) & leap, 29, base)
+
+
+def _days_from_civil_vec(y: jnp.ndarray, m: jnp.ndarray, d: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized inverse of _civil_from_days (Hinnant)."""
+    y = y - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    doy = (153 * (m + jnp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
 
 
 def days_from_civil(y: int, m: int, d: int) -> int:

@@ -1,0 +1,16 @@
+"""Connector SPI and built-in connectors.
+
+Reference: ``core/trino-spi/src/main/java/io/trino/spi/connector/`` —
+``Connector.java:28``, ``ConnectorMetadata``, ``ConnectorSplitManager.java:23``,
+``ConnectorPageSource.java:47``. Built-ins mirror ``plugin/trino-tpch``
+(on-the-fly deterministic datagen), ``plugin/trino-memory``
+(``MemoryPagesStore.java:41``), ``plugin/trino-blackhole``.
+"""
+
+from trino_tpu.connectors.api import (  # noqa: F401
+    CatalogManager,
+    ColumnSchema,
+    Connector,
+    Split,
+    TableSchema,
+)

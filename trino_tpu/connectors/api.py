@@ -1,0 +1,105 @@
+"""Connector SPI.
+
+Reference interfaces: ``spi/connector/Connector.java:28-90`` (metadata,
+split manager, page source provider), ``spi/connector/ConnectorSplitManager.java:23``,
+``spi/connector/ConnectorPageSource.java:47``.
+
+TPU-first simplification: a connector reads a (table, split, columns)
+triple into one host :class:`Batch`; the executor moves it to device and
+pads. Splits are the unit of scan parallelism (reference §2.6 item 3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    type: T.SqlType
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSchema:
+    name: str
+    columns: tuple[ColumnSchema, ...]
+
+    def column_names(self) -> list[str]:
+        return [c.name for c in self.columns]
+
+    def column(self, name: str) -> ColumnSchema | None:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """Opaque unit of scan work (reference: ``spi/connector/ConnectorSplit``)."""
+
+    table: str
+    index: int
+    total: int
+    info: Any = None
+
+
+class Connector:
+    name: str = "connector"
+
+    # --- metadata --------------------------------------------------------
+    def list_schemas(self) -> list[str]:
+        raise NotImplementedError
+
+    def list_tables(self, schema: str) -> list[str]:
+        raise NotImplementedError
+
+    def get_table(self, schema: str, table: str) -> Optional[TableSchema]:
+        raise NotImplementedError
+
+    # --- splits + data ---------------------------------------------------
+    def get_splits(self, schema: str, table: str, target_splits: int) -> list[Split]:
+        return [Split(table, 0, 1)]
+
+    def read_split(
+        self, schema: str, table: str, columns: Sequence[str], split: Split
+    ) -> Batch:
+        raise NotImplementedError
+
+    # --- optional stats (drives join distribution / sizing) -------------
+    def estimate_rows(self, schema: str, table: str) -> Optional[int]:
+        return None
+
+    # --- optional write path --------------------------------------------
+    def create_table(self, schema: str, table: str, schema_def: TableSchema) -> None:
+        raise NotImplementedError(f"{self.name}: CREATE TABLE not supported")
+
+    def insert(self, schema: str, table: str, batch: Batch) -> int:
+        raise NotImplementedError(f"{self.name}: INSERT not supported")
+
+    def drop_table(self, schema: str, table: str) -> None:
+        raise NotImplementedError(f"{self.name}: DROP TABLE not supported")
+
+
+class CatalogManager:
+    """Catalog name -> connector instance (reference:
+    ``metadata/MetadataManager.java:184`` catalog routing)."""
+
+    def __init__(self):
+        self._catalogs: dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._catalogs[name] = connector
+
+    def get(self, name: str) -> Connector:
+        if name not in self._catalogs:
+            raise KeyError(f"catalog not found: {name}")
+        return self._catalogs[name]
+
+    def names(self) -> list[str]:
+        return sorted(self._catalogs)

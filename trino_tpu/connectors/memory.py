@@ -1,0 +1,73 @@
+"""In-memory connector (reference: ``plugin/trino-memory``,
+``MemoryPagesStore.java:41``): CREATE TABLE AS / INSERT / scan."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from trino_tpu.columnar import Batch, concat_batches
+from trino_tpu.connectors.api import Connector, Split, TableSchema
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self):
+        self._tables: dict[tuple[str, str], TableSchema] = {}
+        self._data: dict[tuple[str, str], list[Batch]] = {}
+
+    def list_schemas(self):
+        return sorted({s for s, _ in self._tables} | {"default"})
+
+    def list_tables(self, schema):
+        return sorted(t for s, t in self._tables if s == schema)
+
+    def get_table(self, schema, table):
+        return self._tables.get((schema, table))
+
+    def create_table(self, schema, table, schema_def):
+        if (schema, table) in self._tables:
+            raise ValueError(f"table already exists: {schema}.{table}")
+        self._tables[(schema, table)] = schema_def
+        self._data[(schema, table)] = []
+
+    def insert(self, schema, table, batch):
+        if (schema, table) not in self._tables:
+            raise KeyError(f"table not found: {schema}.{table}")
+        compacted = batch.compact()
+        self._data[(schema, table)].append(compacted)
+        return compacted.num_rows
+
+    def drop_table(self, schema, table):
+        self._tables.pop((schema, table), None)
+        self._data.pop((schema, table), None)
+
+    def estimate_rows(self, schema, table):
+        parts = self._data.get((schema, table))
+        if parts is None:
+            return None
+        return sum(b.num_rows for b in parts)
+
+    def get_splits(self, schema, table, target_splits):
+        parts = self._data.get((schema, table), [])
+        n = max(1, len(parts))
+        return [Split(table, i, n) for i in range(n)]
+
+    def read_split(self, schema, table, columns: Sequence[str], split):
+        ts = self._tables[(schema, table)]
+        parts = self._data[(schema, table)]
+        name_to_idx = {c.name: i for i, c in enumerate(ts.columns)}
+        if not parts:
+            import numpy as np
+
+            from trino_tpu.columnar import Column
+
+            cols = [
+                Column(ts.columns[name_to_idx[c]].type,
+                       np.zeros(0, dtype=ts.columns[name_to_idx[c]].type.storage_dtype))
+                for c in columns
+            ]
+            return Batch(cols, 0)
+        b = parts[split.index]
+        cols = [b.columns[name_to_idx[c]] for c in columns]
+        return Batch(cols, b.num_rows, b.sel)

@@ -1,0 +1,8 @@
+"""Execution: physical planning and operator evaluation.
+
+Reference: ``core/trino-main/src/main/java/io/trino/operator/`` (~60 physical
+operators, ``Driver.java:270`` hot loop) and
+``sql/planner/LocalExecutionPlanner.java:392``. TPU-first: operators are
+whole-column device computations; the "driver loop" is the host walking the
+plan tree invoking jit-compiled kernels.
+"""

@@ -48,6 +48,17 @@ class Constant(RowExpr):
 
 
 @dataclasses.dataclass(frozen=True)
+class Variable(RowExpr):
+    """Named symbol reference (resolved to a channel by the physical
+    planner). Mirrors ``VariableReferenceExpression.java:22``."""
+
+    name: str = ""
+
+    def __repr__(self):
+        return f"${self.name}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
 class Call(RowExpr):
     """Resolved scalar function call. ``name`` indexes the function catalog
     (:mod:`trino_tpu.functions`)."""
@@ -73,6 +84,10 @@ class SpecialForm(RowExpr):
 
 def input_ref(channel: int, type_: T.SqlType) -> InputRef:
     return InputRef(type=type_, channel=channel)
+
+
+def variable(name: str, type_: T.SqlType) -> Variable:
+    return Variable(type=type_, name=name)
 
 
 def const(value: Any, type_: T.SqlType) -> Constant:
@@ -101,16 +116,52 @@ def referenced_channels(expr: RowExpr) -> set[int]:
     return out
 
 
+def referenced_variables(expr: RowExpr) -> set[str]:
+    out: set[str] = set()
+
+    def walk(e: RowExpr):
+        if isinstance(e, Variable):
+            out.add(e.name)
+        elif isinstance(e, (Call, SpecialForm)):
+            for a in e.args:
+                walk(a)
+
+    walk(expr)
+    return out
+
+
+def transform(expr: RowExpr, fn) -> RowExpr:
+    """Bottom-up rewrite: fn is applied to every node after its children."""
+
+    def walk(e: RowExpr) -> RowExpr:
+        if isinstance(e, Call):
+            e = Call(type=e.type, name=e.name, args=tuple(walk(a) for a in e.args))
+        elif isinstance(e, SpecialForm):
+            e = SpecialForm(
+                type=e.type, form=e.form, args=tuple(walk(a) for a in e.args)
+            )
+        return fn(e)
+
+    return walk(expr)
+
+
 def remap_channels(expr: RowExpr, mapping: dict[int, int]) -> RowExpr:
     """Rewrite input channels (used when pruning/reordering columns)."""
 
-    def walk(e: RowExpr) -> RowExpr:
+    def fn(e: RowExpr) -> RowExpr:
         if isinstance(e, InputRef):
             return InputRef(type=e.type, channel=mapping[e.channel])
-        if isinstance(e, Call):
-            return Call(type=e.type, name=e.name, args=tuple(walk(a) for a in e.args))
-        if isinstance(e, SpecialForm):
-            return SpecialForm(type=e.type, form=e.form, args=tuple(walk(a) for a in e.args))
         return e
 
-    return walk(expr)
+    return transform(expr, fn)
+
+
+def bind_variables(expr: RowExpr, channels: dict[str, int]) -> RowExpr:
+    """Replace Variables with channel InputRefs (physical planning)."""
+
+    def fn(e: RowExpr) -> RowExpr:
+        if isinstance(e, Variable):
+            return InputRef(type=e.type, channel=channels[e.name])
+        return e
+
+    return transform(expr, fn)

@@ -1,0 +1,354 @@
+"""Logical plan nodes.
+
+Reference: ``core/trino-main/src/main/java/io/trino/sql/planner/plan/``
+(TableScanNode, FilterNode, ProjectNode, AggregationNode, JoinNode,
+SortNode, TopNNode, LimitNode, ExchangeNode, OutputNode, ValuesNode, …).
+
+Every node exposes ``output_symbols`` — a list of :class:`Symbol` (name +
+type). Expressions inside nodes are RowExpr trees over ``Variable``
+references to those symbols; the physical planner binds them to channels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Optional, Sequence
+
+from trino_tpu import types as T
+from trino_tpu.ir import RowExpr
+from trino_tpu.ops.sort import SortKey
+
+_counter = itertools.count()
+
+
+def fresh_name(base: str) -> str:
+    return f"{base}_{next(_counter)}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Symbol:
+    name: str
+    type: T.SqlType
+
+    def __repr__(self):
+        return f"{self.name}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Ordering:
+    symbol: Symbol
+    ascending: bool = True
+    nulls_first: bool = False
+
+    def sort_key(self) -> SortKey:
+        return SortKey(ascending=self.ascending, nulls_first=self.nulls_first)
+
+
+class PlanNode:
+    @property
+    def output_symbols(self) -> list[Symbol]:
+        raise NotImplementedError
+
+    @property
+    def sources(self) -> list["PlanNode"]:
+        return []
+
+
+@dataclasses.dataclass
+class TableScan(PlanNode):
+    """Scan of a connector table.
+
+    ``table`` is a connector-specific handle; ``assignments`` maps each
+    output symbol to the connector column name.
+    Reference: ``plan/TableScanNode.java``.
+    """
+
+    catalog: str
+    schema: str
+    table: str
+    symbols: list[Symbol]
+    column_names: list[str]
+    # predicate pushed into the connector (reference: TupleDomain pushdown)
+    pushed_predicate: Optional[RowExpr] = None
+
+    @property
+    def output_symbols(self):
+        return self.symbols
+
+
+@dataclasses.dataclass
+class Values(PlanNode):
+    symbols: list[Symbol]
+    rows: list[list[Any]]  # storage-representation python values
+
+    @property
+    def output_symbols(self):
+        return self.symbols
+
+
+@dataclasses.dataclass
+class Filter(PlanNode):
+    source: PlanNode
+    predicate: RowExpr
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
+class Project(PlanNode):
+    source: PlanNode
+    assignments: list[tuple[Symbol, RowExpr]]
+
+    @property
+    def output_symbols(self):
+        return [s for s, _ in self.assignments]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass(frozen=True)
+class AggFunction:
+    """One aggregate: kind in ops.aggregation.AGG_KINDS, argument expression
+    (None for count(*)), marks distinct/filter (reference:
+    ``plan/AggregationNode.Aggregation``)."""
+
+    kind: str
+    argument: Optional[RowExpr]
+    result_type: T.SqlType
+    distinct: bool = False
+    filter: Optional[RowExpr] = None
+
+
+@dataclasses.dataclass
+class Aggregate(PlanNode):
+    """Group-by aggregation. step: 'single' | 'partial' | 'final'."""
+
+    source: PlanNode
+    group_keys: list[Symbol]
+    aggregates: list[tuple[Symbol, AggFunction]]
+    step: str = "single"
+
+    @property
+    def output_symbols(self):
+        return self.group_keys + [s for s, _ in self.aggregates]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
+class Join(PlanNode):
+    """Equi-join. criteria is a list of (left_symbol, right_symbol) pairs;
+    ``filter`` is an extra non-equi condition over both sides' symbols.
+    distribution: None (undecided) | 'partitioned' | 'replicated'.
+    Reference: ``plan/JoinNode.java``."""
+
+    join_type: str  # INNER | LEFT | RIGHT | FULL | CROSS | SEMI | ANTI
+    left: PlanNode
+    right: PlanNode
+    criteria: list[tuple[Symbol, Symbol]]
+    filter: Optional[RowExpr] = None
+    distribution: Optional[str] = None
+    # for SEMI/ANTI: the output mark symbol replaces right outputs
+    mark_symbol: Optional[Symbol] = None
+
+    @property
+    def output_symbols(self):
+        if self.join_type in ("SEMI", "ANTI"):
+            return self.left.output_symbols + (
+                [self.mark_symbol] if self.mark_symbol else []
+            )
+        return self.left.output_symbols + self.right.output_symbols
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+
+@dataclasses.dataclass
+class Sort(PlanNode):
+    source: PlanNode
+    order_by: list[Ordering]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
+class TopN(PlanNode):
+    source: PlanNode
+    count: int
+    order_by: list[Ordering]
+    step: str = "single"  # single | partial | final
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
+class Limit(PlanNode):
+    source: PlanNode
+    count: Optional[int]
+    offset: int = 0
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
+class Distinct(PlanNode):
+    source: PlanNode
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
+class SetOp(PlanNode):
+    op: str  # UNION | INTERSECT | EXCEPT
+    distinct: bool
+    inputs: list[PlanNode]
+    symbols: list[Symbol]
+    # per-input mapping: input.output_symbols[i] feeds symbols[i]
+
+    @property
+    def output_symbols(self):
+        return self.symbols
+
+    @property
+    def sources(self):
+        return self.inputs
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFunction:
+    kind: str  # rank, row_number, dense_rank, sum, avg, min, max, count, lead, lag
+    argument: Optional[RowExpr]
+    result_type: T.SqlType
+    offset: int = 1  # for lead/lag
+    default: Optional[RowExpr] = None
+
+
+@dataclasses.dataclass
+class Window(PlanNode):
+    source: PlanNode
+    partition_by: list[Symbol]
+    order_by: list[Ordering]
+    functions: list[tuple[Symbol, WindowFunction]]
+    frame: Optional[tuple[str, str, str]] = None
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols + [s for s, _ in self.functions]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
+class Output(PlanNode):
+    """Root node fixing column names/order for the client.
+    Reference: ``plan/OutputNode.java``."""
+
+    source: PlanNode
+    column_names: list[str]
+    symbols: list[Symbol]
+
+    @property
+    def output_symbols(self):
+        return self.symbols
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
+class Exchange(PlanNode):
+    """Repartitioning boundary (reference: ``plan/ExchangeNode.java``).
+
+    scope: 'remote' (cross-shard collective) | 'local' (within shard —
+    usually elided on TPU, XLA handles intra-chip parallelism).
+    partitioning: 'hash' (keys), 'broadcast', 'single', 'round_robin'.
+    """
+
+    source: PlanNode
+    partitioning: str
+    keys: list[Symbol] = dataclasses.field(default_factory=list)
+    scope: str = "remote"
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+def walk_plan(node: PlanNode):
+    yield node
+    for s in node.sources:
+        yield from walk_plan(s)
+
+
+def plan_text(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style tree rendering (reference: planprinter/PlanPrinter.java)."""
+    pad = "  " * indent
+    name = type(node).__name__
+    detail = ""
+    if isinstance(node, TableScan):
+        detail = f" {node.catalog}.{node.schema}.{node.table}"
+    elif isinstance(node, Filter):
+        detail = f" predicate={node.predicate!r}"
+    elif isinstance(node, Aggregate):
+        detail = f" keys={[s.name for s in node.group_keys]} step={node.step}"
+    elif isinstance(node, Join):
+        detail = (
+            f" {node.join_type}"
+            f" criteria={[(a.name, b.name) for a, b in node.criteria]}"
+            + (f" dist={node.distribution}" if node.distribution else "")
+        )
+    elif isinstance(node, (TopN,)):
+        detail = f" n={node.count}"
+    elif isinstance(node, Limit):
+        detail = f" n={node.count}"
+    elif isinstance(node, Exchange):
+        detail = f" {node.scope}/{node.partitioning} keys={[s.name for s in node.keys]}"
+    elif isinstance(node, Output):
+        detail = f" columns={node.column_names}"
+    lines = [f"{pad}{name}{detail} -> {[s.name for s in node.output_symbols][:8]}"]
+    for s in node.sources:
+        lines.append(plan_text(s, indent + 1))
+    return "\n".join(lines)

@@ -1,0 +1,70 @@
+"""Test harness: LocalQueryRunner analog.
+
+Reference: ``core/trino-main/src/main/java/io/trino/testing/LocalQueryRunner.java:221,631``
+(single-process full stack) and the H2 oracle pattern
+(``testing/trino-testing/.../H2QueryRunner.java``) — our oracle is NumPy
+recomputation over the same generated data.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from trino_tpu.analyzer import Analyzer
+from trino_tpu.columnar import Batch
+from trino_tpu.config import Session
+from trino_tpu.connectors.api import CatalogManager
+from trino_tpu.connectors.blackhole import BlackHoleConnector
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.exec.local import LocalExecutor
+from trino_tpu.planner import plan as P
+from trino_tpu.sql import parse_statement
+from trino_tpu.sql import tree as t
+
+
+class LocalQueryRunner:
+    """Parse -> analyze/plan -> execute, one process, no RPC."""
+
+    def __init__(self, session: Optional[Session] = None):
+        self.session = session or Session()
+        self.catalogs = CatalogManager()
+        self.catalogs.register("tpch", TpchConnector())
+        self.catalogs.register("memory", MemoryConnector())
+        self.catalogs.register("blackhole", BlackHoleConnector())
+
+    def plan(self, sql: str) -> P.PlanNode:
+        stmt = parse_statement(sql)
+        analyzer = Analyzer(self.catalogs, self.session)
+        plan = analyzer.plan_statement(stmt)
+        from trino_tpu.planner.optimizer import optimize
+
+        return optimize(plan, self.session, self.catalogs)
+
+    def execute(self, sql: str) -> tuple[list[tuple], list[str]]:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, t.SetSession):
+            value = stmt.value
+            v: Any = value.value if isinstance(value, t.Literal) else None
+            self.session.set(stmt.name, v)
+            return [], ["result"]
+        plan = self._plan_stmt(stmt)
+        executor = LocalExecutor(self.catalogs, self.session)
+        batch, names = executor.execute(plan)
+        return batch.to_pylist(), names
+
+    def _plan_stmt(self, stmt) -> P.PlanNode:
+        analyzer = Analyzer(self.catalogs, self.session)
+        plan = analyzer.plan_statement(stmt)
+        from trino_tpu.planner.optimizer import optimize
+
+        return optimize(plan, self.session, self.catalogs)
+
+    def explain(self, sql: str) -> str:
+        return P.plan_text(self.plan(sql))
+
+    def assert_query(self, sql: str, expected: Sequence[tuple], ordered: bool = False):
+        rows, _ = self.execute(sql)
+        got = rows if ordered else sorted(map(tuple, rows))
+        want = list(expected) if ordered else sorted(map(tuple, expected))
+        assert got == want, f"query mismatch:\n got: {got[:20]}\nwant: {want[:20]}"
