@@ -1,0 +1,53 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Headline metric (BASELINE.json: "hash-join rows/sec/chip" family): the
+TPC-H Q1 aggregation pipeline — filter + decimal projections + 2-key
+group-by with 5 aggregates — steady-state rows/second on one chip, over
+4M pre-staged device rows. ``vs_baseline`` is measured against the
+north-star proxy of 100M rows/s/core for the reference's Java operator
+stack (BASELINE.md publishes no absolute numbers; the driver records
+round-over-round movement).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    import __graft_entry__ as G
+
+    n = 1 << 22
+    fn, _ = G.entry()
+    batch = G._example_batch(n, seed=42)
+    jitted = jax.jit(fn)
+    # warmup/compile
+    out = jax.block_until_ready(jitted(batch))
+    t0 = time.time()
+    iters = 5
+    for _ in range(iters):
+        out = jax.block_until_ready(jitted(batch))
+    dt = (time.time() - t0) / iters
+    rows_per_sec = n / dt
+    (kd, kv), results, ng, ovf = out
+    assert int(ng) >= 1 and not bool(ovf)
+    baseline_proxy = 1.0e8  # assumed Java operator rows/s/core (no published number)
+    print(
+        json.dumps(
+            {
+                "metric": "tpch_q1_pipeline_rows_per_sec_per_chip",
+                "value": round(rows_per_sec),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / baseline_proxy, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

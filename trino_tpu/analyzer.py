@@ -422,7 +422,11 @@ class Analyzer:
             )
             agg_map[fc] = out_sym
 
-        pre_project = P.Project(rp.node, pre_assignments)
+        # count(*)-only aggregations have no inputs to project; feed the
+        # source directly (a zero-column projection would lose row counts)
+        pre_project = (
+            P.Project(rp.node, pre_assignments) if pre_assignments else rp.node
+        )
         agg_node = P.Aggregate(pre_project, key_symbols, aggs, step="single")
 
         # post-agg scope: group-by ASTs and agg ASTs -> symbols

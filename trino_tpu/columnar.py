@@ -180,7 +180,11 @@ class Batch:
 
     @property
     def capacity(self) -> int:
-        return self.columns[0].capacity if self.columns else 0
+        if self.columns:
+            return self.columns[0].capacity
+        if self.sel is not None:
+            return int(self.sel.shape[0])
+        return self.num_rows
 
     @property
     def width(self) -> int:

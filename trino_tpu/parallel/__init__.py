@@ -1,0 +1,14 @@
+"""Distribution: device mesh, exchanges as collectives, distributed executor.
+
+Reference: Trino's distribution stack — ``PlanFragmenter.java:88`` (stage
+cutting), ``SystemPartitioningHandle.java:58-66`` (partitioning taxonomy),
+``execution/buffer/`` + ``operator/ExchangeClient.java`` (HTTP shuffle),
+``AddExchanges.java:115`` (distribution choice).
+
+TPU-first translation (SURVEY.md §2.6/§2.7): a stage is an SPMD region over
+a ``jax.sharding.Mesh``; the pull-based HTTP shuffle becomes
+``lax.all_to_all`` (hash repartition) / replication constraints (broadcast)
+inside jit-compiled programs, with XLA inserting the collectives.
+"""
+
+from trino_tpu.parallel.mesh import make_mesh, shard_batch  # noqa: F401

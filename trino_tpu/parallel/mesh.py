@@ -1,0 +1,136 @@
+"""Device mesh + sharded batch construction.
+
+The engine uses a 1-D mesh axis ``"shards"`` for inter-chip partitioned
+parallelism (Trino's FIXED_HASH_DISTRIBUTION analog). Batches are global
+``jax.Array``s sharded on the row axis; padding makes per-shard row counts
+equal (selection masks carry validity).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from trino_tpu.columnar import Batch, Column
+
+AXIS = "shards"
+
+
+def smap(f, mesh: Mesh, in_specs, out_specs):
+    """Version-compatible shard_map (check_vma/check_rep rename across JAX)."""
+    try:
+        from jax import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
+    except (ImportError, TypeError):
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]), (AXIS,))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(mesh: Mesh, parts: Sequence[Batch]) -> Batch:
+    """Assemble per-shard host batches into one globally-sharded Batch.
+
+    ``parts`` has one Batch per mesh device (same schema). Rows are padded
+    to the max per-shard capacity; the result's ``sel`` masks padding.
+    """
+    n = mesh.devices.size
+    assert len(parts) == n, f"need {n} parts, got {len(parts)}"
+    cap = max(1, max(p.capacity for p in parts))
+    sharding = row_sharding(mesh)
+    width = parts[0].width
+    cols: list[Column] = []
+    sels = []
+    for p in parts:
+        mask = np.zeros(cap, dtype=np.bool_)
+        mask[: p.num_rows] = True
+        if p.sel is not None:
+            local = np.zeros(cap, dtype=np.bool_)
+            local[: p.capacity] = np.asarray(p.sel)
+            mask &= local
+        sels.append(mask)
+    sel = _global(mesh, sharding, sels)
+    dictionaries = _unify_part_dictionaries(parts)
+    for j in range(width):
+        t = parts[0].columns[j].type  # same schema across parts
+        datas, valids = [], []
+        for pi, p in enumerate(parts):
+            c = p.columns[j]
+            data = np.asarray(c.data)
+            if dictionaries[j] is not None and c.dictionary is not None:
+                remap = dictionaries[j][1][pi]
+                if remap is not None:
+                    data = np.where(data >= 0, remap[np.maximum(data, 0)], -1).astype(
+                        np.int32
+                    )
+            if data.shape[0] < cap:
+                data = np.concatenate(
+                    [data, np.zeros(cap - data.shape[0], dtype=data.dtype)]
+                )
+            valid = np.ones(cap, dtype=np.bool_)
+            if c.valid is not None:
+                v = np.asarray(c.valid)
+                valid[: v.shape[0]] = v
+                valid[v.shape[0]:] = False
+            datas.append(data)
+            valids.append(valid)
+        data_g = _global(mesh, sharding, datas)
+        valid_g = _global(mesh, sharding, valids)
+        d = dictionaries[j][0] if dictionaries[j] is not None else None
+        cols.append(Column(t, data_g, valid_g, d))
+    return Batch(cols, cap * n, sel)
+
+
+def _unify_part_dictionaries(parts: Sequence[Batch]):
+    """Per column: merge per-part dictionaries into one; remap tables."""
+    out = []
+    width = parts[0].width
+    for j in range(width):
+        dicts = [p.columns[j].dictionary for p in parts]
+        if all(d is None for d in dicts):
+            out.append(None)
+            continue
+        base = None
+        remaps = []
+        for d in dicts:
+            if d is None:
+                remaps.append(None)
+                continue
+            if base is None:
+                base = d
+                remaps.append(None)
+            elif d is base:
+                remaps.append(None)
+            else:
+                base, remap = base.merged(d)
+                remaps.append(remap)
+        out.append((base, remaps))
+    return out
+
+
+def _global(mesh: Mesh, sharding: NamedSharding, arrs: list[np.ndarray]) -> jax.Array:
+    """Build a global sharded array from per-device host shards."""
+    singles = [
+        jax.device_put(a, d) for a, d in zip(arrs, list(mesh.devices.flat))
+    ]
+    shape = (sum(a.shape[0] for a in arrs),) + arrs[0].shape[1:]
+    return jax.make_array_from_single_device_arrays(shape, sharding, singles)

@@ -68,3 +68,27 @@ class LocalQueryRunner:
         got = rows if ordered else sorted(map(tuple, rows))
         want = list(expected) if ordered else sorted(map(tuple, expected))
         assert got == want, f"query mismatch:\n got: {got[:20]}\nwant: {want[:20]}"
+
+
+class DistributedQueryRunner(LocalQueryRunner):
+    """Multi-shard runner over a device mesh (reference:
+    ``testing/trino-testing/.../DistributedQueryRunner.java:72`` — N real
+    workers in one process; here N mesh shards in one process, with real
+    collectives between them)."""
+
+    def __init__(self, session: Optional[Session] = None, n_devices: Optional[int] = None):
+        super().__init__(session)
+        from trino_tpu.parallel.mesh import make_mesh
+
+        self.mesh = make_mesh(n_devices)
+
+    def execute(self, sql: str) -> tuple[list[tuple], list[str]]:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, t.SetSession):
+            return super().execute(sql)
+        plan = self._plan_stmt(stmt)
+        from trino_tpu.parallel.distributed import DistributedExecutor
+
+        executor = DistributedExecutor(self.catalogs, self.session, self.mesh)
+        batch, names = executor.execute(plan)
+        return batch.to_pylist(), names
