@@ -1,0 +1,95 @@
+"""Regression tests for code-review findings (round 1, review 2)."""
+
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def test_right_join_predicate_not_pushed_below(runner):
+    rows, _ = runner.execute(
+        "select * from (values (1, 5), (2, 7)) a(k, x) "
+        "right join (values 1, 3) b(k) on a.k = b.k where a.x = 5"
+    )
+    assert rows == [(1, 5, 1)]
+
+
+def test_count_distinct(runner):
+    rows, _ = runner.execute(
+        "select count(distinct x) from (values 1, 1, 2) v(x)"
+    )
+    assert rows == [(2,)]
+
+
+def test_count_distinct_grouped(runner):
+    rows, _ = runner.execute(
+        "select k, count(distinct x), sum(distinct x), count(*) from "
+        "(values (1, 10), (1, 10), (1, 20), (2, 5), (2, 5)) v(k, x) "
+        "group by k order by k"
+    )
+    assert rows == [(1, 2, 30, 3), (2, 1, 5, 2)]
+
+
+def test_not_in_null_probe_value(runner):
+    rows, _ = runner.execute(
+        "select x from (values 1, cast(null as bigint), 4) t(x) "
+        "where x not in (select y from (values 1, 2) u(y)) order by x"
+    )
+    assert rows == [(4,)]
+
+
+def test_not_in_empty_build_keeps_null_probe(runner):
+    rows, _ = runner.execute(
+        "select count(*) from (values 1, cast(null as bigint)) t(x) "
+        "where x not in (select y from (values 2) u(y) where y > 100)"
+    )
+    # empty subquery: NOT IN is TRUE for every row, even NULL x
+    assert rows == [(2,)]
+
+
+def test_in_with_null_in_build_side(runner):
+    rows, _ = runner.execute(
+        "select x from (values 1, 3) t(x) "
+        "where x in (select y from (values 1, cast(null as bigint)) u(y))"
+    )
+    # 3 IN (1, NULL) is NULL -> filtered; 1 IN (1, NULL) is TRUE
+    assert rows == [(1,)]
+
+
+def test_decimal_integer_join(runner):
+    rows, _ = runner.execute(
+        "select * from (values 5.00) a(d) join (values 5) b(i) on a.d = b.i"
+    )
+    assert rows == [(Decimal("5.00"), 5)]
+
+
+def test_group_by_case_insensitive(runner):
+    rows, _ = runner.execute(
+        "select X, count(*) from (values 1, 1, 2) v(x) group by x order by x"
+    )
+    assert rows == [(1, 2), (2, 1)]
+
+
+def test_group_by_qualified_vs_bare(runner):
+    rows, _ = runner.execute(
+        "select a, count(*) from (values 1, 2) v(a) group by v.a order by a"
+    )
+    assert rows == [(1, 1), (2, 1)]
+
+
+def test_values_with_cast(runner):
+    rows, _ = runner.execute(
+        "select * from (values cast(5 as decimal(10,2))) a(d)"
+    )
+    assert rows == [(Decimal("5.00"),)]
+
+
+def test_cast_null(runner):
+    rows, _ = runner.execute("select cast(null as bigint), cast(null as date)")
+    assert rows == [(None, None)]

@@ -68,6 +68,11 @@ class Scope:
             return matches[0].symbol
         if len(matches) > 1:
             raise SemanticError(f"ambiguous column: {'.'.join(parts)}")
+        if len(parts) == 1:
+            # normalized identifiers carry unique symbol names directly
+            sym_matches = [f for f in self.fields if f.symbol.name == parts[0]]
+            if len(sym_matches) == 1:
+                return sym_matches[0].symbol
         if self.parent is not None:
             return self.parent.resolve(parts)
         raise SemanticError(f"column not found: {'.'.join(parts)}")
@@ -250,7 +255,9 @@ class Analyzer:
                 alias = item.alias
                 if alias is None and isinstance(item.expression, t.Identifier):
                     alias = item.expression.parts[-1]
-                select_entries.append((item.expression, alias))
+                select_entries.append(
+                    (self._normalize(item.expression, rp.scope), alias)
+                )
 
         has_aggs = any(
             _contains_aggregate(e) for e, _ in select_entries
@@ -284,9 +291,18 @@ class Analyzer:
                 [Field(n, None, s) for (n, s) in zip(names, out_syms)],
             )
             for si in order_by:
+                # alias resolution first (raw form), then structural match
+                # against normalized select expressions
                 sym = self._resolve_sort_symbol(
                     si, select_scope, rp.scope, select_entries, out_syms
                 )
+                if sym is None:
+                    si = dataclasses.replace(
+                        si, expression=self._normalize(si.expression, rp.scope)
+                    )
+                    sym = self._resolve_sort_symbol(
+                        si, select_scope, rp.scope, select_entries, out_syms
+                    )
                 if sym is None:
                     ex = self._rewrite(si.expression, rp.scope)
                     ex = _fold(ex)
@@ -341,7 +357,8 @@ class Analyzer:
         self, spec, rp, select_entries, order_by, limit, offset
     ) -> tuple[RelationPlan, list[str]]:
         input_scope = rp.scope
-        # resolve group keys (ordinals or expressions)
+        # resolve group keys (ordinals or expressions), normalized for
+        # structural matching against (already-normalized) select entries
         group_asts: list[t.Node] = []
         for g in spec.group_by:
             if isinstance(g, t.Literal) and g.kind == "integer":
@@ -350,14 +367,26 @@ class Analyzer:
                     raise SemanticError(f"GROUP BY ordinal {g.value} out of range")
                 group_asts.append(select_entries[idx][0])
             else:
-                group_asts.append(g)
+                group_asts.append(self._normalize(g, input_scope))
+
+        having_ast = (
+            self._normalize(spec.having, input_scope)
+            if spec.having is not None
+            else None
+        )
+        order_by = tuple(
+            dataclasses.replace(
+                si, expression=self._normalize(si.expression, input_scope)
+            )
+            for si in order_by
+        )
 
         # collect aggregate calls from select + having + order_by
         agg_asts: list[t.FunctionCall] = []
         for e, _ in select_entries:
             _collect_aggregates(e, agg_asts)
-        if spec.having is not None:
-            _collect_aggregates(spec.having, agg_asts)
+        if having_ast is not None:
+            _collect_aggregates(having_ast, agg_asts)
         for si in order_by:
             _collect_aggregates(si.expression, agg_asts)
 
@@ -440,8 +469,8 @@ class Analyzer:
             )
 
         node: P.PlanNode = agg_node
-        if spec.having is not None:
-            pred = _fold(rewrite_post(spec.having))
+        if having_ast is not None:
+            pred = _fold(rewrite_post(having_ast))
             node = P.Filter(node, pred)
 
         out_syms: list[P.Symbol] = []
@@ -658,6 +687,48 @@ class Analyzer:
 
         ex = self._rewrite(e, rp.scope, subquery_handler=handle, scope_getter=lambda: state["rp"].scope)
         return ex, state["rp"]
+
+    # ==== AST normalization =============================================
+    def _normalize(self, e: t.Node, scope: Scope) -> t.Node:
+        """Canonicalize an AST expression for structural matching: every
+        resolvable Identifier becomes Identifier((symbol_name,)) so that
+        'X' vs 'x' vs 't.x' compare equal (name resolution is
+        case-insensitive; structural dataclass equality is not). Subquery
+        bodies are left untouched (their identifiers resolve in inner
+        scopes)."""
+        if isinstance(e, t.Identifier):
+            sym = scope.try_resolve(e.parts)
+            if sym is not None:
+                return t.Identifier((sym.name,))
+            return t.Identifier(tuple(p.lower() for p in e.parts))
+        if isinstance(e, (t.ScalarSubquery, t.InSubquery, t.Exists, t.Query)):
+            return e
+        if dataclasses.is_dataclass(e) and isinstance(e, t.Node):
+            changes = {}
+            for f in dataclasses.fields(e):
+                v = getattr(e, f.name)
+                if isinstance(v, t.Node):
+                    changes[f.name] = self._normalize(v, scope)
+                elif isinstance(v, tuple):
+                    new_items = tuple(
+                        self._normalize(item, scope)
+                        if isinstance(item, t.Node)
+                        else (
+                            tuple(
+                                self._normalize(sub, scope)
+                                if isinstance(sub, t.Node)
+                                else sub
+                                for sub in item
+                            )
+                            if isinstance(item, tuple)
+                            else item
+                        )
+                        for item in v
+                    )
+                    changes[f.name] = new_items
+            if changes:
+                return dataclasses.replace(e, **changes)
+        return e
 
     # ==== expression rewriting ==========================================
     def _rewrite(
@@ -1055,9 +1126,11 @@ def _fold(e: RowExpr) -> RowExpr:
 def _fold_call(node: Call) -> RowExpr:
     args = node.args
     vals = [a.value for a in args]
-    if any(v is None for v in vals) and node.name != "cast":
+    if any(v is None for v in vals):
         return Constant(type=node.type, value=None)
     try:
+        if node.name == "cast":
+            return _fold_cast(args[0], node.type) or node
         if node.name == "date_add_days":
             return const(int(vals[0]) + int(vals[1]), node.type)
         if node.name == "date_add_months":
@@ -1100,6 +1173,46 @@ def _fold_call(node: Call) -> RowExpr:
     except Exception:
         return node
     return node
+
+
+def _fold_cast(src: Constant, target: T.SqlType) -> Optional[Constant]:
+    """Fold CAST of a literal (storage-representation conversion)."""
+    st = src.type
+    v = src.value
+    if st == target:
+        return src
+    if T.is_string(st):
+        return _cast_string_constant(src, target)
+    if isinstance(target, T.DecimalType):
+        if isinstance(st, T.DecimalType):
+            return const(_rescale_int(int(v), st.scale, target.scale), target)
+        if T.is_integer(st):
+            return const(int(v) * target.unscale, target)
+        if isinstance(st, (T.DoubleType, T.RealType)):
+            from decimal import Decimal
+
+            return const(
+                int(Decimal(str(float(v))).scaleb(target.scale).to_integral_value()),
+                target,
+            )
+    if isinstance(target, (T.DoubleType, T.RealType)):
+        if isinstance(st, T.DecimalType):
+            return const(float(v) / st.unscale, target)
+        return const(float(v), target)
+    if T.is_integer(target):
+        if isinstance(st, T.DecimalType):
+            return const(_rescale_int(int(v), st.scale, 0), target)
+        if isinstance(st, (T.DoubleType, T.RealType)):
+            f = float(v)
+            import math
+
+            return const(int(math.floor(abs(f) + 0.5)) * (1 if f >= 0 else -1), target)
+        return const(int(v), target)
+    if isinstance(target, T.TimestampType) and isinstance(st, T.DateType):
+        return const(int(v) * 86_400_000_000, target)
+    if isinstance(target, T.DateType) and isinstance(st, T.TimestampType):
+        return const(int(v) // 86_400_000_000, target)
+    return None
 
 
 def _as_float(c: Constant) -> float:

@@ -217,8 +217,11 @@ class LocalExecutor:
 
     # === aggregation ====================================================
     def _exec_aggregate(self, node: P.Aggregate) -> Result:
-        res = self._exec(node.source)
+        return self._aggregate_result(node, self._exec(node.source))
+
+    def _aggregate_result(self, node: P.Aggregate, res: Result) -> Result:
         sel = res.batch.selection_mask()
+        key_pairs_for_distinct = [res.pair(k) for k in node.group_keys]
         agg_inputs = []
         specs = []
         string_aggs: list[Optional[Dictionary]] = []
@@ -242,6 +245,15 @@ class LocalExecutor:
                     fsym = P.Symbol(fn.filter.name, T.BOOLEAN)
                     fc = res.column(fsym)
                     valid = valid & fc.data & fc.valid_mask()
+                if fn.distinct and fn.kind in ("count", "sum", "avg"):
+                    # DISTINCT: keep only the first occurrence of each
+                    # (group keys, value) combination
+                    from trino_tpu.ops.aggregation import distinct_first_mask
+
+                    first = distinct_first_mask(
+                        key_pairs_for_distinct, (data, valid), sel & valid
+                    )
+                    valid = valid & first
                 pair = (data, valid)
             agg_inputs.append(pair)
             specs.append(AggSpec(fn.kind if fn.kind != "count_star" else "count_star"))
@@ -371,9 +383,11 @@ class LocalExecutor:
             return res  # layout covers both sides; order fixed by Output
         if node.join_type not in ("INNER", "LEFT"):
             raise ExecutionError(f"join type {node.join_type} not supported yet")
-
         left = self._exec(node.left)  # probe
         right = self._exec(node.right)  # build
+        return self._join_result(node, left, right)
+
+    def _join_result(self, node: P.Join, left: Result, right: Result) -> Result:
         lkeys, rkeys = self._join_keys(left, right, node.criteria)
         bh, bv = J.hash_keys(rkeys)
         ph, pv = J.hash_keys(lkeys)
@@ -440,13 +454,14 @@ class LocalExecutor:
                     merged, remap = lc.dictionary.merged(rc.dictionary)
                     remap_j = jnp.asarray(remap)
                     rd = jnp.where(rd >= 0, remap_j[jnp.maximum(rd, 0)], -1)
-            if T.is_numeric(ls.type) and isinstance(ls.type, T.DecimalType):
-                # align scales for cross-scale decimal joins
-                rs_t = rs.type
-                if isinstance(rs_t, T.DecimalType) and rs_t.scale != ls.type.scale:
-                    s = max(ls.type.scale, rs_t.scale)
-                    ld = ld * (10 ** (s - ls.type.scale))
-                    rd = rd * (10 ** (s - rs_t.scale))
+            ls_scale = ls.type.scale if isinstance(ls.type, T.DecimalType) else 0
+            rs_scale = rs.type.scale if isinstance(rs.type, T.DecimalType) else 0
+            if ls_scale != rs_scale:
+                # align scales: decimal-vs-decimal and decimal-vs-integer
+                # joins must compare equal values equal
+                s = max(ls_scale, rs_scale)
+                ld = ld.astype(jnp.int64) * (10 ** (s - ls_scale))
+                rd = rd.astype(jnp.int64) * (10 ** (s - rs_scale))
             lkeys.append((ld.astype(jnp.int64), lv))
             rkeys.append((rd.astype(jnp.int64), rv))
         return lkeys, rkeys
@@ -479,24 +494,28 @@ class LocalExecutor:
                 break
             out_capacity = bucket_capacity(int(total))
         osel = J.verify_equal(lkeys, rkeys, ppos, bpos, osel)
-        mark = (
+        matched = (
             jnp.zeros(left.batch.capacity, dtype=jnp.bool_)
             .at[jnp.where(osel, ppos, left.batch.capacity)]
             .set(True, mode="drop")
         )
-        if node.join_type == "ANTI":
-            # NOT IN semantics: if build side has any NULL key, result is
-            # NULL (filtered); approximate with no-match -> true minus nulls
-            any_null_build = bool(
-                np.asarray((~bv) & right.batch.selection_mask()).any()
-            )
-            mark_data = ~mark
-            mark_valid = None
-            if any_null_build:
-                mark_valid = np.zeros(left.batch.capacity, dtype=np.bool_)
-            mark_col = Column(T.BOOLEAN, mark_data, mark_valid)
+        # three-valued IN semantics (x IN S / x NOT IN S):
+        #   matched            -> TRUE / FALSE
+        #   S empty            -> FALSE / TRUE
+        #   x NULL, S nonempty -> NULL
+        #   no match, S has NULL -> NULL
+        bsel_mask = right.batch.selection_mask()
+        build_nonempty = bool(np.asarray(bsel_mask).any())
+        any_null_build = bool(np.asarray((~bv) & bsel_mask).any())
+        pv = jnp.ones(left.batch.capacity, dtype=jnp.bool_)
+        for _, kv in lkeys:
+            pv = pv & kv
+        if not build_nonempty:
+            valid = jnp.ones(left.batch.capacity, dtype=jnp.bool_)
         else:
-            mark_col = Column(T.BOOLEAN, mark)
+            valid = matched | (pv & (not any_null_build))
+        value = matched if node.join_type == "SEMI" else ~matched
+        mark_col = Column(T.BOOLEAN, value, None if bool(np.asarray(valid).all()) else valid)
         cols = list(left.batch.columns) + [mark_col]
         layout = dict(left.layout)
         layout[node.mark_symbol.name] = len(cols) - 1

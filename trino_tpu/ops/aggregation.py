@@ -156,6 +156,34 @@ def group_aggregate(
     return (out_key_data, out_key_valid), results, num_groups, overflow
 
 
+def distinct_first_mask(
+    keys: Sequence[tuple[jnp.ndarray, jnp.ndarray]],
+    value: tuple[jnp.ndarray, jnp.ndarray],
+    sel: jnp.ndarray,
+) -> jnp.ndarray:
+    """Mask of first occurrences of each (group keys..., value) combination
+    among selected rows — the dedup pass behind DISTINCT aggregates
+    (reference: ``MarkDistinctOperator.java`` / distinct accumulators).
+
+    Sort-based: lexicographically sort (sel, keys..., value), mark rows where
+    any component differs from the previous row, and scatter the marks back
+    through the permutation.
+    """
+    n = sel.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ops = _sortable_keys(list(keys) + [value], sel)
+    num_keys = len(ops)
+    sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=num_keys)
+    perm = sorted_ops[-1]
+    s_sel = ~sorted_ops[0]
+    changed = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+    for k in sorted_ops[:num_keys]:
+        prev = jnp.concatenate([k[:1], k[:-1]])
+        changed = changed | (k != prev)
+    first_sorted = changed & s_sel
+    return jnp.zeros(n, dtype=jnp.bool_).at[perm].set(first_sorted)
+
+
 def global_aggregate(
     sel: jnp.ndarray,
     agg_inputs: Sequence[tuple[jnp.ndarray, jnp.ndarray] | None],

@@ -90,7 +90,12 @@ class DistributedExecutor(LocalExecutor):
     def _exec_aggregate(self, node: P.Aggregate) -> Result:
         res = self._exec(node.source)
         if not _is_sharded(res.batch):
-            return super()._exec_aggregate(node)
+            return self._aggregate_result(node, res)
+        if any(fn.distinct for _, fn in node.aggregates):
+            # DISTINCT aggregates need a global dedup — per-shard partials
+            # would double-count values seen on multiple shards. Run the
+            # single-program path (XLA gathers as needed).
+            return self._aggregate_result(node, res)
         if not node.group_keys:
             # global agg: compute per-shard partials via masked group-by with
             # a single dummy key, then combine on host
@@ -100,10 +105,16 @@ class DistributedExecutor(LocalExecutor):
         keys = [res.pair(k) for k in node.group_keys]
         key_dicts = [res.column(k).dictionary for k in node.group_keys]
         agg_inputs, specs, string_aggs = self._prepare_agg_inputs(node, res)
-        G = 1 << 12
-
         n = self.n_shards
         nkeys = len(keys)
+        G = 1 << 12
+        return self._partial_final_agg(
+            node, keys, key_dicts, sel, agg_inputs, specs, string_aggs, G, n, nkeys
+        )
+
+    def _partial_final_agg(
+        self, node, keys, key_dicts, sel, agg_inputs, specs, string_aggs, G, n, nkeys
+    ) -> Result:
 
         in_specs = tuple(PS(AXIS) for _ in range(2 * nkeys + 1)) + tuple(
             PS(AXIS) for _ in range(sum(2 if p else 0 for p in agg_inputs))
@@ -151,7 +162,8 @@ class DistributedExecutor(LocalExecutor):
             key_data = jnp.stack([kd[i2].astype(jnp.int64) for i2 in range(nkeys)])
             key_valid = jnp.stack([kv[i2] for i2 in range(nkeys)])
             live = jnp.arange(G) < ng
-            return key_data.T, key_valid.T, tuple(flat_vals), tuple(flat_cnts), live
+            ovf_any = jax.lax.pmax(ovf.astype(jnp.int32), AXIS)
+            return key_data.T, key_valid.T, tuple(flat_vals), tuple(flat_cnts), live, ovf_any
 
         mapped = smap(
             partial_agg,
@@ -163,9 +175,18 @@ class DistributedExecutor(LocalExecutor):
                 tuple(PS(AXIS) for _ in specs),
                 tuple(PS(AXIS) for _ in specs),
                 PS(AXIS),
+                PS(),
             ),
         )
-        key_data_g, key_valid_g, vals_g, cnts_g, live_g = mapped(*flat_inputs)
+        key_data_g, key_valid_g, vals_g, cnts_g, live_g, ovf_g = mapped(*flat_inputs)
+        if bool(np.asarray(ovf_g).max()):
+            # some shard exceeded G groups — retry with larger capacity
+            if G > (1 << 24):
+                raise ExecutionError("per-shard group cardinality too large")
+            return self._partial_final_agg(
+                node, keys, key_dicts, sel, agg_inputs, specs, string_aggs,
+                G << 2, n, nkeys,
+            )
         # host-side final combine over n*G partial rows (small)
         kd_np = np.asarray(key_data_g)
         kv_np = np.asarray(key_valid_g)
@@ -296,13 +317,13 @@ class DistributedExecutor(LocalExecutor):
         layout[dummy.name] = len(cols) - 1
         res2 = Result(Batch(cols, res.batch.num_rows, res.batch.sel), layout)
         node2 = P.Aggregate(node.source, [dummy], node.aggregates, node.step)
-        # NOTE: bypass _exec on source — we already have res2
-        saved = self._exec
-        try:
-            self._exec = lambda n_: res2 if n_ is node.source else saved(n_)
-            out = self._exec_aggregate_grouped_from(node2, res2)
-        finally:
-            self._exec = saved
+        sel = res2.batch.selection_mask()
+        keys = [res2.pair(dummy)]
+        agg_inputs, specs, string_aggs = self._prepare_agg_inputs(node2, res2)
+        out = self._partial_final_agg(
+            node2, keys, [None], sel, agg_inputs, specs, string_aggs,
+            8, self.n_shards, 1,
+        )
         # drop the dummy key column; single row (or zero -> one null row)
         b = out.batch
         agg_cols = b.columns[1:]
@@ -327,9 +348,6 @@ class DistributedExecutor(LocalExecutor):
             Batch(agg_cols, b.num_rows),
             {s.name: i for i, (s, _) in enumerate(node.aggregates)},
         )
-
-    def _exec_aggregate_grouped_from(self, node2: P.Aggregate, res: Result) -> Result:
-        return DistributedExecutor._exec_aggregate(self, node2)
 
     # === joins ==========================================================
     def _exec_join(self, node: P.Join) -> Result:
@@ -364,14 +382,7 @@ class DistributedExecutor(LocalExecutor):
         return self._partitioned_join(node, left, right)
 
     def _local_join(self, node, left, right):
-        saved = self._exec
-        try:
-            self._exec = lambda n_: (
-                left if n_ is node.left else right if n_ is node.right else saved(n_)
-            )
-            return LocalExecutor._exec_join(self, node)
-        finally:
-            self._exec = saved
+        return self._join_result(node, left, right)
 
     def _broadcast_join(self, node, left, right, lkeys, rkeys, ph, pv, bh, bv):
         mesh = self.mesh

@@ -103,9 +103,16 @@ def push_down_predicates(node: P.PlanNode, inherited: Optional[list[RowExpr]] = 
         join_type = node.join_type
         for c in pending:
             refs = referenced_variables(c)
-            if refs and refs <= left_names:
+            # pushing into a null-extended (outer) side would filter before
+            # null-extension and wrongly revive rows: LEFT keeps left-side
+            # pushes only, RIGHT right-side only, FULL neither
+            if refs and refs <= left_names and join_type in (
+                "INNER", "CROSS", "LEFT", "SEMI", "ANTI"
+            ):
                 to_left.append(c)
-            elif refs and refs <= right_names and join_type in ("INNER", "CROSS", "SEMI", "ANTI"):
+            elif refs and refs <= right_names and join_type in (
+                "INNER", "CROSS", "RIGHT", "SEMI", "ANTI"
+            ):
                 to_right.append(c)
             else:
                 # equality spanning both sides of an inner/cross join

@@ -308,16 +308,7 @@ class Parser:
         if self.at_op("*"):
             self.next()
             return t.SelectItem(t.Star())
-        # qualified star: ident(.ident)*.*
-        save = self.pos
-        if self.peek().kind in ("IDENT", "QIDENT"):
-            try:
-                name = self.qualified_name()
-                if self.at_op(".") or (self.at_op("*") and self.tokens[self.pos - 1].text == "."):
-                    pass
-            except SqlSyntaxError:
-                self.pos = save
-        self.pos = save
+        # qualified star: only single-qualifier `t.*` is supported
         if (
             self.peek().kind in ("IDENT", "QIDENT")
             and self.peek(1).kind == "OP"
