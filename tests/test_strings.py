@@ -1,0 +1,154 @@
+"""String function tests (reference: operator/scalar/StringFunctions.java +
+TestStringFunctions in trino-main)."""
+
+import pytest
+
+from trino_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+NAMES = "(values ('Alice'), ('bob'), ('  Carol  '), (cast(null as varchar))) as t(s)"
+
+
+class TestStringFunctions:
+    def test_upper_lower(self, runner):
+        rows, _ = runner.execute(
+            f"select upper(s), lower(s) from {NAMES} order by s"
+        )
+        assert rows == [
+            ("  CAROL  ", "  carol  "),
+            ("ALICE", "alice"),
+            ("BOB", "bob"),
+            (None, None),
+        ]
+
+    def test_trim(self, runner):
+        rows, _ = runner.execute(
+            "select trim(s), ltrim(s), rtrim(s) from (values ('  x  ')) as t(s)"
+        )
+        assert rows == [("x", "x  ", "  x")]
+
+    def test_length(self, runner):
+        rows, _ = runner.execute(f"select length(s) from {NAMES} order by s")
+        assert rows == [(9,), (5,), (3,), (None,)]
+
+    def test_substr(self, runner):
+        rows, _ = runner.execute(
+            "select substr('hello', 2), substr('hello', 2, 3), substr('hello', -3)"
+        )
+        assert rows == [("ello", "ell", "llo")]
+
+    def test_concat_operator(self, runner):
+        rows, _ = runner.execute(
+            "select s || '!' from (values ('a'), ('b')) as t(s) order by s"
+        )
+        assert rows == [("a!",), ("b!",)]
+
+    def test_concat_two_columns(self, runner):
+        rows, _ = runner.execute(
+            "select a || '-' || b from (values ('x', 'p'), ('y', 'q')) as t(a, b) "
+            "order by a"
+        )
+        assert rows == [("x-p",), ("y-q",)]
+
+    def test_replace_reverse(self, runner):
+        rows, _ = runner.execute(
+            "select replace('banana', 'a', 'o'), reverse('abc')"
+        )
+        assert rows == [("bonono", "cba")]
+
+    def test_strpos_starts_with(self, runner):
+        rows, _ = runner.execute(
+            "select strpos(s, 'b'), starts_with(s, 'a') "
+            "from (values ('abc'), ('bcd')) as t(s) order by s"
+        )
+        assert rows == [(2, True), (1, False)]
+
+    def test_lpad_rpad(self, runner):
+        rows, _ = runner.execute(
+            "select lpad('7', 3, '0'), rpad('ab', 5, 'xy'), lpad('hello', 3, '0')"
+        )
+        assert rows == [("007", "abxyx", "hel")]
+
+    def test_split_part(self, runner):
+        rows, _ = runner.execute(
+            "select split_part('a:b:c', ':', 2), split_part('a:b', ':', 5)"
+        )
+        assert rows == [("b", "")]
+
+    def test_filter_on_transformed(self, runner):
+        rows, _ = runner.execute(
+            f"select trim(s) from {NAMES} where upper(trim(s)) = 'CAROL'"
+        )
+        assert rows == [("Carol",)]
+
+    def test_group_by_transformed(self, runner):
+        rows, _ = runner.execute(
+            "select upper(s), count(*) from (values ('a'), ('A'), ('b')) as t(s) "
+            "group by upper(s) order by 1"
+        )
+        assert rows == [("A", 2), ("B", 1)]
+
+    def test_case_over_strings(self, runner):
+        rows, _ = runner.execute(
+            "select case when s = 'a' then upper(s) else 'z' end "
+            "from (values ('a'), ('b')) as t(s) order by s"
+        )
+        assert rows == [("A",), ("z",)]
+
+    def test_join_on_transformed_key(self, runner):
+        rows, _ = runner.execute(
+            "select a.s, b.n from (values ('X'), ('Y')) as a(s) "
+            "join (values ('x', 1), ('y', 2)) as b(s, n) on lower(a.s) = b.s "
+            "order by a.s"
+        )
+        assert rows == [("X", 1), ("Y", 2)]
+
+
+class TestReviewRegressions:
+    """Regressions from the window/strings code review."""
+
+    def test_decimal_double_join(self, runner):
+        rows, _ = runner.execute(
+            "select a.d from (values 5.50) a(d) "
+            "join (values cast(5.5 as double)) b(x) on a.d = b.x"
+        )
+        assert len(rows) == 1
+
+    def test_lead_default_column_pruning(self, runner):
+        rows, _ = runner.execute(
+            "select lead(x, 1, y) over (order by x) from "
+            "(select x, y from (values (1, 100), (2, 200)) q(x, y)) t order by 1"
+        )
+        assert rows == [(2,), (200,)]
+
+    def test_concat_non_varchar_rejected(self, runner):
+        import pytest as _pytest
+        from trino_tpu.analyzer import SemanticError
+
+        with _pytest.raises(SemanticError):
+            runner.execute("select 'a' || cast(1.5 as decimal(3,1))")
+
+    def test_strpos_literal(self, runner):
+        rows, _ = runner.execute(
+            "select strpos('abc', 'b'), length('hello'), starts_with('abc', 'a')"
+        )
+        assert rows == [(2, 5, True)]
+
+    def test_window_in_order_by_only(self, runner):
+        rows, _ = runner.execute(
+            "select x from (values (2), (1)) t(x) "
+            "order by row_number() over (order by x desc)"
+        )
+        assert rows == [(2,), (1,)]
+
+    def test_ntile_zero_rejected(self, runner):
+        import pytest as _pytest
+        from trino_tpu.analyzer import SemanticError
+
+        with _pytest.raises(SemanticError):
+            runner.execute("select ntile(0) over (order by x) from (values (1)) t(x)")

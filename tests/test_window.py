@@ -1,0 +1,169 @@
+"""Window function tests (reference: AbstractTestWindowQueries,
+operator/window/* in trino-main tests)."""
+
+import pytest
+
+from trino_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+T1 = (
+    "(values (1, 10), (1, 20), (1, 20), (2, 5), (2, 15), (3, 7)) "
+    "as t(g, v)"
+)
+
+
+class TestRanking:
+    def test_row_number(self, runner):
+        rows, _ = runner.execute(
+            f"select g, v, row_number() over (partition by g order by v) rn "
+            f"from {T1} order by g, v, rn"
+        )
+        assert rows == [
+            (1, 10, 1), (1, 20, 2), (1, 20, 3),
+            (2, 5, 1), (2, 15, 2), (3, 7, 1),
+        ]
+
+    def test_rank_dense_rank(self, runner):
+        rows, _ = runner.execute(
+            f"select g, v, rank() over (partition by g order by v) r, "
+            f"dense_rank() over (partition by g order by v) dr "
+            f"from {T1} order by g, v"
+        )
+        assert rows == [
+            (1, 10, 1, 1), (1, 20, 2, 2), (1, 20, 2, 2),
+            (2, 5, 1, 1), (2, 15, 2, 2), (3, 7, 1, 1),
+        ]
+
+    def test_row_number_no_partition(self, runner):
+        rows, _ = runner.execute(
+            "select v, row_number() over (order by v desc) rn "
+            "from (values (3), (1), (2)) as t(v) order by v"
+        )
+        assert rows == [(1, 3), (2, 2), (3, 1)]
+
+    def test_ntile(self, runner):
+        rows, _ = runner.execute(
+            "select v, ntile(2) over (order by v) nt "
+            "from (values (1), (2), (3), (4)) as t(v) order by v"
+        )
+        assert rows == [(1, 1), (2, 1), (3, 2), (4, 2)]
+
+
+class TestWindowAggregates:
+    def test_running_sum(self, runner):
+        rows, _ = runner.execute(
+            f"select g, v, sum(v) over (partition by g order by v) s "
+            f"from {T1} order by g, v, s"
+        )
+        # RANGE frame: peers (two 20s in g=1) share the running total
+        assert rows == [
+            (1, 10, 10), (1, 20, 50), (1, 20, 50),
+            (2, 5, 5), (2, 15, 20), (3, 7, 7),
+        ]
+
+    def test_partition_total(self, runner):
+        rows, _ = runner.execute(
+            f"select g, v, sum(v) over (partition by g) s "
+            f"from {T1} order by g, v"
+        )
+        assert rows == [
+            (1, 10, 50), (1, 20, 50), (1, 20, 50),
+            (2, 5, 20), (2, 15, 20), (3, 7, 7),
+        ]
+
+    def test_rows_frame(self, runner):
+        rows, _ = runner.execute(
+            f"select g, v, sum(v) over (partition by g order by v "
+            f"rows between unbounded preceding and current row) s "
+            f"from {T1} order by g, v, s"
+        )
+        assert rows == [
+            (1, 10, 10), (1, 20, 30), (1, 20, 50),
+            (2, 5, 5), (2, 15, 20), (3, 7, 7),
+        ]
+
+    def test_count_avg_min_max(self, runner):
+        rows, _ = runner.execute(
+            "select g, count(*) over (partition by g) c, "
+            "avg(v) over (partition by g) a, "
+            "min(v) over (partition by g) mn, "
+            "max(v) over (partition by g) mx "
+            "from (values (1, 10.0), (1, 20.0), (2, 5.0)) as t(g, v) "
+            "order by g, c"
+        )
+        assert rows == [
+            (1, 2, 15.0, 10.0, 20.0),
+            (1, 2, 15.0, 10.0, 20.0),
+            (2, 1, 5.0, 5.0, 5.0),
+        ]
+
+    def test_null_handling(self, runner):
+        rows, _ = runner.execute(
+            "select g, sum(v) over (partition by g) s, "
+            "count(v) over (partition by g) c "
+            "from (values (1, 10), (1, null), (2, null)) as t(g, v) "
+            "order by g, s"
+        )
+        assert rows == [(1, 10, 1), (1, 10, 1), (2, None, 0)]
+
+
+class TestValueFunctions:
+    def test_lead_lag(self, runner):
+        rows, _ = runner.execute(
+            f"select g, v, lag(v) over (partition by g order by v) lg, "
+            f"lead(v) over (partition by g order by v) ld "
+            f"from {T1} order by g, v, lg nulls first"
+        )
+        assert rows == [
+            (1, 10, None, 20), (1, 20, 10, 20), (1, 20, 20, None),
+            (2, 5, None, 15), (2, 15, 5, None), (3, 7, None, None),
+        ]
+
+    def test_lag_with_default(self, runner):
+        rows, _ = runner.execute(
+            "select v, lag(v, 1, -1) over (order by v) lg "
+            "from (values (1), (2), (3)) as t(v) order by v"
+        )
+        assert rows == [(1, -1), (2, 1), (3, 2)]
+
+    def test_first_last_value(self, runner):
+        rows, _ = runner.execute(
+            f"select g, v, first_value(v) over (partition by g order by v) fv, "
+            f"last_value(v) over (partition by g order by v "
+            f"rows between unbounded preceding and unbounded following) lv "
+            f"from {T1} order by g, v"
+        )
+        assert rows == [
+            (1, 10, 10, 20), (1, 20, 10, 20), (1, 20, 10, 20),
+            (2, 5, 5, 15), (2, 15, 5, 15), (3, 7, 7, 7),
+        ]
+
+    def test_strings(self, runner):
+        rows, _ = runner.execute(
+            "select n, first_value(n) over (order by n) f "
+            "from (values ('b'), ('a'), ('c')) as t(n) order by n"
+        )
+        assert rows == [("a", "a"), ("b", "a"), ("c", "a")]
+
+
+class TestWindowOverAggregation:
+    def test_rank_over_sum(self, runner):
+        rows, _ = runner.execute(
+            "select g, sum(v) s, rank() over (order by sum(v) desc) r "
+            "from (values (1, 10), (1, 20), (2, 5), (3, 50)) as t(g, v) "
+            "group by g order by r"
+        )
+        assert rows == [(3, 50, 1), (1, 30, 2), (2, 5, 3)]
+
+    def test_window_after_where(self, runner):
+        rows, _ = runner.execute(
+            "select v, row_number() over (order by v) rn "
+            "from (values (1), (2), (3), (4)) as t(v) where v > 1 "
+            "order by v"
+        )
+        assert rows == [(2, 1), (3, 2), (4, 3)]

@@ -33,6 +33,7 @@ from trino_tpu.ir import (
     Variable,
     call,
     const,
+    referenced_variables,
     special,
     variable,
 )
@@ -270,12 +271,25 @@ class Analyzer:
                 spec, rp, select_entries, order_by, limit, offset
             )
 
+        # window functions (no aggregation): plan Window nodes over the input
+        window_calls: list[t.FunctionCall] = []
+        for e, _ in select_entries:
+            _collect_windows(e, window_calls)
+        for si in order_by:
+            _collect_windows(self._normalize(si.expression, rp.scope), window_calls)
+        win_repl: dict[t.Node, P.Symbol] = {}
+        if window_calls:
+            wnode, win_repl = self._plan_windows(
+                rp.node, window_calls, lambda ast: self._rewrite(ast, rp.scope)
+            )
+            rp = RelationPlan(wnode, rp.scope)
+
         # plain projection
         out_syms: list[P.Symbol] = []
         assignments: list[tuple[P.Symbol, RowExpr]] = []
         names: list[str] = []
         for e_ast, alias in select_entries:
-            ex, rp = self._rewrite_with_subqueries(e_ast, rp)
+            ex, rp = self._rewrite_with_subqueries(e_ast, rp, win_repl or None)
             ex = _fold(ex)
             name = (alias or "_col").lower()
             sym = P.Symbol(P.fresh_name(name), ex.type)
@@ -304,7 +318,9 @@ class Analyzer:
                         si, select_scope, rp.scope, select_entries, out_syms
                     )
                 if sym is None:
-                    ex = self._rewrite(si.expression, rp.scope)
+                    ex = self._rewrite(
+                        si.expression, rp.scope, replacements=win_repl or None
+                    )
                     ex = _fold(ex)
                     sym = P.Symbol(P.fresh_name("sortkey"), ex.type)
                     assignments.append((sym, ex))
@@ -473,6 +489,16 @@ class Analyzer:
             pred = _fold(rewrite_post(having_ast))
             node = P.Filter(node, pred)
 
+        # windows over aggregation results (rank() OVER (ORDER BY sum(x)))
+        window_calls: list[t.FunctionCall] = []
+        for e, _ in select_entries:
+            _collect_windows(e, window_calls)
+        for si in order_by:
+            _collect_windows(si.expression, window_calls)
+        if window_calls:
+            node, win_repl = self._plan_windows(node, window_calls, rewrite_post)
+            post_replacements.update(win_repl)
+
         out_syms: list[P.Symbol] = []
         assignments = []
         names = []
@@ -580,6 +606,8 @@ class Analyzer:
             return RelationPlan(node, combined_scope)
         criteria: list[tuple[P.Symbol, P.Symbol]] = []
         residual: list[RowExpr] = []
+        left_extra: list[tuple[P.Symbol, RowExpr]] = []
+        right_extra: list[tuple[P.Symbol, RowExpr]] = []
         if rel.using:
             for col in rel.using:
                 ls = left.scope.resolve((col,))
@@ -593,14 +621,56 @@ class Analyzer:
                 eq = self._as_equi_criterion(c, combined_scope, left_names, right_names)
                 if eq is not None:
                     criteria.append(eq)
-                else:
-                    residual.append(_fold(self._rewrite(c, combined_scope)))
+                    continue
+                # complex equi-criterion: each side references one relation
+                # only -> project the expression onto that side
+                # (Trino: ExtractCommonPredicatesExpressionRewriter +
+                # EqualityInference in PredicatePushDown)
+                if isinstance(c, t.BinaryOp) and c.op == "=":
+                    le = _fold(self._rewrite(c.left, combined_scope))
+                    re_ = _fold(self._rewrite(c.right, combined_scope))
+                    le, re_ = _coerce_pair(le, re_)
+                    lrefs = referenced_variables(le)
+                    rrefs = referenced_variables(re_)
+                    sides = None
+                    if lrefs <= left_names and rrefs <= right_names:
+                        sides = (le, re_)
+                    elif lrefs <= right_names and rrefs <= left_names:
+                        sides = (re_, le)
+                    if sides is not None and lrefs and rrefs:
+                        lex, rex = sides
+                        if isinstance(lex, Variable):
+                            lsym = P.Symbol(lex.name, lex.type)
+                        else:
+                            lsym = P.Symbol(P.fresh_name("jk"), lex.type)
+                            left_extra.append((lsym, lex))
+                        if isinstance(rex, Variable):
+                            rsym = P.Symbol(rex.name, rex.type)
+                        else:
+                            rsym = P.Symbol(P.fresh_name("jk"), rex.type)
+                            right_extra.append((rsym, rex))
+                        criteria.append((lsym, rsym))
+                        continue
+                residual.append(_fold(self._rewrite(c, combined_scope)))
+        lnode, rnode = left.node, right.node
+        if left_extra:
+            lnode = P.Project(
+                lnode,
+                [(s, variable(s.name, s.type)) for s in lnode.output_symbols]
+                + left_extra,
+            )
+        if right_extra:
+            rnode = P.Project(
+                rnode,
+                [(s, variable(s.name, s.type)) for s in rnode.output_symbols]
+                + right_extra,
+            )
         filt = None
         if residual:
             filt = residual[0]
             for r in residual[1:]:
                 filt = special("and", T.BOOLEAN, filt, r)
-        node = P.Join(rel.join_type, left.node, right.node, criteria, filter=filt)
+        node = P.Join(rel.join_type, lnode, rnode, criteria, filter=filt)
         return RelationPlan(node, combined_scope)
 
     def _as_equi_criterion(self, c, scope, left_names, right_names):
@@ -622,8 +692,138 @@ class Analyzer:
             return sym
         return None
 
+    # ==== window functions ==============================================
+    _RANKING_WINDOW = ("row_number", "rank", "dense_rank", "ntile")
+    _VALUE_WINDOW = ("lead", "lag", "first_value", "last_value")
+    _AGG_WINDOW = ("sum", "count", "avg", "min", "max")
+
+    def _plan_windows(self, node: P.PlanNode, window_calls, rewrite_fn):
+        """Plan window functions over ``node``. One :class:`P.Window` per
+        distinct (PARTITION BY, ORDER BY, frame) spec, mirroring Trino's
+        ``WindowOperator`` grouping (``sql/planner/QueryPlanner.java``'s
+        window planning). ``rewrite_fn`` rewrites argument ASTs in the
+        enclosing context (input scope or post-aggregation replacements).
+        Returns (new_node, {window_call_ast: output_symbol})."""
+        replacements: dict[t.Node, P.Symbol] = {}
+        groups: dict[tuple, list[t.FunctionCall]] = {}
+        for fc in window_calls:
+            if fc in replacements:
+                continue
+            key = (fc.window.partition_by, fc.window.order_by, fc.window.frame)
+            groups.setdefault(key, [])
+            if fc not in groups[key]:
+                groups[key].append(fc)
+
+        for (pb, ob, frame), fcs in groups.items():
+            pre: list[tuple[P.Symbol, RowExpr]] = []
+
+            def proj(ex: RowExpr) -> P.Symbol:
+                if isinstance(ex, Variable):
+                    return P.Symbol(ex.name, ex.type)
+                sym = P.Symbol(P.fresh_name("w"), ex.type)
+                pre.append((sym, ex))
+                return sym
+
+            part_syms = [proj(_fold(rewrite_fn(p))) for p in pb]
+            orderings = [
+                self._ordering(proj(_fold(rewrite_fn(si.expression))), si)
+                for si in ob
+            ]
+            if frame is not None:
+                ftype, fstart, fend = frame
+                ok = (fstart, fend) in (
+                    ("UNBOUNDED PRECEDING", "CURRENT ROW"),
+                    ("UNBOUNDED PRECEDING", "UNBOUNDED FOLLOWING"),
+                )
+                if not ok:
+                    raise SemanticError(f"unsupported window frame: {frame}")
+            functions: list[tuple[P.Symbol, P.WindowFunction]] = []
+            for fc in fcs:
+                kind = fc.name
+                if fc.distinct:
+                    raise SemanticError("DISTINCT in window aggregates unsupported")
+                arg_expr = None
+                offset = 1
+                default = None
+                if kind in self._RANKING_WINDOW:
+                    result_type: T.SqlType = T.BIGINT
+                    if kind == "ntile":
+                        if len(fc.args) != 1:
+                            raise SemanticError("ntile takes one argument")
+                        k = _fold(rewrite_fn(fc.args[0]))
+                        if not isinstance(k, Constant) or k.value is None:
+                            raise SemanticError("ntile argument must be constant")
+                        offset = int(k.value)
+                        if offset <= 0:
+                            raise SemanticError("NTILE n must be positive")
+                    elif fc.args:
+                        raise SemanticError(f"{kind} takes no arguments")
+                    if not ob and kind != "ntile":
+                        pass  # permitted; order within partition unspecified
+                elif kind in self._VALUE_WINDOW:
+                    arg = _fold(rewrite_fn(fc.args[0]))
+                    result_type = arg.type
+                    arg_expr = variable(proj(arg).name, arg.type)
+                    if kind in ("lead", "lag"):
+                        if len(fc.args) >= 2:
+                            off = _fold(rewrite_fn(fc.args[1]))
+                            if not isinstance(off, Constant) or off.value is None:
+                                raise SemanticError(f"{kind} offset must be constant")
+                            offset = int(off.value)
+                        if len(fc.args) >= 3:
+                            d = _coerce_to(_fold(rewrite_fn(fc.args[2])), arg.type)
+                            if isinstance(d, Constant):
+                                default = d
+                            else:
+                                default = variable(proj(d).name, d.type)
+                elif kind in self._AGG_WINDOW:
+                    if len(fc.args) == 1 and isinstance(fc.args[0], t.Star):
+                        kind = "count_star"
+                        result_type = T.BIGINT
+                    else:
+                        arg = _fold(rewrite_fn(fc.args[0]))
+                        if kind == "count":
+                            result_type = T.BIGINT
+                        elif kind == "sum":
+                            if isinstance(arg.type, T.DecimalType):
+                                result_type = T.decimal(18, arg.type.scale)
+                            elif T.is_integer(arg.type):
+                                result_type = T.BIGINT
+                            else:
+                                result_type = arg.type
+                        elif kind == "avg":
+                            result_type = (
+                                arg.type
+                                if isinstance(arg.type, T.DecimalType)
+                                else T.DOUBLE
+                            )
+                        else:
+                            result_type = arg.type
+                        if kind == "avg" and not isinstance(
+                            arg.type, T.DecimalType
+                        ):
+                            arg = _coerce_to(arg, T.DOUBLE)
+                        arg_expr = variable(proj(arg).name, arg.type)
+                else:
+                    raise SemanticError(f"unknown window function: {kind}")
+                out_sym = P.Symbol(P.fresh_name(kind), result_type)
+                functions.append(
+                    (out_sym, P.WindowFunction(kind, arg_expr, result_type, offset, default))
+                )
+                replacements[fc] = out_sym
+            if pre:
+                node = P.Project(
+                    node,
+                    [(s, variable(s.name, s.type)) for s in node.output_symbols]
+                    + pre,
+                )
+            node = P.Window(node, part_syms, orderings, functions, frame)
+        return node, replacements
+
     # ==== subqueries in expressions =====================================
-    def _rewrite_with_subqueries(self, e: t.Node, rp: RelationPlan):
+    def _rewrite_with_subqueries(
+        self, e: t.Node, rp: RelationPlan, replacements=None
+    ):
         """Rewrite an expression, planning any subqueries into the relation:
         - uncorrelated scalar subquery -> CROSS join of single-row subplan
         - [NOT] IN (subquery) / EXISTS -> SEMI/ANTI join with mark symbol
@@ -685,7 +885,13 @@ class Analyzer:
                 return variable(mark.name, T.BOOLEAN)
             return None
 
-        ex = self._rewrite(e, rp.scope, subquery_handler=handle, scope_getter=lambda: state["rp"].scope)
+        ex = self._rewrite(
+            e,
+            rp.scope,
+            replacements=replacements,
+            subquery_handler=handle,
+            scope_getter=lambda: state["rp"].scope,
+        )
         return ex, state["rp"]
 
     # ==== AST normalization =============================================
@@ -887,9 +1093,45 @@ class Analyzer:
                 _coerce_to(args[1], T.DOUBLE),
             )
         if name == "length":
+            if isinstance(args[0], Constant):
+                v = args[0].value
+                return Constant(
+                    type=T.BIGINT, value=None if v is None else len(str(v))
+                )
             return call("length", T.BIGINT, args[0])
-        if name == "substr":
+        if name in ("substr", "substring"):
             return call("substr", T.VARCHAR, *args)
+        if name in ("upper", "lower", "trim", "ltrim", "rtrim", "reverse"):
+            if not T.is_string(args[0].type):
+                raise SemanticError(f"{name} requires a string argument")
+            return call(name, T.VARCHAR, args[0])
+        if name == "replace":
+            if len(args) == 2:
+                args = args + [const("", T.VARCHAR)]
+            return call("replace", T.VARCHAR, *args)
+        if name == "concat":
+            for a in args:
+                if not T.is_string(a.type) and a.type != T.UNKNOWN:
+                    raise SemanticError(
+                        "concat requires varchar arguments (add a cast)"
+                    )
+            return call("concat", T.VARCHAR, *args)
+        if name in ("lpad", "rpad"):
+            return call(name, T.VARCHAR, *args)
+        if name == "strpos":
+            if isinstance(args[0], Constant) and isinstance(args[1], Constant):
+                a, b = args[0].value, args[1].value
+                v = None if a is None or b is None else str(a).find(str(b)) + 1
+                return Constant(type=T.BIGINT, value=v)
+            return call("strpos", T.BIGINT, *args)
+        if name == "split_part":
+            return call("split_part", T.VARCHAR, *args)
+        if name == "starts_with":
+            if isinstance(args[0], Constant) and isinstance(args[1], Constant):
+                a, b = args[0].value, args[1].value
+                v = None if a is None or b is None else str(a).startswith(str(b))
+                return Constant(type=T.BOOLEAN, value=v)
+            return call("starts_with", T.BOOLEAN, *args)
         if name == "date":
             return call("cast", T.DATE, args[0])
         raise SemanticError(f"unknown function: {name}")
@@ -904,7 +1146,12 @@ class Analyzer:
             name = {"=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt", ">=": "ge"}[op]
             return _make_comparison(name, left, right)
         if op == "||":
-            raise SemanticError("string concatenation not yet supported")
+            for a in (left, right):
+                if not T.is_string(a.type) and a.type != T.UNKNOWN:
+                    raise SemanticError(
+                        "|| requires varchar operands (add a cast)"
+                    )
+            return call("concat", T.VARCHAR, left, right)
         # arithmetic, with date/interval special cases
         iv = None
         other = None
@@ -1067,6 +1314,24 @@ def _contains_aggregate(e: t.Node) -> bool:
     found = []
     _collect_aggregates(e, found)
     return bool(found)
+
+
+def _collect_windows(e: t.Node, out: list) -> None:
+    if isinstance(e, t.FunctionCall) and e.window is not None:
+        out.append(e)
+        return  # SQL forbids nested window functions
+    for f in dataclasses.fields(e) if dataclasses.is_dataclass(e) else []:
+        v = getattr(e, f.name)
+        if isinstance(v, t.Node):
+            _collect_windows(v, out)
+        elif isinstance(v, tuple):
+            for item in v:
+                if isinstance(item, t.Node):
+                    _collect_windows(item, out)
+                elif isinstance(item, tuple):
+                    for sub in item:
+                        if isinstance(sub, t.Node):
+                            _collect_windows(sub, out)
 
 
 def _collect_aggregates(e: t.Node, out: list) -> None:

@@ -214,6 +214,8 @@ class ExprCompiler:
             return jnp.power(a, b), av & bv
         if name == "like":
             return self._like(expr)
+        if name in ("length", "strpos", "starts_with"):
+            return self._string_table(expr)
         if name == "substr_pred":  # reserved for host-eval string predicates
             raise NotImplementedError
         if name == "sqrt":
@@ -359,6 +361,38 @@ class ExprCompiler:
                 col[1] & other[1],
             )
         raise NotImplementedError("cross-dictionary string comparison (remap first)")
+
+    def _string_table(self, expr: Call) -> Pair:
+        """String->numeric scalar via per-dictionary-code lookup table
+        (host precomputed, one device gather)."""
+        col_e = expr.args[0]
+        dictionary = self._arg_dictionary(col_e)
+        if dictionary is None:
+            raise ValueError(f"{expr.name} on string column without dictionary")
+        col = self._eval(col_e)
+        name = expr.name
+        if name == "length":
+            table = np.asarray([len(v) for v in dictionary.values] + [0], dtype=np.int64)
+        else:
+            lit_e = expr.args[1]
+            if not isinstance(lit_e, Constant) or lit_e.value is None:
+                raise NotImplementedError(f"{name} argument must be a literal")
+            lit = str(lit_e.value)
+            if name == "strpos":
+                table = np.asarray(
+                    [v.find(lit) + 1 for v in dictionary.values] + [0],
+                    dtype=np.int64,
+                )
+            else:  # starts_with
+                table = np.asarray(
+                    [v.startswith(lit) for v in dictionary.values] + [False],
+                    dtype=np.bool_,
+                )
+        t = jnp.asarray(table)
+        out = t[jnp.maximum(col[0], 0)]
+        if table.dtype == np.bool_:
+            out = out & (col[0] >= 0)
+        return out, col[1]
 
     def _like(self, expr: Call) -> Pair:
         col_e, pat_e = expr.args

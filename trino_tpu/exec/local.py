@@ -112,22 +112,30 @@ class LocalExecutor:
 
     # === row-preserving nodes ==========================================
     def _exec_filter(self, node: P.Filter) -> Result:
+        from trino_tpu.strings import lower_string_calls
+
         res = self._exec(node.source)
         expr = self._bind(node.predicate, res.layout)
-        mask = ExprCompiler(res.batch.columns).predicate_mask(expr)
+        cols = list(res.batch.columns)
+        expr = lower_string_calls(expr, cols)
+        mask = ExprCompiler(cols).predicate_mask(expr)
         sel = mask if res.batch.sel is None else (mask & res.batch.sel)
         return Result(
             Batch(res.batch.columns, res.batch.num_rows, sel), res.layout
         )
 
     def _exec_project(self, node: P.Project) -> Result:
+        from trino_tpu.strings import lower_string_calls
+
         res = self._exec(node.source)
-        ec = ExprCompiler(res.batch.columns)
+        work_cols = list(res.batch.columns)
         cols: list[Column] = []
         for sym, expr in node.assignments:
             bound = self._bind(expr, res.layout)
+            bound = lower_string_calls(bound, work_cols)
+            ec = ExprCompiler(work_cols)
             if isinstance(bound, InputRef):
-                cols.append(res.batch.columns[bound.channel])
+                cols.append(work_cols[bound.channel])
                 continue
             if T.is_string(sym.type):
                 if isinstance(bound, Constant):
@@ -154,7 +162,7 @@ class LocalExecutor:
                 # general string-valued expression (CASE/COALESCE/...):
                 # unify all referenced dictionaries + literals, evaluate
                 # as codes in the unified dictionary
-                new_cols, union = _unify_strings(bound, res.batch.columns)
+                new_cols, union = _unify_strings(bound, work_cols)
                 ec2 = ExprCompiler(new_cols, string_dictionary=union)
                 data, valid = ec2.evaluate(bound)
                 cols.append(
@@ -341,6 +349,126 @@ class LocalExecutor:
                     )
         return cols
 
+    # === window functions ==============================================
+    def _exec_window(self, node: P.Window) -> Result:
+        from trino_tpu.ops.window import WindowFn, WindowSpecKernel, compute_windows
+
+        res = self._exec(node.source)
+        b = res.batch
+        sel = b.selection_mask()
+
+        part_pairs, part_ranks = [], []
+        for s in node.partition_by:
+            c = res.column(s)
+            part_pairs.append((c.data, c.valid_mask()))
+            part_ranks.append(c.dictionary.ranks() if c.dictionary else None)
+        order_pairs, order_specs, order_ranks = [], [], []
+        for o in node.order_by:
+            c = res.column(o.symbol)
+            order_pairs.append((c.data, c.valid_mask()))
+            order_specs.append(o.sort_key())
+            order_ranks.append(c.dictionary.ranks() if c.dictionary else None)
+
+        # frame selection (SQL defaults; ranking fns ignore it)
+        if not node.order_by:
+            kframe = "partition"
+        elif node.frame is None:
+            kframe = "running_range"
+        else:
+            ftype, fstart, fend = node.frame
+            if fend == "UNBOUNDED FOLLOWING":
+                kframe = "partition"
+            elif ftype == "ROWS":
+                kframe = "running_rows"
+            else:
+                kframe = "running_range"
+
+        fns, args, defaults = [], [], []
+        out_dicts: list[Optional[Dictionary]] = []
+        minmax_dicts: list[Optional[Dictionary]] = []
+        for _, wf in node.functions:
+            fns.append(WindowFn(wf.kind, wf.offset, wf.default is not None))
+            out_dict = None
+            mm_dict = None
+            if wf.argument is None:
+                args.append(None)
+                defaults.append(None)
+            else:
+                sym = P.Symbol(wf.argument.name, wf.argument.type)
+                c = res.column(sym)
+                data, valid = c.data, c.valid_mask()
+                if c.dictionary is not None and wf.kind in ("min", "max"):
+                    r = jnp.asarray(c.dictionary.ranks())
+                    data = r[jnp.maximum(data, 0)]
+                    mm_dict = c.dictionary
+                elif c.dictionary is not None:
+                    out_dict = c.dictionary
+                args.append((data, valid))
+                d = None
+                if wf.default is not None:
+                    n = b.capacity
+                    if isinstance(wf.default, Constant):
+                        if wf.default.value is None:
+                            d = (
+                                jnp.zeros(n, dtype=data.dtype),
+                                jnp.zeros(n, dtype=jnp.bool_),
+                            )
+                        elif out_dict is not None:
+                            code = out_dict.encode(str(wf.default.value))
+                            if code < 0:
+                                out_dict = Dictionary(
+                                    out_dict.values + [str(wf.default.value)]
+                                )
+                                code = len(out_dict.values) - 1
+                            d = (
+                                jnp.full(n, code, dtype=data.dtype),
+                                jnp.ones(n, dtype=jnp.bool_),
+                            )
+                        else:
+                            d = (
+                                jnp.full(n, wf.default.value, dtype=data.dtype),
+                                jnp.ones(n, dtype=jnp.bool_),
+                            )
+                    else:
+                        dsym = P.Symbol(wf.default.name, wf.default.type)
+                        dc = res.column(dsym)
+                        d = (dc.data, dc.valid_mask())
+                defaults.append(d)
+            out_dicts.append(out_dict)
+            minmax_dicts.append(mm_dict)
+
+        results = compute_windows(
+            part_pairs, part_ranks, order_pairs, order_specs, order_ranks,
+            sel, fns, args, defaults, WindowSpecKernel(kframe),
+        )
+
+        cols = list(b.columns)
+        layout = dict(res.layout)
+        for (sym, wf), (data, valid), odict, mmdict in zip(
+            node.functions, results, out_dicts, minmax_dicts
+        ):
+            valid_np = np.asarray(valid)
+            if mmdict is not None:
+                # min/max over strings: ranks back to codes
+                order = np.argsort(mmdict.ranks(), kind="stable")
+                data = order[np.clip(np.asarray(data), 0, len(order) - 1)].astype(
+                    np.int32
+                )
+                col = Column(sym.type, data, valid_np, mmdict)
+            elif odict is not None:
+                col = Column(
+                    sym.type, np.asarray(data).astype(np.int32), valid_np, odict
+                )
+            else:
+                col = Column(
+                    sym.type,
+                    np.asarray(data).astype(sym.type.storage_dtype),
+                    None if valid_np.all() else valid_np,
+                )
+            cols.append(col)
+            layout[sym.name] = len(cols) - 1
+        return Result(Batch(cols, b.num_rows, b.sel), layout)
+
     def _exec_distinct(self, node: P.Distinct) -> Result:
         res = self._exec(node.source)
         syms = node.output_symbols
@@ -431,8 +559,12 @@ class LocalExecutor:
             Batch(cols, out_capacity, osel_np), layout
         )
         if node.filter is not None:
+            from trino_tpu.strings import lower_string_calls
+
             expr = self._bind(node.filter, out.layout)
-            mask = ExprCompiler(out.batch.columns).predicate_mask(expr)
+            fcols = list(out.batch.columns)
+            expr = lower_string_calls(expr, fcols)
+            mask = ExprCompiler(fcols).predicate_mask(expr)
             if node.join_type == "LEFT":
                 # filter applies to matched rows only; outer rows survive
                 mask = mask | jnp.asarray(is_outer)
@@ -454,9 +586,20 @@ class LocalExecutor:
                     merged, remap = lc.dictionary.merged(rc.dictionary)
                     remap_j = jnp.asarray(remap)
                     rd = jnp.where(rd >= 0, remap_j[jnp.maximum(rd, 0)], -1)
+            l_float = isinstance(ls.type, (T.DoubleType, T.RealType))
+            r_float = isinstance(rs.type, (T.DoubleType, T.RealType))
             ls_scale = ls.type.scale if isinstance(ls.type, T.DecimalType) else 0
             rs_scale = rs.type.scale if isinstance(rs.type, T.DecimalType) else 0
-            if ls_scale != rs_scale:
+            if l_float or r_float:
+                # decimal/integer vs double: compare in double space, keyed
+                # on the float64 bit pattern (exact per-value equality)
+                if not l_float:
+                    ld = ld.astype(jnp.float64) / (10**ls_scale)
+                if not r_float:
+                    rd = rd.astype(jnp.float64) / (10**rs_scale)
+                ld = _f64_key(ld)
+                rd = _f64_key(rd)
+            elif ls_scale != rs_scale:
                 # align scales: decimal-vs-decimal and decimal-vs-integer
                 # joins must compare equal values equal
                 s = max(ls_scale, rs_scale)
@@ -629,6 +772,17 @@ def _unify_strings(expr: RowExpr, columns: Sequence[Column]):
         codes = jnp.where(c.data >= 0, codes, -1)
         new_cols[ch] = Column(c.type, codes, c.valid, union)
     return new_cols, union
+
+
+def _f64_key(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact int64 equality key for float64 values (+0/-0 normalized).
+    f64->i64 bitcast is unsupported under TPU x64 rewriting, so bitcast to
+    two int32 lanes and recombine."""
+    x = jnp.where(x == 0.0, 0.0, x.astype(jnp.float64))
+    parts = jax.lax.bitcast_convert_type(x, jnp.int32)  # (..., 2)
+    lo = parts[..., 0].astype(jnp.int64) & 0xFFFFFFFF
+    hi = parts[..., 1].astype(jnp.int64)
+    return (hi << 32) | lo
 
 
 def _host_cast(data: np.ndarray, from_t: T.SqlType, to_t: T.SqlType) -> np.ndarray:

@@ -277,6 +277,8 @@ def prune_columns(node: P.PlanNode, required: Optional[set[str]] = None) -> P.Pl
         for _, f in node.functions:
             if f.argument is not None:
                 needed |= referenced_variables(f.argument)
+            if f.default is not None:
+                needed |= referenced_variables(f.default)
         src = prune_columns(node.source, needed - {s.name for s, _ in node.functions})
         return P.Window(src, node.partition_by, node.order_by, node.functions, node.frame)
 
