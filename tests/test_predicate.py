@@ -1,0 +1,275 @@
+"""TupleDomain predicate algebra + scan pruning.
+
+Mirrors reference tests for ``spi/predicate`` (TestTupleDomain, TestDomain,
+TestRange) and PushPredicateIntoTableScan behavior.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.ir import Call, Constant, Variable, call, const, special, variable
+from trino_tpu.predicate import (
+    Domain,
+    ExtractionResult,
+    Range,
+    TupleDomain,
+    ValueSet,
+    extract_tuple_domain,
+    to_row_expr,
+)
+
+
+def v(name):
+    return variable(name, T.BIGINT)
+
+
+class TestRange:
+    def test_basic(self):
+        r = Range.equal(5)
+        assert r.is_single_value
+        assert r.contains_value(5) and not r.contains_value(4)
+
+    def test_intersect(self):
+        a = Range.greater_or_equal(3)
+        b = Range.less_than(7)
+        c = a.intersect(b)
+        assert c.contains_value(3) and c.contains_value(6)
+        assert not c.contains_value(7) and not c.contains_value(2)
+
+    def test_empty(self):
+        assert Range.greater_than(5).intersect(Range.less_than(5)).is_empty()
+        assert Range.greater_than(5).intersect(Range.less_or_equal(5)).is_empty()
+        assert not Range.greater_or_equal(5).intersect(Range.less_or_equal(5)).is_empty()
+
+    def test_span(self):
+        s = Range.equal(1).span(Range.equal(9))
+        assert s.contains_value(5)
+
+
+class TestValueSet:
+    def test_merge_adjacent(self):
+        s = ValueSet.of_ranges([Range.less_than(5), Range.greater_or_equal(3)])
+        assert s.is_all is False
+        assert len(s.ranges) == 1
+        assert s.ranges[0].low is None and s.ranges[0].high is None
+
+    def test_points_merge(self):
+        s = ValueSet.of_values([5, 1, 5, 3])
+        assert s.discrete_values() == [1, 3, 5]
+
+    def test_intersect_union(self):
+        a = ValueSet.of_values([1, 2, 3])
+        b = ValueSet.of_values([2, 3, 4])
+        assert a.intersect(b).discrete_values() == [2, 3]
+        assert a.union(b).discrete_values() == [1, 2, 3, 4]
+
+    def test_range_point_overlap(self):
+        a = ValueSet.of_ranges([Range(10, True, 20, True)])
+        assert a.overlaps(ValueSet.of_values([15]))
+        assert not a.overlaps(ValueSet.of_values([25]))
+
+
+class TestDomain:
+    def test_stats_overlap(self):
+        d = Domain.of_values([1, 5, 9])
+        assert d.overlaps_stats(5, 5)
+        assert not d.overlaps_stats(6, 8)
+        assert d.overlaps_stats(None, None)  # no stats -> cannot prune
+        assert not Domain.only_null().overlaps_stats(1, 9, has_null=False)
+        assert Domain.only_null().overlaps_stats(1, 9, has_null=True)
+
+    def test_intersect_to_none(self):
+        assert Domain.single_value(1).intersect(Domain.single_value(2)).is_none()
+
+
+class TestTupleDomain:
+    def test_intersect(self):
+        a = TupleDomain({"x": Domain.of_values([1, 2])})
+        b = TupleDomain({"x": Domain.of_values([2, 3]), "y": Domain.not_null()})
+        c = a.intersect(b)
+        assert c.domain("x").values.discrete_values() == [2]
+        assert not c.domain("y").null_allowed
+
+    def test_contradiction(self):
+        a = TupleDomain({"x": Domain.single_value(1)})
+        b = TupleDomain({"x": Domain.single_value(2)})
+        assert a.intersect(b).is_none()
+
+    def test_column_wise_union_drops_disjoint_columns(self):
+        a = TupleDomain({"x": Domain.single_value(1), "y": Domain.single_value(9)})
+        b = TupleDomain({"x": Domain.single_value(2)})
+        u = a.column_wise_union(b)
+        assert u.domain("x").values.discrete_values() == [1, 2]
+        assert u.domain("y").is_all()
+
+    def test_stats_pruning(self):
+        td = TupleDomain({"k": Domain(ValueSet.of_ranges([Range(100, True, 200, True)]))})
+        assert td.overlaps_stats({"k": (150, 300, False)})
+        assert not td.overlaps_stats({"k": (201, 300, False)})
+        assert td.overlaps_stats({})  # no stats for the column
+
+
+class TestExtraction:
+    def test_comparisons(self):
+        res = extract_tuple_domain([call("eq", T.BOOLEAN, v("x"), const(5, T.BIGINT))])
+        assert res.tuple_domain.domain("x").values.discrete_values() == [5]
+        assert res.remaining == []
+
+        res = extract_tuple_domain([call("lt", T.BOOLEAN, const(5, T.BIGINT), v("x"))])
+        d = res.tuple_domain.domain("x")
+        assert d.contains(6) and not d.contains(5)
+
+    def test_in_between_null(self):
+        e_in = special("in", T.BOOLEAN, v("x"), const(1, T.BIGINT), const(3, T.BIGINT))
+        e_btw = special("between", T.BOOLEAN, v("y"), const(10, T.BIGINT), const(20, T.BIGINT))
+        e_nn = special("not", T.BOOLEAN, special("is_null", T.BOOLEAN, v("z")))
+        res = extract_tuple_domain([e_in, e_btw, e_nn])
+        assert res.remaining == []
+        assert res.tuple_domain.domain("x").values.discrete_values() == [1, 3]
+        assert res.tuple_domain.domain("y").contains(15)
+        assert not res.tuple_domain.domain("z").null_allowed
+
+    def test_or_same_column(self):
+        e = special(
+            "or", T.BOOLEAN,
+            call("eq", T.BOOLEAN, v("x"), const(1, T.BIGINT)),
+            call("eq", T.BOOLEAN, v("x"), const(2, T.BIGINT)),
+        )
+        res = extract_tuple_domain([e])
+        assert res.tuple_domain.domain("x").values.discrete_values() == [1, 2]
+
+    def test_or_cross_column_not_extracted(self):
+        e = special(
+            "or", T.BOOLEAN,
+            call("eq", T.BOOLEAN, v("x"), const(1, T.BIGINT)),
+            call("eq", T.BOOLEAN, v("y"), const(2, T.BIGINT)),
+        )
+        res = extract_tuple_domain([e])
+        assert res.tuple_domain.is_all()
+        assert len(res.remaining) == 1
+
+    def test_unextractable_kept_as_remaining(self):
+        e = call("eq", T.BOOLEAN, v("x"), v("y"))
+        res = extract_tuple_domain([e])
+        assert res.tuple_domain.is_all() and res.remaining == [e]
+
+    def test_compare_null_is_none(self):
+        res = extract_tuple_domain([call("eq", T.BOOLEAN, v("x"), const(None, T.BIGINT))])
+        assert res.tuple_domain.is_none()
+
+    def test_roundtrip(self):
+        td = TupleDomain(
+            {
+                "a": Domain.of_values([1, 2, 3]),
+                "b": Domain(ValueSet.of_ranges([Range(0, True, 10, False)])),
+            }
+        )
+        e = to_row_expr(td, {"a": T.BIGINT, "b": T.BIGINT})
+        res = extract_tuple_domain([e])
+        assert res.remaining == []
+        assert res.tuple_domain.domain("a").values.discrete_values() == [1, 2, 3]
+        assert res.tuple_domain.domain("b").contains(0)
+        assert not res.tuple_domain.domain("b").contains(10)
+
+
+class TestScanPruning:
+    def test_plan_gets_constraint(self, runner):
+        plan = runner.plan(
+            "select count(*) from tpch.tiny.orders where o_orderkey between 10 and 20"
+        )
+        scans = _find_scans(plan)
+        assert len(scans) == 1
+        td = scans[0].constraint
+        assert td is not None
+        assert td.domain("o_orderkey").contains(15)
+        assert not td.domain("o_orderkey").contains(21)
+
+    def test_tpch_split_pruning_counts(self):
+        from trino_tpu.connectors.tpch import TpchConnector
+        from trino_tpu.predicate import Domain, TupleDomain
+
+        conn = TpchConnector(split_rows=1000)
+        splits = conn.get_splits("tiny", "orders", 64)
+        assert len(splits) > 4
+        pruned = conn.get_splits(
+            "tiny", "orders", 64,
+            constraint=TupleDomain({"o_orderkey": Domain.of_values([5])}),
+        )
+        assert len(pruned) == 1
+        b = conn.read_split("tiny", "orders", ["o_orderkey"], pruned[0])
+        data = np.asarray(b.columns[0].data)
+        assert 5 in data
+
+    def test_memory_split_pruning(self):
+        from trino_tpu import types as T
+        from trino_tpu.columnar import Batch, Column
+        from trino_tpu.connectors.api import ColumnSchema, TableSchema
+        from trino_tpu.connectors.memory import MemoryConnector
+        from trino_tpu.predicate import Domain, TupleDomain, ValueSet, Range
+
+        conn = MemoryConnector()
+        conn.create_table(
+            "default", "t",
+            TableSchema("t", (ColumnSchema("k", T.BIGINT),)),
+        )
+        for lo in (0, 100, 200):
+            conn.insert(
+                "default", "t",
+                Batch([Column(T.BIGINT, np.arange(lo, lo + 100, dtype=np.int64))], 100),
+            )
+        td = TupleDomain({"k": Domain(ValueSet.of_ranges([Range(150, True, 160, True)]))})
+        splits = conn.get_splits("default", "t", 16, constraint=td)
+        assert len(splits) == 1
+        assert splits[0].index == 1
+
+    def test_pruned_query_still_correct(self, runner):
+        runner.assert_query(
+            "select count(*) from tpch.tiny.orders where o_orderkey between 1 and 50",
+            [(50,)],
+        )
+        runner.assert_query(
+            "select count(*) from tpch.tiny.orders where o_orderkey = -5",
+            [(0,)],
+        )
+
+    def test_zero_based_key_tables_not_overpruned(self, runner):
+        # nation/region keys start at 0 — regression for off-by-one stats
+        runner.assert_query(
+            "select n_name from tpch.tiny.nation where n_nationkey = 0",
+            [("ALGERIA",)],
+        )
+        runner.assert_query(
+            "select count(*) from tpch.tiny.region where r_regionkey = 0",
+            [(1,)],
+        )
+        runner.assert_query(
+            "select count(*) from tpch.tiny.nation where n_nationkey = 24",
+            [(1,)],
+        )
+        runner.assert_query(
+            "select count(*) from tpch.tiny.nation where n_nationkey = 25",
+            [(0,)],
+        )
+
+
+def _find_scans(node):
+    from trino_tpu.planner import plan as P
+
+    out = []
+
+    def walk(n):
+        if isinstance(n, P.TableScan):
+            out.append(n)
+        for s in n.sources:
+            walk(s)
+
+    walk(node)
+    return out
+
+
+@pytest.fixture(scope="module")
+def runner():
+    from trino_tpu.testing import LocalQueryRunner
+
+    return LocalQueryRunner()

@@ -16,6 +16,25 @@ from typing import Any, Optional, Sequence
 
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch
+from trino_tpu.predicate import TupleDomain
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnStats:
+    """Per-column statistics (reference: ``spi/statistics/ColumnStatistics``)."""
+
+    distinct_count: Optional[float] = None
+    null_fraction: Optional[float] = None
+    min_value: Any = None
+    max_value: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TableStats:
+    """Reference: ``spi/statistics/TableStatistics`` — drives the CBO."""
+
+    row_count: Optional[float] = None
+    columns: dict[str, ColumnStats] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,8 +82,41 @@ class Connector:
         raise NotImplementedError
 
     # --- splits + data ---------------------------------------------------
-    def get_splits(self, schema: str, table: str, target_splits: int) -> list[Split]:
-        return [Split(table, 0, 1)]
+    def get_splits(
+        self,
+        schema: str,
+        table: str,
+        target_splits: int,
+        constraint: Optional[TupleDomain] = None,
+    ) -> list[Split]:
+        return self.prune_splits(schema, table, [Split(table, 0, 1)], constraint)
+
+    def prune_splits(
+        self,
+        schema: str,
+        table: str,
+        splits: list[Split],
+        constraint: Optional[TupleDomain],
+    ) -> list[Split]:
+        """Drop splits whose min/max stats cannot satisfy ``constraint``
+        (reference: stripe/row-group pruning,
+        ``lib/trino-orc/.../TupleDomainOrcPredicate.java:74,92``)."""
+        if constraint is None or constraint.is_all():
+            return splits
+        if constraint.is_none():
+            return []
+        out = []
+        for s in splits:
+            stats = self.split_stats(schema, table, s)
+            if stats is None or constraint.overlaps_stats(stats):
+                out.append(s)
+        return out
+
+    def split_stats(
+        self, schema: str, table: str, split: Split
+    ) -> Optional[dict[str, tuple[Any, Any, bool]]]:
+        """column -> (min, max, has_null) for this split, or None if unknown."""
+        return None
 
     def read_split(
         self, schema: str, table: str, columns: Sequence[str], split: Split
@@ -74,6 +126,11 @@ class Connector:
     # --- optional stats (drives join distribution / sizing) -------------
     def estimate_rows(self, schema: str, table: str) -> Optional[int]:
         return None
+
+    def table_stats(self, schema: str, table: str) -> Optional[TableStats]:
+        """Reference: ``ConnectorMetadata.getTableStatistics`` — CBO input."""
+        rows = self.estimate_rows(schema, table)
+        return TableStats(row_count=rows) if rows is not None else None
 
     # --- optional write path --------------------------------------------
     def create_table(self, schema: str, table: str, schema_def: TableSchema) -> None:

@@ -33,7 +33,7 @@ class BlackHoleConnector(Connector):
     def drop_table(self, schema, table):
         self._tables.pop((schema, table), None)
 
-    def get_splits(self, schema, table, target_splits):
+    def get_splits(self, schema, table, target_splits, constraint=None):
         return [Split(table, 0, 1)]
 
     def read_split(self, schema, table, columns, split):

@@ -15,6 +15,7 @@ class MemoryConnector(Connector):
     def __init__(self):
         self._tables: dict[tuple[str, str], TableSchema] = {}
         self._data: dict[tuple[str, str], list[Batch]] = {}
+        self._stats: dict[tuple[str, str], dict[int, dict]] = {}
 
     def list_schemas(self):
         return sorted({s for s, _ in self._tables} | {"default"})
@@ -36,11 +37,13 @@ class MemoryConnector(Connector):
             raise KeyError(f"table not found: {schema}.{table}")
         compacted = batch.compact()
         self._data[(schema, table)].append(compacted)
+        self._stats.pop((schema, table), None)
         return compacted.num_rows
 
     def drop_table(self, schema, table):
         self._tables.pop((schema, table), None)
         self._data.pop((schema, table), None)
+        self._stats.pop((schema, table), None)
 
     def estimate_rows(self, schema, table):
         parts = self._data.get((schema, table))
@@ -48,10 +51,44 @@ class MemoryConnector(Connector):
             return None
         return sum(b.num_rows for b in parts)
 
-    def get_splits(self, schema, table, target_splits):
+    def get_splits(self, schema, table, target_splits, constraint=None):
         parts = self._data.get((schema, table), [])
         n = max(1, len(parts))
-        return [Split(table, i, n) for i in range(n)]
+        splits = [Split(table, i, n) for i in range(n)]
+        return self.prune_splits(schema, table, splits, constraint)
+
+    def split_stats(self, schema, table, split):
+        """Per-stored-batch min/max over numeric/date columns, computed
+        lazily and cached (reference: MemoryMetadata#getTableStatistics)."""
+        parts = self._data.get((schema, table))
+        if not parts or split.index >= len(parts):
+            return None
+        cache = self._stats.setdefault((schema, table), {})
+        if split.index not in cache:
+            import numpy as np
+
+            from trino_tpu import types as T
+
+            ts = self._tables[(schema, table)]
+            b = parts[split.index]
+            stats = {}
+            for cs, col in zip(ts.columns, b.columns):
+                if T.is_string(cs.type) or b.num_rows == 0:
+                    continue
+                data = np.asarray(col.data)[: b.num_rows]
+                vm = col.valid
+                if vm is not None:
+                    vm = np.asarray(vm)[: b.num_rows]
+                    has_null = bool((~vm).any())
+                    data = data[vm]
+                else:
+                    has_null = False
+                if data.size == 0:
+                    stats[cs.name] = (None, None, has_null)
+                else:
+                    stats[cs.name] = (data.min().item(), data.max().item(), has_null)
+            cache[split.index] = stats
+        return cache[split.index]
 
     def read_split(self, schema, table, columns: Sequence[str], split):
         ts = self._tables[(schema, table)]

@@ -190,10 +190,37 @@ class TpchConnector(Connector):
         return c[table]
 
     # --- splits ----------------------------------------------------------
-    def get_splits(self, schema, table, target_splits):
+    def get_splits(self, schema, table, target_splits, constraint=None):
         rows = self.estimate_rows(schema, table)
         n = max(1, min(target_splits, (rows + self.split_rows - 1) // self.split_rows))
-        return [Split(table, i, n) for i in range(n)]
+        splits = [Split(table, i, n) for i in range(n)]
+        return self.prune_splits(schema, table, splits, constraint)
+
+    # primary keys are sequential per split -> exact min/max stats, so a
+    # key-range constraint (incl. dynamic filters) prunes whole splits
+    # (reference: TpchSplitManager + stripe-stat pruning semantics)
+    _KEY_COLUMNS = {"orders": "o_orderkey", "lineitem": "l_orderkey",
+                    "customer": "c_custkey", "part": "p_partkey",
+                    "supplier": "s_suppkey", "nation": "n_nationkey",
+                    "region": "r_regionkey"}
+
+    # nation/region generate 0-based keys (np.arange(lo, hi)); the rest are
+    # 1-based (np.arange(lo + 1, hi + 1))
+    _ZERO_BASED_KEYS = {"nation", "region"}
+
+    def split_stats(self, schema, table, split):
+        key = self._KEY_COLUMNS.get(table)
+        if key is None:
+            return None
+        sf = scale_factor(schema)
+        base = "orders" if table == "lineitem" else table
+        total_rows = _counts(sf)[base]
+        lo, hi = self._range(total_rows, split.index, split.total)
+        if hi <= lo:
+            return {key: (None, None, False)}
+        if table in self._ZERO_BASED_KEYS:
+            return {key: (lo, hi - 1, False)}
+        return {key: (lo + 1, hi, False)}
 
     # --- data generation -------------------------------------------------
     def read_split(self, schema, table, columns, split):

@@ -79,7 +79,11 @@ class LocalExecutor:
     # === leaf nodes =====================================================
     def _exec_tablescan(self, node: P.TableScan) -> Result:
         connector = self.catalogs.get(node.catalog)
-        splits = connector.get_splits(node.schema, node.table, target_splits=64)
+        splits = connector.get_splits(
+            node.schema, node.table, target_splits=64, constraint=node.constraint
+        )
+        if not splits:
+            return Result(self._empty_batch(node), {s.name: i for i, s in enumerate(node.symbols)})
         batches = [
             connector.read_split(node.schema, node.table, node.column_names, s)
             for s in splits
@@ -87,6 +91,18 @@ class LocalExecutor:
         batch = concat_batches(batches) if len(batches) > 1 else batches[0]
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         return Result(batch, layout)
+
+    def _empty_batch(self, node: P.TableScan) -> Batch:
+        cols = [
+            Column(
+                s.type,
+                np.zeros(0, dtype=s.type.storage_dtype),
+                None,
+                Dictionary([]) if T.is_string(s.type) else None,
+            )
+            for s in node.symbols
+        ]
+        return Batch(cols, 0)
 
     def _exec_values(self, node: P.Values) -> Result:
         n = len(node.rows)

@@ -57,8 +57,13 @@ class DistributedExecutor(LocalExecutor):
         connector = self.catalogs.get(node.catalog)
         n = self.n_shards
         splits = connector.get_splits(
-            node.schema, node.table, target_splits=n * 4
+            node.schema, node.table, target_splits=n * 4, constraint=node.constraint
         )
+        if not splits:  # constraint pruned everything
+            return Result(
+                self._empty_batch(node),
+                {s.name: i for i, s in enumerate(node.symbols)},
+            )
         per_shard: list[list[Batch]] = [[] for _ in range(n)]
         for i, s in enumerate(splits):
             per_shard[i % n].append(

@@ -37,8 +37,53 @@ from trino_tpu.planner import plan as P
 
 def optimize(root: P.PlanNode, session: Session, catalogs) -> P.PlanNode:
     root = push_down_predicates(root)
+    root = push_into_scans(root)
     root = prune_columns(root)
     return root
+
+
+# === predicate -> TupleDomain pushdown into scans ==========================
+# Reference: iterative/rule/PushPredicateIntoTableScan.java + DomainTranslator.
+# The extracted constraint drives connector split pruning and dynamic-filter
+# intersection; the Filter stays in place (constraint is unenforced).
+
+
+def push_into_scans(node: P.PlanNode) -> P.PlanNode:
+    from trino_tpu.predicate import extract_tuple_domain
+
+    if isinstance(node, P.Filter) and isinstance(node.source, P.TableScan):
+        scan = node.source
+        res = extract_tuple_domain(_conjuncts(node.predicate))
+        td = res.tuple_domain
+        if not td.is_all():
+            # rekey symbol names -> connector column names
+            sym_to_col = {
+                s.name: c for s, c in zip(scan.symbols, scan.column_names)
+            }
+            if td.is_none():
+                constraint = td
+            else:
+                from trino_tpu.predicate import TupleDomain
+
+                constraint = TupleDomain(
+                    {
+                        sym_to_col[k]: v
+                        for k, v in td.domains.items()
+                        if k in sym_to_col
+                    }
+                )
+            if scan.constraint is not None:
+                constraint = scan.constraint.intersect(constraint)
+            new_scan = P.TableScan(
+                scan.catalog, scan.schema, scan.table, scan.symbols,
+                scan.column_names, scan.pushed_predicate, constraint,
+            )
+            return P.Filter(new_scan, node.predicate)
+        return node
+    new_sources = [push_into_scans(s) for s in node.sources]
+    if new_sources:
+        return _replace_sources(node, new_sources)
+    return node
 
 
 # === predicate pushdown ====================================================
@@ -224,6 +269,7 @@ def prune_columns(node: P.PlanNode, required: Optional[set[str]] = None) -> P.Pl
         return P.TableScan(
             node.catalog, node.schema, node.table,
             [s for s, _ in keep], [c for _, c in keep], node.pushed_predicate,
+            node.constraint,
         )
 
     if isinstance(node, P.Aggregate):

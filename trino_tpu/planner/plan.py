@@ -71,6 +71,10 @@ class TableScan(PlanNode):
     column_names: list[str]
     # predicate pushed into the connector (reference: TupleDomain pushdown)
     pushed_predicate: Optional[RowExpr] = None
+    # extracted TupleDomain over *column names*, for split pruning and
+    # connector applyFilter (reference: PushPredicateIntoTableScan.java);
+    # advisory — the enclosing Filter still applies the full predicate
+    constraint: Optional[Any] = None
 
     @property
     def output_symbols(self):
