@@ -1,0 +1,167 @@
+"""Dynamic filtering: build-side domains prune probe scans at runtime.
+
+Mirrors reference tests ``execution/TestCoordinatorDynamicFiltering.java``
+and DynamicFilterService unit tests.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.config import Session
+from trino_tpu.dynfilter import domain_from_build, push_probe_domain
+from trino_tpu.planner import plan as P
+from trino_tpu.predicate import Domain
+from trino_tpu.testing import DistributedQueryRunner, LocalQueryRunner
+
+
+class TestDomainFromBuild:
+    def test_discrete(self):
+        d = domain_from_build(np.array([3, 1, 3, 7]), None, T.BIGINT)
+        assert d.values.discrete_values() == [1, 3, 7]
+        assert not d.null_allowed
+
+    def test_range_fallback(self):
+        data = np.arange(10_000, dtype=np.int64)
+        d = domain_from_build(data, None, T.BIGINT)
+        assert d.values.discrete_values() is None
+        assert d.contains(5000) and not d.contains(10_000)
+
+    def test_nulls_excluded(self):
+        d = domain_from_build(
+            np.array([1, 2, 3]), np.array([True, False, True]), T.BIGINT
+        )
+        assert d.values.discrete_values() == [1, 3]
+
+    def test_empty_build_gives_none_domain(self):
+        d = domain_from_build(np.array([], dtype=np.int64), None, T.BIGINT)
+        assert d.is_none()
+
+    def test_strings_skipped(self):
+        assert domain_from_build(np.array([1, 2]), None, T.VARCHAR) is None
+
+    def test_convert_decimal_to_bigint(self):
+        from trino_tpu.dynfilter import convert_domain
+
+        # DECIMAL(3,2) storage {500, 250} -> BIGINT {5} (2.50 drops: no
+        # integer probe value equals 2.50)
+        d = Domain.of_values([500, 250], T.decimal(3, 2))
+        out = convert_domain(d, T.decimal(3, 2), T.BIGINT)
+        assert out.values.discrete_values() == [5]
+
+    def test_convert_bigint_to_decimal(self):
+        from trino_tpu.dynfilter import convert_domain
+
+        d = Domain.of_values([5], T.BIGINT)
+        out = convert_domain(d, T.BIGINT, T.decimal(10, 2))
+        assert out.values.discrete_values() == [500]
+
+    def test_convert_incompatible_returns_none(self):
+        from trino_tpu.dynfilter import convert_domain
+
+        d = Domain.of_values([5], T.BIGINT)
+        assert convert_domain(d, T.BIGINT, T.DOUBLE) is None
+
+
+class TestPushProbeDomain:
+    def test_reaches_scan_through_filter_project(self):
+        from trino_tpu.ir import variable
+
+        sym = P.Symbol("k", T.BIGINT)
+        scan = P.TableScan("tpch", "tiny", "orders", [sym], ["o_orderkey"])
+        proj = P.Project(scan, [(P.Symbol("k2", T.BIGINT), variable("k", T.BIGINT))])
+        out = push_probe_domain(proj, P.Symbol("k2", T.BIGINT), Domain.of_values([5]))
+        # scan at the bottom must carry the constraint
+        def find_scan(n):
+            if isinstance(n, P.TableScan):
+                return n
+            for s in n.sources:
+                r = find_scan(s)
+                if r is not None:
+                    return r
+            return None
+
+        s = find_scan(out)
+        assert s.constraint is not None
+        assert s.constraint.domain("o_orderkey").contains(5)
+
+    def test_does_not_descend_null_extended_side(self):
+        sym_l = P.Symbol("a", T.BIGINT)
+        sym_r = P.Symbol("b", T.BIGINT)
+        scan_l = P.TableScan("tpch", "tiny", "orders", [sym_l], ["o_orderkey"])
+        scan_r = P.TableScan("tpch", "tiny", "customer", [sym_r], ["c_custkey"])
+        join = P.Join("LEFT", scan_l, scan_r, [(sym_l, sym_r)])
+        out = push_probe_domain(join, sym_r, Domain.of_values([5]))
+        # right side of LEFT join is null-extended: must NOT get a constraint
+        assert isinstance(out, P.Filter)  # filter applied above instead
+        assert out.source is join or isinstance(out.source, P.Join)
+        assert join.right.constraint is None
+
+
+class TestEndToEnd:
+    @pytest.fixture(scope="class")
+    def runner(self):
+        return LocalQueryRunner()
+
+    def test_join_collects_filter_and_prunes(self, runner):
+        from trino_tpu.exec.local import LocalExecutor
+
+        q = (
+            "select count(*) from tpch.tiny.lineitem l "
+            "join tpch.tiny.orders o on l.l_orderkey = o.o_orderkey "
+            "where o.o_orderkey <= 40"
+        )
+        plan = runner.plan(q)
+        ex = LocalExecutor(runner.catalogs, runner.session)
+        batch, _ = ex.execute(plan)
+        assert len(ex.dynamic_filters) >= 1
+        df = ex.dynamic_filters[0]
+        assert df.symbol.startswith("l_orderkey")
+        assert df.kind == "discrete"
+        # oracle
+        expect, _ = LocalQueryRunner(
+            _session_without_df()
+        ).execute(q)
+        assert batch.to_pylist() == expect
+
+    def test_disabled_by_session(self, runner):
+        from trino_tpu.exec.local import LocalExecutor
+
+        s = _session_without_df()
+        r = LocalQueryRunner(s)
+        plan = r.plan(
+            "select count(*) from tpch.tiny.lineitem l "
+            "join tpch.tiny.orders o on l.l_orderkey = o.o_orderkey "
+            "where o.o_orderkey <= 40"
+        )
+        ex = LocalExecutor(r.catalogs, s)
+        ex.execute(plan)
+        assert ex.dynamic_filters == []
+
+    def test_left_join_unaffected(self, runner):
+        # LEFT join must not dynamic-filter the probe (all left rows kept)
+        q = (
+            "select count(*) from tpch.tiny.customer c "
+            "left join tpch.tiny.orders o on c.c_custkey = o.o_custkey "
+            "and o.o_orderkey <= 10"
+        )
+        got, _ = runner.execute(q)
+        base, _ = LocalQueryRunner(_session_without_df()).execute(q)
+        assert got == base
+
+    def test_distributed_matches_local(self):
+        q = (
+            "select o.o_orderpriority, count(*) c from tpch.tiny.lineitem l "
+            "join tpch.tiny.orders o on l.l_orderkey = o.o_orderkey "
+            "where o.o_orderkey between 100 and 200 "
+            "group by o.o_orderpriority"
+        )
+        local, _ = LocalQueryRunner().execute(q)
+        dist, _ = DistributedQueryRunner().execute(q)
+        assert sorted(local) == sorted(dist)
+
+
+def _session_without_df() -> Session:
+    s = Session()
+    s.set("enable_dynamic_filtering", False)
+    return s

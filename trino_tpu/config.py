@@ -50,6 +50,7 @@ class Session:
         ("batch_capacity", 1 << 16),  # padded kernel batch rows
         ("broadcast_join_threshold_rows", 1 << 22),
         ("enable_dynamic_filtering", True),
+        ("dynamic_filtering_max_build_rows", 1 << 20),
         ("tpu_enabled", True),
     )
 

@@ -189,6 +189,59 @@ class TpchConnector(Connector):
             return c["orders"] * 4
         return c[table]
 
+    def table_stats(self, schema, table):
+        """Column statistics derived from the generator's known value
+        domains (reference: ``plugin/trino-tpch/.../statistics/`` — the
+        reference likewise ships precomputed stats for the CBO)."""
+        from trino_tpu.connectors.api import ColumnStats, TableStats
+
+        sf = scale_factor(schema)
+        c = _counts(sf)
+        rows = float(self.estimate_rows(schema, table))
+        key = self._KEY_COLUMNS.get(table)
+        cols: dict[str, ColumnStats] = {}
+        if key is not None:
+            base = "orders" if table == "lineitem" else table
+            nkeys = c[base]
+            lo = 0 if table in self._ZERO_BASED_KEYS else 1
+            cols[key] = ColumnStats(float(nkeys), 0.0, lo, lo + nkeys - 1)
+        fks = {
+            "nation": [("n_regionkey", "region", 0)],
+            "supplier": [("s_nationkey", "nation", 0)],
+            "customer": [("c_nationkey", "nation", 0)],
+            "orders": [("o_custkey", "customer", 1)],
+            "partsupp": [("ps_partkey", "part", 1), ("ps_suppkey", "supplier", 1)],
+            "lineitem": [("l_partkey", "part", 1), ("l_suppkey", "supplier", 1)],
+        }
+        for col, ref, lo in fks.get(table, []):
+            n = c[ref]
+            cols[col] = ColumnStats(float(n), 0.0, lo, lo + n - 1)
+        low_card = {
+            "o_orderstatus": 3, "o_orderpriority": 5, "o_shippriority": 1,
+            "l_returnflag": 3, "l_linestatus": 2,
+            "l_shipmode": len(_SHIPMODES), "l_shipinstruct": len(_INSTRUCTS),
+            "c_mktsegment": len(_SEGMENTS), "n_name": 25, "r_name": 5,
+            "p_brand": len(_BRANDS), "p_type": len(_TYPES),
+            "p_container": len(_CONTAINERS), "p_size": 50,
+        }
+        dates = {
+            "o_orderdate": (_EPOCH_START, _EPOCH_END),
+            "l_shipdate": (_EPOCH_START, _EPOCH_END + 121),
+            "l_commitdate": (_EPOCH_START, _EPOCH_END + 121),
+            "l_receiptdate": (_EPOCH_START, _EPOCH_END + 151),
+        }
+        for name, _ty in _SCHEMAS[table]:
+            if name in cols:
+                continue
+            if name in low_card:
+                cols[name] = ColumnStats(float(low_card[name]), 0.0)
+            elif name in dates:
+                lo_d, hi_d = dates[name]
+                cols[name] = ColumnStats(
+                    float(min(rows, hi_d - lo_d + 1)), 0.0, lo_d, hi_d
+                )
+        return TableStats(row_count=rows, columns=cols)
+
     # --- splits ----------------------------------------------------------
     def get_splits(self, schema, table, target_splits, constraint=None):
         rows = self.estimate_rows(schema, table)

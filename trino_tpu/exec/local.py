@@ -58,6 +58,8 @@ class LocalExecutor:
     def __init__(self, catalogs: CatalogManager, session: Session):
         self.catalogs = catalogs
         self.session = session
+        # collected dynamic-filter stats (DynamicFilterService analog)
+        self.dynamic_filters: list = []
 
     # === entry ==========================================================
     def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
@@ -527,9 +529,53 @@ class LocalExecutor:
             return res  # layout covers both sides; order fixed by Output
         if node.join_type not in ("INNER", "LEFT"):
             raise ExecutionError(f"join type {node.join_type} not supported yet")
-        left = self._exec(node.left)  # probe
-        right = self._exec(node.right)  # build
+        right = self._exec(node.right)  # build first: enables dynamic filter
+        left_plan = self._apply_dynamic_filters(node, right)
+        left = self._exec(left_plan)  # probe
         return self._join_result(node, left, right)
+
+    def _apply_dynamic_filters(self, node: P.Join, build: Result) -> P.PlanNode:
+        """Collect build-side key domains and push them into the probe plan
+        (reference: DynamicFilterSourceOperator -> DynamicFilterService ->
+        probe scans; here synchronous since the build is materialized)."""
+        from trino_tpu.dynfilter import (
+            DynamicFilterStats,
+            convert_domain,
+            domain_from_build,
+            push_probe_domain,
+        )
+
+        left_plan = node.left
+        if (
+            node.join_type != "INNER"
+            or not node.criteria
+            or not self.session.get("enable_dynamic_filtering")
+        ):
+            return left_plan
+        build_rows = int(build.batch.count_rows())
+        if build_rows > int(self.session.get("dynamic_filtering_max_build_rows")):
+            return left_plan
+        sel = np.asarray(build.batch.selection_mask())
+        for lsym, rsym in node.criteria:
+            col = build.column(rsym)
+            valid = np.asarray(col.valid_mask()) & sel
+            domain = domain_from_build(np.asarray(col.data), valid, col.type)
+            if domain is None or domain.is_all():
+                continue
+            domain = convert_domain(domain, col.type, lsym.type)
+            if domain is None or domain.is_all():
+                continue
+            dv = domain.values.discrete_values()
+            self.dynamic_filters.append(
+                DynamicFilterStats(
+                    lsym.name,
+                    "none" if domain.is_none() else ("discrete" if dv is not None else "range"),
+                    len(dv) if dv else 0,
+                    build_rows,
+                )
+            )
+            left_plan = push_probe_domain(left_plan, lsym, domain)
+        return left_plan
 
     def _join_result(self, node: P.Join, left: Result, right: Result) -> Result:
         lkeys, rkeys = self._join_keys(left, right, node.criteria)

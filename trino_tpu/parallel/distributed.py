@@ -358,8 +358,8 @@ class DistributedExecutor(LocalExecutor):
     def _exec_join(self, node: P.Join) -> Result:
         if node.join_type in ("CROSS", "SEMI", "ANTI", "RIGHT"):
             return super()._exec_join(node)
-        left = self._exec(node.left)
-        right = self._exec(node.right)
+        right = self._exec(node.right)  # build first: enables dynamic filter
+        left = self._exec(self._apply_dynamic_filters(node, right))
         if not (_is_sharded(left.batch) or _is_sharded(right.batch)):
             return self._local_join(node, left, right)
         if not node.criteria:

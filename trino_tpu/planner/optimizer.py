@@ -36,8 +36,15 @@ from trino_tpu.planner import plan as P
 
 
 def optimize(root: P.PlanNode, session: Session, catalogs) -> P.PlanNode:
+    from trino_tpu.planner.joins import determine_join_distribution, reorder_joins
+    from trino_tpu.planner.stats import StatsCalculator
+
     root = push_down_predicates(root)
     root = push_into_scans(root)
+    stats = StatsCalculator(catalogs)
+    if session.get("join_reordering_strategy") == "AUTOMATIC":
+        root = reorder_joins(root, stats, session)
+    root = determine_join_distribution(root, stats, session)
     root = prune_columns(root)
     return root
 
