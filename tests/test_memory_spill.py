@@ -1,0 +1,156 @@
+"""Memory accounting, pool limits, and spill-to-host partitioned execution.
+
+Mirrors reference tests for ``lib/trino-memory-context``, ``memory/``
+(TestMemoryPools, TestMemoryManager) and
+``tests/TestDistributedSpilledQueries.java`` (spilled results == unspilled).
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.config import Session
+from trino_tpu.memory import (
+    ExceededMemoryLimitError,
+    MemoryPool,
+    QueryMemoryContext,
+    batch_nbytes,
+)
+from trino_tpu.testing import LocalQueryRunner
+
+
+class TestMemoryPool:
+    def test_reserve_free(self):
+        pool = MemoryPool(1000)
+        assert pool.try_reserve("q1", 600)
+        assert not pool.try_reserve("q2", 600)
+        assert pool.try_reserve("q2", 400)
+        pool.free("q1", 600)
+        assert pool.free_bytes == 600
+
+    def test_largest_query_policy(self):
+        pool = MemoryPool(1000)
+        pool.try_reserve("small", 100)
+        pool.try_reserve("big", 500)
+        assert pool.largest_query() == "big"
+
+    def test_query_limit(self):
+        pool = MemoryPool(10_000)
+        ctx = QueryMemoryContext(pool, "q", max_bytes=100)
+        ctx.reserve(80)
+        with pytest.raises(ExceededMemoryLimitError):
+            ctx.reserve(50)
+
+    def test_pool_exhaustion_raises(self):
+        pool = MemoryPool(100)
+        ctx = QueryMemoryContext(pool, "q")
+        with pytest.raises(ExceededMemoryLimitError):
+            ctx.reserve(200)
+
+    def test_revoke_hook_called(self):
+        pool = MemoryPool(100)
+        pool.try_reserve("other", 80)
+        freed = []
+
+        def revoke(n):
+            pool.free("other", 80)
+            freed.append(n)
+            return 80
+
+        ctx = QueryMemoryContext(pool, "q", on_revoke=revoke)
+        ctx.reserve(60)
+        assert freed == [60]
+
+    def test_peak_tracking(self):
+        pool = MemoryPool(1000)
+        ctx = QueryMemoryContext(pool, "q")
+        ctx.reserve(300)
+        ctx.free(200)
+        ctx.reserve(100)
+        assert ctx.peak_bytes == 300
+
+    def test_batch_nbytes(self):
+        from trino_tpu import types as T
+        from trino_tpu.columnar import Batch, Column
+
+        b = Batch([Column(T.BIGINT, np.zeros(100, dtype=np.int64))], 100)
+        assert batch_nbytes(b) == 800
+
+
+class TestQueryAccounting:
+    def test_query_runs_with_accounting(self):
+        r = LocalQueryRunner()
+        rows, _ = r.execute(
+            "select o_orderpriority, count(*) from tpch.tiny.orders "
+            "group by o_orderpriority"
+        )
+        assert len(rows) == 5
+        # everything freed at query end
+        assert r.memory_pool.reserved == 0
+
+    def test_query_killed_over_limit(self):
+        s = Session()
+        s.set("query_max_memory_bytes", 1000)  # absurdly small
+        r = LocalQueryRunner(s)
+        with pytest.raises(ExceededMemoryLimitError):
+            r.execute("select count(*) from tpch.tiny.orders")
+        assert r.memory_pool.reserved == 0
+
+
+class TestSpill:
+    def test_spilled_join_matches_unspilled(self):
+        q = (
+            "select o.o_orderpriority, count(*) c from tpch.tiny.lineitem l "
+            "join tpch.tiny.orders o on l.l_orderkey = o.o_orderkey "
+            "group by o.o_orderpriority"
+        )
+        base, _ = LocalQueryRunner().execute(q)
+        s = Session()
+        s.set("spill_threshold_rows", 1000)  # force partitioned path
+        s.set("spill_partitions", 4)
+        spilled, _ = LocalQueryRunner(s).execute(q)
+        assert sorted(base) == sorted(spilled)
+
+    def test_spilled_left_join_matches(self):
+        q = (
+            "select count(*), count(o.o_orderkey) from tpch.tiny.customer c "
+            "left join tpch.tiny.orders o on c.c_custkey = o.o_custkey"
+        )
+        base, _ = LocalQueryRunner().execute(q)
+        s = Session()
+        s.set("spill_threshold_rows", 500)
+        spilled, _ = LocalQueryRunner(s).execute(q)
+        assert base == spilled
+
+    def test_spilled_aggregation_matches(self):
+        q = (
+            "select l_orderkey, sum(l_quantity) q, count(*) c "
+            "from tpch.tiny.lineitem group by l_orderkey"
+        )
+        base, _ = LocalQueryRunner().execute(q)
+        s = Session()
+        s.set("spill_threshold_rows", 1000)
+        s.set("spill_partitions", 4)
+        spilled, _ = LocalQueryRunner(s).execute(q)
+        assert sorted(base) == sorted(spilled)
+        assert len(base) > 10_000
+
+    def test_spilled_string_group_keys(self):
+        q = (
+            "select l_shipmode, l_returnflag, count(*) c from tpch.tiny.lineitem "
+            "group by l_shipmode, l_returnflag"
+        )
+        base, _ = LocalQueryRunner().execute(q)
+        s = Session()
+        s.set("spill_threshold_rows", 1000)
+        spilled, _ = LocalQueryRunner(s).execute(q)
+        assert sorted(base) == sorted(spilled)
+
+    def test_spill_disabled_by_session(self):
+        s = Session()
+        s.set("spill_enabled", False)
+        s.set("spill_threshold_rows", 10)
+        rows, _ = LocalQueryRunner(s).execute(
+            "select count(*) from tpch.tiny.orders o "
+            "join tpch.tiny.customer c on o.o_custkey = c.c_custkey"
+        )
+        assert rows == [(15000,)]

@@ -1,0 +1,194 @@
+"""HTTP server + client protocol tests.
+
+Mirrors reference tests: ``TestQueuedStatementResource``, protocol tests in
+``client/trino-client``, ``tests/TestGracefulShutdown.java``,
+``TestingTrinoServer``-based integration (real HTTP in one process).
+"""
+
+import json
+import time
+import urllib.request
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu.client import ClientSession, Connection, QueryFailure, StatementClient
+
+
+@pytest.fixture(scope="module")
+def server():
+    from trino_tpu.server.http import TrinoTpuServer
+
+    s = TrinoTpuServer().start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def conn(server):
+    return Connection(server.base_uri)
+
+
+class TestProtocol:
+    def test_simple_query(self, conn):
+        rows, names = conn.execute("select 1 as x, 'a' as s")
+        assert rows == [(1, "a")]
+        assert names == ["x", "s"]
+
+    def test_tpch_aggregation(self, conn):
+        rows, names = conn.execute(
+            "select o_orderpriority, count(*) c from tpch.tiny.orders "
+            "group by o_orderpriority order by o_orderpriority"
+        )
+        assert len(rows) == 5
+        assert sum(r[1] for r in rows) == 15000
+
+    def test_decimal_typed(self, conn):
+        rows, _ = conn.execute("select sum(o_totalprice) from tpch.tiny.orders")
+        assert isinstance(rows[0][0], Decimal)
+
+    def test_multi_page_results(self, server):
+        # > PAGE_ROWS rows forces several nextUri fetches
+        client = StatementClient(
+            server.base_uri,
+            "select o_orderkey from tpch.tiny.orders",
+            ClientSession(),
+        )
+        rows = list(client.rows())
+        assert len(rows) == 15000
+        assert client.stats["state"] == "FINISHED"
+
+    def test_query_failure_semantic(self, conn):
+        with pytest.raises(QueryFailure) as ei:
+            conn.execute("select no_such_column from tpch.tiny.orders")
+        assert ei.value.error["errorType"] == "USER_ERROR"
+
+    def test_query_failure_syntax(self, conn):
+        with pytest.raises(QueryFailure) as ei:
+            conn.execute("selectt 1")
+        assert ei.value.error["errorName"] in ("SYNTAX_ERROR", "SEMANTIC_ERROR")
+
+    def test_session_properties_via_headers(self, server):
+        sess = ClientSession(properties={"join_reordering_strategy": "NONE"})
+        rows, _ = Connection(server.base_uri, sess).execute(
+            "select count(*) from tpch.tiny.nation n join tpch.tiny.region r "
+            "on n.n_regionkey = r.r_regionkey"
+        )
+        assert rows == [(25,)]
+
+    def test_set_session_roundtrip(self, server):
+        sess = ClientSession()
+        c = Connection(server.base_uri, sess)
+        c.execute("set session join_distribution_type = 'PARTITIONED'")
+        # server sent X-Trino-Set-Session; client session carries it now
+        assert "join_distribution_type" in sess.properties
+
+    def test_ddl_roundtrip(self, conn):
+        conn.session.catalog = "memory"
+        conn.session.schema = "default"
+        try:
+            conn.execute(
+                "create table memory.default.t_server as "
+                "select 1 as a, 'x' as b union all select 2, 'y'"
+            )
+            rows, _ = conn.execute("select a, b from memory.default.t_server order by a")
+            assert rows == [(1, "x"), (2, "y")]
+            conn.execute("insert into memory.default.t_server select 3, 'z'")
+            rows, _ = conn.execute("select count(*) from memory.default.t_server")
+            assert rows == [(3,)]
+        finally:
+            conn.execute("drop table if exists memory.default.t_server")
+
+    def test_show_statements(self, conn):
+        rows, _ = conn.execute("show catalogs")
+        assert ("tpch",) in rows and ("memory",) in rows
+        rows, _ = conn.execute("show schemas from tpch")
+        assert ("tiny",) in rows
+        rows, _ = conn.execute("show tables from tpch.tiny")
+        assert ("orders",) in rows
+        rows, _ = conn.execute("show columns from tpch.tiny.orders")
+        assert any(r[0] == "o_orderkey" for r in rows)
+
+    def test_explain(self, conn):
+        rows, _ = conn.execute("explain select count(*) from tpch.tiny.orders")
+        text = "\n".join(r[0] for r in rows)
+        assert "Aggregate" in text and "TableScan" in text
+
+
+class TestNodeEndpoints:
+    def test_info(self, server):
+        info = Connection(server.base_uri).server_info()
+        assert info["coordinator"] is True
+
+    def test_status_memory(self, server):
+        with urllib.request.urlopen(f"{server.base_uri}/v1/status") as r:
+            st = json.loads(r.read().decode())
+        assert st["memoryInfo"]["totalNodeMemory"] > 0
+
+    def test_query_listing(self, server, conn):
+        conn.execute("select 42")
+        queries = Connection(server.base_uri).list_queries()
+        assert any("42" in q["query"] for q in queries)
+        finished = [q for q in queries if q["state"] == "FINISHED"]
+        assert finished
+        qid = finished[0]["queryId"]
+        with urllib.request.urlopen(f"{server.base_uri}/v1/query/{qid}") as r:
+            detail = json.loads(r.read().decode())
+        assert detail["queryId"] == qid
+
+
+class TestGracefulShutdown:
+    def test_shutdown_drains(self):
+        from trino_tpu.server.http import TrinoTpuServer
+
+        s = TrinoTpuServer().start()
+        c = Connection(s.base_uri)
+        c.execute("select 1")
+        req = urllib.request.Request(
+            f"{s.base_uri}/v1/info/state",
+            data=b'"SHUTTING_DOWN"',
+            method="PUT",
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        # new queries refused while draining
+        deadline = time.time() + 5
+        refused = False
+        while time.time() < deadline:
+            try:
+                c.execute("select 1")
+            except Exception:
+                refused = True
+                break
+            time.sleep(0.05)
+        assert refused
+
+
+class TestCli:
+    def test_execute_aligned(self, server, capsys):
+        from trino_tpu.cli import main
+
+        rc = main(
+            ["--server", server.base_uri, "--execute",
+             "select 1 as a, 'x' as b", "--output-format", "ALIGNED"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "a" in out and "x" in out and "1 row" in out
+
+    def test_execute_csv(self, server, capsys):
+        from trino_tpu.cli import main
+
+        rc = main(
+            ["--server", server.base_uri, "--execute",
+             "select 1, 2 union all select 3, 4", "--output-format", "CSV"]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert sorted(out) == ["1,2", "3,4"]
+
+    def test_failure_exit_code(self, server, capsys):
+        from trino_tpu.cli import main
+
+        rc = main(["--server", server.base_uri, "--execute", "select bogus_col from tpch.tiny.orders"])
+        assert rc == 1

@@ -1,0 +1,168 @@
+"""Client protocol library + statement client.
+
+Reference: ``client/trino-client/src/main/java/io/trino/client/StatementClientV1.java:62,125,324``
+— POST /v1/statement, then follow ``nextUri`` until absent; typed results
+via ``columns``; session mutations via ``X-Trino-Set-Session`` headers.
+Stdlib ``urllib`` only (the reference uses OkHttp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from decimal import Decimal
+from typing import Any, Iterator, Optional
+
+HEADER = "X-Trino"
+
+
+class QueryFailure(Exception):
+    def __init__(self, error: dict):
+        self.error = error
+        super().__init__(
+            f"{error.get('errorName', 'ERROR')}: {error.get('message', '')}"
+        )
+
+
+@dataclasses.dataclass
+class Column:
+    name: str
+    type: str
+
+
+class StatementClient:
+    """Drives one statement through the paged protocol."""
+
+    def __init__(self, base_uri: str, sql: str, session: "ClientSession"):
+        self.base_uri = base_uri.rstrip("/")
+        self.sql = sql
+        self.session = session
+        self.columns: Optional[list[Column]] = None
+        self.update_type: Optional[str] = None
+        self.update_count: Optional[int] = None
+        self.stats: dict = {}
+        self.query_id: Optional[str] = None
+        self._next_uri: Optional[str] = None
+        self._current_data: list[list[Any]] = []
+        self._started = False
+
+    # --- protocol ---------------------------------------------------------
+
+    def _headers(self) -> dict[str, str]:
+        h = {
+            f"{HEADER}-User": self.session.user,
+            f"{HEADER}-Source": "trino-tpu-client",
+        }
+        if self.session.catalog:
+            h[f"{HEADER}-Catalog"] = self.session.catalog
+        if self.session.schema:
+            h[f"{HEADER}-Schema"] = self.session.schema
+        if self.session.properties:
+            h[f"{HEADER}-Session"] = ",".join(
+                f"{k}={urllib.parse.quote(str(v))}"
+                for k, v in self.session.properties.items()
+            )
+        return h
+
+    def _request(self, method: str, uri: str, body: Optional[bytes] = None) -> dict:
+        req = urllib.request.Request(uri, data=body, method=method)
+        for k, v in self._headers().items():
+            req.add_header(k, v)
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            set_session = resp.headers.get(f"{HEADER}-Set-Session")
+            if set_session and "=" in set_session:
+                k, v = set_session.split("=", 1)
+                self.session.properties[k] = urllib.parse.unquote(v)
+            return json.loads(resp.read().decode())
+
+    def _advance_state(self, payload: dict) -> None:
+        self.query_id = payload.get("id", self.query_id)
+        self.stats = payload.get("stats", self.stats)
+        if "columns" in payload and self.columns is None:
+            self.columns = [
+                Column(c["name"], c["type"]) for c in payload["columns"]
+            ]
+        self.update_type = payload.get("updateType", self.update_type)
+        if "updateCount" in payload:
+            self.update_count = payload["updateCount"]
+        if payload.get("error"):
+            raise QueryFailure(payload["error"])
+        self._current_data = payload.get("data", [])
+        self._next_uri = payload.get("nextUri")
+
+    def advance(self) -> bool:
+        """POST on first call, then follow nextUri (StatementClientV1.advance)."""
+        if not self._started:
+            self._started = True
+            payload = self._request(
+                "POST", f"{self.base_uri}/v1/statement", self.sql.encode()
+            )
+            self._advance_state(payload)
+            return True
+        if self._next_uri is None:
+            return False
+        self._advance_state(self._request("GET", self._next_uri))
+        return True
+
+    def cancel(self) -> None:
+        if self._next_uri is not None:
+            try:
+                self._request("DELETE", self._next_uri)
+            except urllib.error.HTTPError:
+                pass
+        self._next_uri = None
+
+    # --- results ----------------------------------------------------------
+
+    def rows(self) -> Iterator[tuple]:
+        """All rows, typed (decimal strings -> Decimal)."""
+        while self.advance():
+            types = [c.type for c in self.columns] if self.columns else []
+            for row in self._current_data:
+                yield tuple(
+                    _decode_value(v, types[i] if i < len(types) else "")
+                    for i, v in enumerate(row)
+                )
+
+
+def _decode_value(v: Any, type_: str) -> Any:
+    if v is None:
+        return None
+    if type_.startswith("decimal"):
+        return Decimal(v)
+    return v
+
+
+@dataclasses.dataclass
+class ClientSession:
+    user: str = "user"
+    catalog: Optional[str] = "tpch"
+    schema: Optional[str] = "tiny"
+    properties: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class Connection:
+    """DB-API-flavored convenience wrapper (the trino-jdbc analog tier)."""
+
+    def __init__(self, base_uri: str, session: Optional[ClientSession] = None):
+        self.base_uri = base_uri
+        self.session = session or ClientSession()
+
+    def execute(self, sql: str) -> tuple[list[tuple], list[str]]:
+        client = StatementClient(self.base_uri, sql, self.session)
+        rows = list(client.rows())
+        names = [c.name for c in client.columns] if client.columns else []
+        return rows, names
+
+    # --- server introspection -------------------------------------------
+
+    def server_info(self) -> dict:
+        with urllib.request.urlopen(f"{self.base_uri}/v1/info", timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    def list_queries(self) -> list[dict]:
+        with urllib.request.urlopen(f"{self.base_uri}/v1/query", timeout=10) as r:
+            return json.loads(r.read().decode())

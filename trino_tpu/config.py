@@ -51,7 +51,13 @@ class Session:
         ("broadcast_join_threshold_rows", 1 << 22),
         ("enable_dynamic_filtering", True),
         ("dynamic_filtering_max_build_rows", 1 << 20),
+        ("query_max_memory_bytes", 8 << 30),
+        ("spill_enabled", True),
+        ("spill_partitions", 8),
+        # rows above which join/group-by switch to partitioned host-spill
+        ("spill_threshold_rows", 1 << 23),
         ("tpu_enabled", True),
+        ("execution_mode", "local"),  # local | distributed (mesh SPMD)
     )
 
     def get(self, name: str) -> Any:

@@ -1,0 +1,273 @@
+"""Statement execution engine: the coordinator's analyze/plan/execute core.
+
+Reference: ``execution/SqlQueryExecution.java:373`` (the DQL path) plus the
+``DataDefinitionTask`` short-circuit family (``execution/CreateTableTask.java``,
+``DataDefinitionExecution.java``) for DDL/utility statements, and
+``testing/LocalQueryRunner.java`` which drives the same core in-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from trino_tpu import types as T
+from trino_tpu.analyzer import Analyzer, SemanticError
+from trino_tpu.columnar import Batch
+from trino_tpu.config import Session
+from trino_tpu.connectors.api import CatalogManager, ColumnSchema, TableSchema
+from trino_tpu.exec.local import LocalExecutor
+from trino_tpu.planner import plan as P
+from trino_tpu.sql import parse_statement
+from trino_tpu.sql import tree as t
+
+
+@dataclasses.dataclass
+class StatementResult:
+    """What a statement produced (protocol-ready, host-side)."""
+
+    rows: list[tuple]
+    column_names: list[str]
+    column_types: list[T.SqlType]
+    update_type: Optional[str] = None  # e.g. "CREATE TABLE", "INSERT"
+    update_count: Optional[int] = None
+    set_session: dict[str, Any] = dataclasses.field(default_factory=dict)
+    peak_memory_bytes: int = 0
+    dynamic_filters: int = 0
+
+
+class Engine:
+    """Catalogs + memory pool + statement dispatch. One per server."""
+
+    def __init__(
+        self,
+        catalogs: Optional[CatalogManager] = None,
+        hbm_bytes: int = 16 << 30,
+        mesh=None,
+    ):
+        from trino_tpu.memory import MemoryPool
+
+        if catalogs is None:
+            from trino_tpu.connectors.blackhole import BlackHoleConnector
+            from trino_tpu.connectors.memory import MemoryConnector
+            from trino_tpu.connectors.tpch import TpchConnector
+
+            catalogs = CatalogManager()
+            catalogs.register("tpch", TpchConnector())
+            catalogs.register("memory", MemoryConnector())
+            catalogs.register("blackhole", BlackHoleConnector())
+        self.catalogs = catalogs
+        self.memory_pool = MemoryPool(hbm_bytes)
+        self.mesh = mesh  # used by execution_mode=distributed
+        self._query_seq = 0
+
+    # === entry ============================================================
+
+    def execute_statement(self, sql: str, session: Session) -> StatementResult:
+        stmt = parse_statement(sql)
+        handler = getattr(self, f"_do_{type(stmt).__name__.lower()}", None)
+        if handler is not None:
+            return handler(stmt, session)
+        if isinstance(stmt, t.Query):
+            return self._execute_query_plan(self.plan(stmt, session), session)
+        raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
+
+    def plan(self, stmt: t.Node, session: Session) -> P.PlanNode:
+        from trino_tpu.planner.optimizer import optimize
+
+        analyzer = Analyzer(self.catalogs, session)
+        plan = analyzer.plan_statement(stmt)
+        return optimize(plan, session, self.catalogs)
+
+    # === DQL ==============================================================
+
+    def _execute_query_plan(self, plan: P.PlanNode, session: Session) -> StatementResult:
+        from trino_tpu.memory import QueryMemoryContext
+
+        self._query_seq += 1
+        ctx = QueryMemoryContext(
+            self.memory_pool,
+            f"q{self._query_seq}",
+            max_bytes=int(session.get("query_max_memory_bytes")),
+        )
+        try:
+            executor = self._executor(session, ctx)
+            batch, names = executor.execute(plan)
+            return StatementResult(
+                batch.to_pylist(),
+                names,
+                [c.type for c in batch.columns],
+                peak_memory_bytes=ctx.peak_bytes,
+                dynamic_filters=len(executor.dynamic_filters),
+            )
+        finally:
+            ctx.close()
+
+    def _executor(self, session: Session, ctx) -> LocalExecutor:
+        mode = session.get("execution_mode")
+        if mode == "distributed":
+            from trino_tpu.parallel.distributed import DistributedExecutor
+
+            return DistributedExecutor(
+                self.catalogs, session, self.mesh, memory_ctx=ctx
+            )
+        return LocalExecutor(self.catalogs, session, memory_ctx=ctx)
+
+    def _run_query_rows(self, query: t.Query, session: Session) -> tuple[Batch, list[str]]:
+        plan = self.plan(query, session)
+        from trino_tpu.memory import QueryMemoryContext
+
+        self._query_seq += 1
+        ctx = QueryMemoryContext(
+            self.memory_pool,
+            f"q{self._query_seq}",
+            max_bytes=int(session.get("query_max_memory_bytes")),
+        )
+        try:
+            return self._executor(session, ctx).execute(plan)
+        finally:
+            ctx.close()
+
+    # === session control ==================================================
+
+    def _do_setsession(self, stmt: t.SetSession, session: Session) -> StatementResult:
+        value = stmt.value
+        v: Any = value.value if isinstance(value, t.Literal) else None
+        session.set(stmt.name, v)
+        return StatementResult(
+            [], ["result"], [T.BOOLEAN],
+            update_type="SET SESSION", set_session={stmt.name: v},
+        )
+
+    # === metadata / SHOW ==================================================
+
+    def _do_showcatalogs(self, stmt, session) -> StatementResult:
+        rows = [(name,) for name in self.catalogs.names()]
+        return StatementResult(rows, ["Catalog"], [T.VARCHAR])
+
+    def _do_showschemas(self, stmt, session) -> StatementResult:
+        catalog = stmt.catalog or session.catalog
+        conn = self.catalogs.get(catalog)
+        return StatementResult(
+            [(s,) for s in conn.list_schemas()], ["Schema"], [T.VARCHAR]
+        )
+
+    def _do_showtables(self, stmt, session) -> StatementResult:
+        parts = list(stmt.schema or ())
+        if len(parts) == 2:
+            catalog, schema = parts
+        elif len(parts) == 1:
+            catalog, schema = session.catalog, parts[0]
+        else:
+            catalog, schema = session.catalog, session.schema
+        conn = self.catalogs.get(catalog)
+        return StatementResult(
+            [(x,) for x in conn.list_tables(schema)], ["Table"], [T.VARCHAR]
+        )
+
+    def _do_showcolumns(self, stmt, session) -> StatementResult:
+        catalog, schema, table = self._qualify(stmt.table, session)
+        conn = self.catalogs.get(catalog)
+        ts = conn.get_table(schema, table)
+        if ts is None:
+            raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
+        rows = [(c.name, str(c.type), "", "") for c in ts.columns]
+        return StatementResult(
+            rows, ["Column", "Type", "Extra", "Comment"], [T.VARCHAR] * 4
+        )
+
+    # === EXPLAIN ==========================================================
+
+    def _do_explain(self, stmt: t.Explain, session: Session) -> StatementResult:
+        if getattr(stmt, "analyze", False):
+            inner = stmt.statement
+            if not isinstance(inner, t.Query):
+                raise SemanticError("EXPLAIN ANALYZE supports queries only")
+            plan = self.plan(inner, session)
+            res = self._execute_query_plan(plan, session)
+            text = P.plan_text(plan)
+            text += (
+                f"\npeak memory: {res.peak_memory_bytes} bytes"
+                f"\ndynamic filters: {res.dynamic_filters}"
+                f"\noutput rows: {len(res.rows)}"
+            )
+            return StatementResult(
+                [(line,) for line in text.splitlines()], ["Query Plan"], [T.VARCHAR]
+            )
+        plan = self.plan(stmt.statement, session)
+        text = P.plan_text(plan)
+        return StatementResult(
+            [(line,) for line in text.splitlines()], ["Query Plan"], [T.VARCHAR]
+        )
+
+    # === DDL / DML ========================================================
+
+    def _do_createtableasselect(
+        self, stmt: t.CreateTableAsSelect, session: Session
+    ) -> StatementResult:
+        catalog, schema, table = self._qualify(stmt.name, session)
+        conn = self.catalogs.get(catalog)
+        batch, names = self._run_query_rows(stmt.query, session)
+        cols = tuple(
+            ColumnSchema(n.lower(), c.type) for n, c in zip(names, batch.columns)
+        )
+        conn.create_table(schema, table, TableSchema(table, cols))
+        n = conn.insert(schema, table, batch)
+        return StatementResult(
+            [], ["rows"], [T.BIGINT], update_type="CREATE TABLE", update_count=n
+        )
+
+    def _do_insertinto(self, stmt: t.InsertInto, session: Session) -> StatementResult:
+        catalog, schema, table = self._qualify(stmt.name, session)
+        conn = self.catalogs.get(catalog)
+        ts = conn.get_table(schema, table)
+        if ts is None:
+            raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
+        batch, names = self._run_query_rows(stmt.query, session)
+        ncols = len(stmt.columns) if stmt.columns else len(ts.columns)
+        if len(batch.columns) != ncols:
+            raise SemanticError(
+                f"INSERT has {len(batch.columns)} columns, expected {ncols}"
+            )
+        if stmt.columns:
+            # reorder/complete to table column order, NULL-filling the rest
+            import numpy as np
+
+            from trino_tpu.columnar import Column, Dictionary
+
+            by_name = {c.lower(): i for i, c in enumerate(stmt.columns)}
+            n = batch.num_rows
+            cols = []
+            for cs in ts.columns:
+                if cs.name in by_name:
+                    cols.append(batch.columns[by_name[cs.name]])
+                else:
+                    cols.append(
+                        Column(
+                            cs.type,
+                            np.zeros(n, dtype=cs.type.storage_dtype),
+                            np.zeros(n, dtype=np.bool_),
+                            Dictionary([]) if T.is_string(cs.type) else None,
+                        )
+                    )
+            batch = Batch(cols, n, batch.sel)
+        n = conn.insert(schema, table, batch)
+        return StatementResult(
+            [], ["rows"], [T.BIGINT], update_type="INSERT", update_count=n
+        )
+
+    def _do_droptable(self, stmt: t.DropTable, session: Session) -> StatementResult:
+        catalog, schema, table = self._qualify(stmt.name, session)
+        conn = self.catalogs.get(catalog)
+        if conn.get_table(schema, table) is None and stmt.if_exists:
+            return StatementResult([], ["result"], [T.BOOLEAN], update_type="DROP TABLE")
+        conn.drop_table(schema, table)
+        return StatementResult([], ["result"], [T.BOOLEAN], update_type="DROP TABLE")
+
+    def _qualify(self, name_parts, session: Session) -> tuple[str, str, str]:
+        parts = list(name_parts)
+        if len(parts) == 1:
+            return session.catalog, session.schema, parts[0]
+        if len(parts) == 2:
+            return session.catalog, parts[0], parts[1]
+        return parts[0], parts[1], parts[2]

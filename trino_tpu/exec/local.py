@@ -55,11 +55,19 @@ class Result:
 
 
 class LocalExecutor:
-    def __init__(self, catalogs: CatalogManager, session: Session):
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        session: Session,
+        memory_ctx=None,
+    ):
         self.catalogs = catalogs
         self.session = session
         # collected dynamic-filter stats (DynamicFilterService analog)
         self.dynamic_filters: list = []
+        # memory accounting (node -> query -> pool; see trino_tpu.memory)
+        self.memory_ctx = memory_ctx
+        self._reservations: dict[int, int] = {}
 
     # === entry ==========================================================
     def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
@@ -76,7 +84,17 @@ class LocalExecutor:
         method = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
-        return method(node)
+        res = method(node)
+        if self.memory_ctx is not None:
+            from trino_tpu.memory import batch_nbytes
+
+            nbytes = batch_nbytes(res.batch)
+            self.memory_ctx.reserve(nbytes, what=type(node).__name__)
+            self._reservations[id(node)] = nbytes
+            # children's intermediates are dead once this node materialized
+            for s in node.sources:
+                self.memory_ctx.free(self._reservations.pop(id(s), 0))
+        return res
 
     # === leaf nodes =====================================================
     def _exec_tablescan(self, node: P.TableScan) -> Result:
@@ -245,7 +263,53 @@ class LocalExecutor:
     def _exec_aggregate(self, node: P.Aggregate) -> Result:
         return self._aggregate_result(node, self._exec(node.source))
 
-    def _aggregate_result(self, node: P.Aggregate, res: Result) -> Result:
+    def _spill_aggregate(self, node: P.Aggregate, res: Result) -> Result:
+        """Partitioned (spill-to-host) group-by: rows hash-partitioned by
+        group keys; each partition aggregated on device independently
+        (disjoint key sets -> plain concat, no re-merge). Reference:
+        HashAggregationOperator revocable-state spill."""
+        from trino_tpu.spill import partitioned_run
+
+        n_part = int(self.session.get("spill_partitions"))
+        keys = [res.pair(k) for k in node.group_keys]
+        kh, _ = J.hash_keys(keys)
+
+        def run(subs, p):
+            if subs[0].num_rows == 0:
+                return None
+            sub = Result(subs[0], dict(res.layout))
+            out = self._aggregate_result(node, sub, allow_spill=False)
+            return out.batch.compact()
+
+        parts = partitioned_run([(res.batch, np.asarray(kh))], n_part, run)
+        layout = {s.name: i for i, s in enumerate(node.output_symbols)}
+        if not parts:
+            cols = [
+                Column(
+                    s.type,
+                    np.zeros(0, dtype=s.type.storage_dtype),
+                    None,
+                    res.column(s).dictionary
+                    if s.name in res.layout and T.is_string(s.type)
+                    else (Dictionary([]) if T.is_string(s.type) else None),
+                )
+                for s in node.output_symbols
+            ]
+            return Result(Batch(cols, 0), layout)
+        merged = concat_batches(parts) if len(parts) > 1 else parts[0]
+        return Result(merged, layout)
+
+    def _aggregate_result(
+        self, node: P.Aggregate, res: Result, allow_spill: bool = True
+    ) -> Result:
+        if (
+            allow_spill
+            and node.group_keys
+            and self.session.get("spill_enabled")
+            and int(res.batch.count_rows())
+            > int(self.session.get("spill_threshold_rows"))
+        ):
+            return self._spill_aggregate(node, res)
         sel = res.batch.selection_mask()
         key_pairs_for_distinct = [res.pair(k) for k in node.group_keys]
         agg_inputs = []
@@ -532,7 +596,62 @@ class LocalExecutor:
         right = self._exec(node.right)  # build first: enables dynamic filter
         left_plan = self._apply_dynamic_filters(node, right)
         left = self._exec(left_plan)  # probe
+        if left_plan is not node.left and id(left_plan) in self._reservations:
+            # rekey the probe reservation so the parent free (which walks
+            # node.sources) finds it
+            self._reservations[id(node.left)] = self._reservations.pop(id(left_plan))
+        if (
+            node.criteria
+            and self.session.get("spill_enabled")
+            and int(left.batch.count_rows()) + int(right.batch.count_rows())
+            > int(self.session.get("spill_threshold_rows"))
+        ):
+            return self._spill_join(node, left, right)
         return self._join_result(node, left, right)
+
+    def _spill_join(self, node: P.Join, left: Result, right: Result) -> Result:
+        """Partitioned (spill-to-host) join: hash-partition both sides so
+        HBM holds one partition's working set at a time (reference:
+        HashBuilderOperator spill states + GenericPartitioningSpiller)."""
+        from trino_tpu.spill import partitioned_run
+
+        n_part = int(self.session.get("spill_partitions"))
+        lkeys, rkeys = self._join_keys(left, right, node.criteria)
+        ph, _ = J.hash_keys(lkeys)
+        bh, _ = J.hash_keys(rkeys)
+
+        def run(subs, p):
+            from trino_tpu.spill import pad_to_one_unselected
+
+            if subs[0].num_rows == 0:
+                return None  # no probe rows: inner AND left produce nothing
+            rb = subs[1] if subs[1].num_rows > 0 else pad_to_one_unselected(subs[1])
+            sub_left = Result(subs[0], dict(left.layout))
+            sub_right = Result(rb, dict(right.layout))
+            out = self._join_result(node, sub_left, sub_right)
+            return out.batch.compact()
+
+        parts = partitioned_run(
+            [(left.batch, np.asarray(ph)), (right.batch, np.asarray(bh))],
+            n_part,
+            run,
+        )
+        layout: dict[str, int] = {}
+        for s in node.left.output_symbols:
+            layout[s.name] = len(layout)
+        for s in node.right.output_symbols:
+            layout[s.name] = len(layout)
+        if not parts:
+            cols = []
+            srcs = [(node.left, left), (node.right, right)]
+            for src_node, src_res in srcs:
+                for s in src_node.output_symbols:
+                    c = src_res.column(s)
+                    data, valid = c.to_numpy()
+                    cols.append(Column(c.type, data[:0], valid[:0], c.dictionary))
+            return Result(Batch(cols, 0), layout)
+        merged = concat_batches(parts) if len(parts) > 1 else parts[0]
+        return Result(merged, layout)
 
     def _apply_dynamic_filters(self, node: P.Join, build: Result) -> P.PlanNode:
         """Collect build-side key domains and push them into the probe plan

@@ -1,0 +1,144 @@
+"""Hierarchical memory accounting + HBM-aware memory pool.
+
+Reference: ``lib/trino-memory-context`` (``LocalMemoryContext.java:18``,
+``AggregatedMemoryContext.java``) and ``core/trino-main/.../memory/``
+(``MemoryPool.java``, ``LocalMemoryManager.java``,
+``ClusterMemoryManager.java:89`` with ``LowMemoryKiller``).
+
+TPU translation: the pool models device HBM (the scarce resource — v5e has
+16 GiB/chip), not JVM heap. Contexts form node -> query -> pool (the
+reference's operator->driver->pipeline->task chain collapses: our executor
+materializes one plan node at a time). When a reservation cannot be
+satisfied the engine first *revokes* (spills to host RAM via the
+partitioned operators in :mod:`trino_tpu.spill`), then kills the largest
+query (TotalReservationLowMemoryKiller policy).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Optional
+
+
+class ExceededMemoryLimitError(Exception):
+    """Reference: ``ExceededMemoryLimitException`` — kills the query, not
+    the server."""
+
+
+class MemoryPool:
+    """Byte-accounted pool shared by queries (``memory/MemoryPool.java``)."""
+
+    def __init__(self, capacity_bytes: int, name: str = "general"):
+        self.name = name
+        self.capacity = int(capacity_bytes)
+        self._lock = threading.Lock()
+        self._query_reserved: dict[str, int] = {}
+
+    @property
+    def reserved(self) -> int:
+        with self._lock:
+            return sum(self._query_reserved.values())
+
+    @property
+    def free_bytes(self) -> int:
+        return self.capacity - self.reserved
+
+    def query_reserved(self, query_id: str) -> int:
+        with self._lock:
+            return self._query_reserved.get(query_id, 0)
+
+    def try_reserve(self, query_id: str, bytes_: int) -> bool:
+        with self._lock:
+            total = sum(self._query_reserved.values())
+            if total + bytes_ > self.capacity:
+                return False
+            self._query_reserved[query_id] = (
+                self._query_reserved.get(query_id, 0) + bytes_
+            )
+            return True
+
+    def free(self, query_id: str, bytes_: int) -> None:
+        with self._lock:
+            cur = self._query_reserved.get(query_id, 0)
+            nxt = max(0, cur - bytes_)
+            if nxt:
+                self._query_reserved[query_id] = nxt
+            else:
+                self._query_reserved.pop(query_id, None)
+
+    def release_query(self, query_id: str) -> None:
+        with self._lock:
+            self._query_reserved.pop(query_id, None)
+
+    def largest_query(self) -> Optional[str]:
+        """TotalReservationLowMemoryKiller policy: pick the biggest."""
+        with self._lock:
+            if not self._query_reserved:
+                return None
+            return max(self._query_reserved, key=self._query_reserved.get)
+
+
+@dataclasses.dataclass
+class QueryMemoryContext:
+    """Per-query context with a hard limit (``query_max_memory``).
+
+    ``on_revoke`` is the spill hook: called once with the shortfall before
+    failing (MemoryRevokingScheduler analog); it returns bytes it freed.
+    """
+
+    pool: MemoryPool
+    query_id: str
+    max_bytes: Optional[int] = None
+    on_revoke: Optional[Callable[[int], int]] = None
+    peak_bytes: int = 0
+
+    def reserved(self) -> int:
+        return self.pool.query_reserved(self.query_id)
+
+    def reserve(self, bytes_: int, what: str = "") -> None:
+        if bytes_ <= 0:
+            return
+        cur = self.reserved()
+        if self.max_bytes is not None and cur + bytes_ > self.max_bytes:
+            raise ExceededMemoryLimitError(
+                f"Query exceeded memory limit of {self.max_bytes} bytes: "
+                f"reserved={cur} request={bytes_}"
+                + (f" at {what}" if what else "")
+            )
+        if not self.pool.try_reserve(self.query_id, bytes_):
+            if self.on_revoke is not None:
+                self.on_revoke(bytes_)
+            if not self.pool.try_reserve(self.query_id, bytes_):
+                raise ExceededMemoryLimitError(
+                    f"Memory pool '{self.pool.name}' exhausted: "
+                    f"capacity={self.pool.capacity} free={self.pool.free_bytes} "
+                    f"request={bytes_}" + (f" at {what}" if what else "")
+                )
+        self.peak_bytes = max(self.peak_bytes, self.reserved())
+
+    def free(self, bytes_: int) -> None:
+        if bytes_ > 0:
+            self.pool.free(self.query_id, bytes_)
+
+    def close(self) -> None:
+        self.pool.release_query(self.query_id)
+
+
+def batch_nbytes(batch) -> int:
+    """Device-resident footprint of a Batch (columns + validity + selection)."""
+    import numpy as np
+
+    total = 0
+    for c in batch.columns:
+        data = c.data
+        itemsize = (
+            data.dtype.itemsize if hasattr(data, "dtype") else 8
+        )
+        n = data.shape[0] if hasattr(data, "shape") and data.shape else 0
+        total += n * itemsize
+        if c.valid is not None:
+            total += n  # bool mask
+    if batch.sel is not None:
+        total += batch.capacity
+    return total

@@ -42,8 +42,14 @@ from trino_tpu.planner import plan as P
 class DistributedExecutor(LocalExecutor):
     """Executes logical plans SPMD over a device mesh."""
 
-    def __init__(self, catalogs: CatalogManager, session: Session, mesh: Optional[Mesh] = None):
-        super().__init__(catalogs, session)
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        session: Session,
+        mesh: Optional[Mesh] = None,
+        memory_ctx=None,
+    ):
+        super().__init__(catalogs, session, memory_ctx=memory_ctx)
         self.mesh = mesh or make_mesh()
 
     @property

@@ -1,0 +1,359 @@
+"""HTTP server: client statement protocol + node endpoints.
+
+Reference: ``dispatcher/QueuedStatementResource.java:93,171`` and
+``server/protocol/ExecutingStatementResource.java:76,145`` (the two-phase
+queued → executing nextUri protocol driven by
+``client/trino-client/.../StatementClientV1.java:62,125,324``),
+``QueryResource``, ``StatusResource``, ``ServerInfoResource`` and
+``GracefulShutdownHandler.java:43`` (PUT /v1/info/state SHUTTING_DOWN).
+
+Implementation: stdlib ``http.server`` (threaded), JSON wire format with
+the reference's ``QueryResults`` field names and ``X-Trino-*`` headers so
+protocol-compatible clients feel at home.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from decimal import Decimal
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from trino_tpu import types as T
+from trino_tpu.config import Session
+from trino_tpu.engine import Engine
+from trino_tpu.server.querymanager import ManagedQuery, QueryManager
+from trino_tpu.server.statemachine import QueryState
+
+PAGE_ROWS = 4096  # rows per protocol page (reference: target result bytes)
+PROTOCOL_HEADER = "X-Trino"
+VERSION = "trino-tpu-0.1 (356-compatible)"
+
+
+def _json_value(v: Any) -> Any:
+    if isinstance(v, Decimal):
+        return str(v)
+    return v
+
+
+class TrinoTpuServer:
+    """Coordinator server wrapping Engine + QueryManager.
+
+    The same class serves coordinator and (future multi-host) worker roles,
+    mirroring the reference's single binary with ``coordinator=true/false``.
+    """
+
+    def __init__(
+        self,
+        engine: Optional[Engine] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_concurrent: int = 4,
+        admit=None,
+    ):
+        self.engine = engine or Engine()
+        self.query_manager = QueryManager(self.engine, max_concurrent, admit=admit)
+        self.start_time = time.time()
+        self.state = "ACTIVE"  # ACTIVE | SHUTTING_DOWN (NodeState)
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "TrinoTpuServer":
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.query_manager.shutdown(wait=False)
+
+    def graceful_shutdown(self) -> None:
+        """Drain: refuse new queries, wait for active ones, then stop
+        (GracefulShutdownHandler.java:142)."""
+        self.state = "SHUTTING_DOWN"
+
+        def drain():
+            while any(
+                not q.state.is_terminal() for q in self.query_manager.queries()
+            ):
+                time.sleep(0.05)
+            self.stop()
+
+        threading.Thread(target=drain, daemon=True).start()
+
+    @property
+    def base_uri(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # --- protocol helpers -------------------------------------------------
+
+    def query_results(self, q: ManagedQuery, phase: str, token: int) -> dict:
+        state = q.state.get()
+        uri = f"{self.base_uri}/v1/statement"
+        out: dict[str, Any] = {
+            "id": q.query_id,
+            "infoUri": f"{self.base_uri}/v1/query/{q.query_id}",
+            "warnings": [],
+        }
+        stats = {
+            "state": state.value,
+            "queued": state == QueryState.QUEUED,
+            "scheduled": state
+            in (QueryState.RUNNING, QueryState.FINISHING, QueryState.FINISHED),
+            "nodes": 1,
+            "elapsedTimeMillis": int(
+                ((q.end_time or time.time()) - q.create_time) * 1000
+            ),
+            "peakMemoryBytes": q.result.peak_memory_bytes if q.result else 0,
+        }
+        out["stats"] = stats
+
+        if state == QueryState.FAILED or state == QueryState.CANCELED:
+            out["error"] = (q.error.to_json() if q.error else
+                            {"message": "query failed", "errorCode": 65536,
+                             "errorName": "GENERIC_INTERNAL_ERROR",
+                             "errorType": "INTERNAL_ERROR"})
+            return out
+
+        if phase == "queued":
+            if state in (QueryState.QUEUED, QueryState.PLANNING):
+                out["nextUri"] = f"{uri}/queued/{q.query_id}/{q.slug}/{token}"
+            else:
+                out["nextUri"] = f"{uri}/executing/{q.query_id}/{q.slug}/0"
+            return out
+
+        # executing phase: page through buffered results
+        if q.result is None:  # still running
+            out["nextUri"] = f"{uri}/executing/{q.query_id}/{q.slug}/{token}"
+            return out
+        res = q.result
+        out["columns"] = [
+            {
+                "name": n,
+                "type": str(ty),
+                "typeSignature": {"rawType": _raw_type(ty), "arguments": []},
+            }
+            for n, ty in zip(res.column_names, res.column_types)
+        ]
+        if res.update_type is not None:
+            out["updateType"] = res.update_type
+        if res.update_count is not None:
+            out["updateCount"] = res.update_count
+        lo = token * PAGE_ROWS
+        hi = min(lo + PAGE_ROWS, len(res.rows))
+        if lo < len(res.rows):
+            out["data"] = [
+                [_json_value(v) for v in row] for row in res.rows[lo:hi]
+            ]
+        if hi < len(res.rows):
+            out["nextUri"] = f"{uri}/executing/{q.query_id}/{q.slug}/{token + 1}"
+        else:
+            out["partialCancelUri"] = None
+        if res.set_session:
+            out["_setSession"] = {k: v for k, v in res.set_session.items()}
+        return out
+
+
+def _raw_type(ty: T.SqlType) -> str:
+    s = str(ty)
+    return s.split("(")[0]
+
+
+def _make_handler(server: TrinoTpuServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = VERSION
+
+        # --- plumbing ----------------------------------------------------
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _send_json(self, obj: Any, status: int = 200, headers: Optional[dict] = None):
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, status: int, message: str):
+            self._send_json({"error": message}, status)
+
+        def _send_no_content(self):
+            # 204 must carry no body (RFC 9110); body bytes would desync
+            # keep-alive connections
+            self.send_response(204)
+            self.end_headers()
+
+        def _session_from_headers(self) -> Session:
+            h = self.headers
+            s = Session(
+                user=h.get(f"{PROTOCOL_HEADER}-User", "anonymous"),
+                catalog=h.get(f"{PROTOCOL_HEADER}-Catalog", "tpch"),
+                schema=h.get(f"{PROTOCOL_HEADER}-Schema", "tiny"),
+            )
+            raw = h.get(f"{PROTOCOL_HEADER}-Session", "")
+            for part in raw.split(","):
+                part = part.strip()
+                if not part or "=" not in part:
+                    continue
+                k, v = part.split("=", 1)
+                s.set(k.strip(), _decode_session_value(urllib.parse.unquote(v.strip())))
+            return s
+
+        # --- routes ------------------------------------------------------
+
+        def do_POST(self):
+            path = urllib.parse.urlparse(self.path).path
+            if path == "/v1/statement":
+                if server.state != "ACTIVE":
+                    return self._error(503, "server is shutting down")
+                length = int(self.headers.get("Content-Length", 0))
+                sql = self.rfile.read(length).decode()
+                if not sql.strip():
+                    return self._error(400, "SQL statement is empty")
+                session = self._session_from_headers()
+                q = server.query_manager.create_query(sql, session)
+                return self._send_json(server.query_results(q, "queued", 0))
+            return self._error(404, f"unknown path: {path}")
+
+        def do_GET(self):
+            path = urllib.parse.urlparse(self.path).path
+            parts = [p for p in path.split("/") if p]
+            if path == "/v1/info":
+                return self._send_json(
+                    {
+                        "nodeVersion": {"version": VERSION},
+                        "environment": "tpu",
+                        "coordinator": True,
+                        "starting": False,
+                        "uptime": f"{time.time() - server.start_time:.2f}s",
+                    }
+                )
+            if path == "/v1/info/state":
+                return self._send_json(server.state)
+            if path == "/v1/status":
+                pool = server.engine.memory_pool
+                return self._send_json(
+                    {
+                        "nodeId": "coordinator",
+                        "nodeVersion": VERSION,
+                        "state": server.state,
+                        "coordinator": True,
+                        "memoryInfo": {
+                            "totalNodeMemory": pool.capacity,
+                            "reservedBytes": pool.reserved,
+                            "freeBytes": pool.free_bytes,
+                        },
+                        "queries": len(server.query_manager.queries()),
+                    }
+                )
+            if path == "/v1/query":
+                return self._send_json(
+                    [q.info() for q in server.query_manager.queries()]
+                )
+            if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                q = server.query_manager.get(parts[2])
+                if q is None:
+                    return self._error(404, "query not found")
+                return self._send_json(q.info())
+            if len(parts) == 6 and parts[:2] == ["v1", "statement"]:
+                phase, qid, slug, token = parts[2], parts[3], parts[4], parts[5]
+                q = server.query_manager.get(qid)
+                if q is None or q.slug != slug:
+                    return self._error(404, "query not found")
+                q.touch()
+                max_wait = _parse_duration(
+                    self.headers.get(f"{PROTOCOL_HEADER}-Max-Wait", "1s")
+                )
+                if phase == "queued":
+                    q.state.wait_for(
+                        lambda s: s not in (QueryState.QUEUED, QueryState.PLANNING),
+                        max_wait,
+                    )
+                else:
+                    from trino_tpu.server.statemachine import TERMINAL_QUERY_STATES
+
+                    q.state.wait_for(
+                        lambda s: q.result is not None or s in TERMINAL_QUERY_STATES,
+                        max_wait,
+                    )
+                out = server.query_results(q, phase, int(token))
+                headers = {}
+                set_session = out.pop("_setSession", None)
+                if set_session:
+                    for k, v in set_session.items():
+                        headers[f"{PROTOCOL_HEADER}-Set-Session"] = (
+                            f"{k}={urllib.parse.quote(str(v))}"
+                        )
+                return self._send_json(out, headers=headers)
+            return self._error(404, f"unknown path: {path}")
+
+        def do_DELETE(self):
+            path = urllib.parse.urlparse(self.path).path
+            parts = [p for p in path.split("/") if p]
+            if len(parts) >= 5 and parts[:2] == ["v1", "statement"]:
+                qid, slug = parts[3], parts[4]
+                q = server.query_manager.get(qid)
+                if q is None or q.slug != slug:  # slug = per-query secret
+                    return self._error(404, "query not found")
+                q.cancel()
+                return self._send_no_content()
+            if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                if server.query_manager.cancel(parts[2]):
+                    return self._send_no_content()
+                return self._error(404, "query not found")
+            return self._error(404, f"unknown path: {path}")
+
+        def do_PUT(self):
+            path = urllib.parse.urlparse(self.path).path
+            if path == "/v1/info/state":
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length).decode().strip().strip('"')
+                if body == "SHUTTING_DOWN":
+                    server.graceful_shutdown()
+                    return self._send_json({}, 200)
+                return self._error(400, f"unsupported state: {body}")
+            return self._error(404, f"unknown path: {path}")
+
+    return Handler
+
+
+def _decode_session_value(v: str) -> Any:
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    return v
+
+
+def _parse_duration(text: str) -> float:
+    text = text.strip().lower()
+    for suffix, mult in (("ms", 0.001), ("s", 1.0), ("m", 60.0)):
+        if text.endswith(suffix):
+            try:
+                return float(text[: -len(suffix)]) * mult
+            except ValueError:
+                return 1.0
+    try:
+        return float(text)
+    except ValueError:
+        return 1.0

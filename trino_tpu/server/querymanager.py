@@ -1,0 +1,203 @@
+"""Query management: dispatch, lifecycle, results buffering.
+
+Reference: ``dispatcher/DispatchManager.java:61,148`` (createQuery →
+queue → execute), ``execution/SqlQueryManager`` (registry/limits),
+``execution/QueryStateMachine.java`` (lifecycle + stats), and
+``server/protocol/Query.java:117`` (paged result serving).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import secrets
+import threading
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+from trino_tpu import types as T
+from trino_tpu.config import Session
+from trino_tpu.engine import Engine, StatementResult
+from trino_tpu.server.statemachine import (
+    QueryState,
+    StateMachine,
+    new_query_state_machine,
+)
+
+_query_counter = itertools.count(1)
+
+
+def _new_query_id() -> str:
+    # reference format: yyyyMMdd_HHmmss_index_coord (QueryIdGenerator)
+    ts = time.strftime("%Y%m%d_%H%M%S")
+    return f"{ts}_{next(_query_counter):05d}_trino_tpu"
+
+
+@dataclasses.dataclass
+class ErrorInfo:
+    """Reference: ``client/.../QueryError.java`` shape."""
+
+    message: str
+    error_code: int = 1
+    error_name: str = "GENERIC_INTERNAL_ERROR"
+    error_type: str = "INTERNAL_ERROR"
+    stack: str = ""
+
+    def to_json(self) -> dict:
+        return {
+            "message": self.message,
+            "errorCode": self.error_code,
+            "errorName": self.error_name,
+            "errorType": self.error_type,
+            "failureInfo": {"type": self.error_name, "message": self.message,
+                            "stack": self.stack.splitlines()},
+        }
+
+
+class ManagedQuery:
+    """One query's full lifecycle + buffered results."""
+
+    def __init__(self, sql: str, session: Session):
+        self.query_id = _new_query_id()
+        self.slug = "x" + secrets.token_hex(8)
+        self.sql = sql
+        self.session = session
+        self.state = new_query_state_machine(self.query_id)
+        self.result: Optional[StatementResult] = None
+        self.error: Optional[ErrorInfo] = None
+        self.create_time = time.time()
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        self.last_access = time.time()  # protocol touch; guards history GC
+        self._cancelled = threading.Event()
+
+    def touch(self) -> None:
+        self.last_access = time.time()
+
+    # --- lifecycle --------------------------------------------------------
+
+    def run(self, engine: Engine) -> None:
+        if self._cancelled.is_set():
+            return
+        self.start_time = time.time()
+        self.state.set(QueryState.PLANNING)
+        try:
+            if self._cancelled.is_set():
+                return
+            self.state.set(QueryState.RUNNING)
+            self.result = engine.execute_statement(self.sql, self.session)
+            self.state.set(QueryState.FINISHING)
+            self.state.set(QueryState.FINISHED)
+        except Exception as e:  # noqa: BLE001 — any failure fails the query
+            from trino_tpu.analyzer import SemanticError
+            from trino_tpu.memory import ExceededMemoryLimitError
+            from trino_tpu.sql.lexer import SqlSyntaxError
+
+            if isinstance(e, SqlSyntaxError):
+                code, name, typ = 1, "SYNTAX_ERROR", "USER_ERROR"
+            elif isinstance(e, SemanticError):
+                code, name, typ = 2, "SEMANTIC_ERROR", "USER_ERROR"
+            elif isinstance(e, ExceededMemoryLimitError):
+                code, name, typ = 131075, "EXCEEDED_MEMORY_LIMIT", "INSUFFICIENT_RESOURCES"
+            elif isinstance(e, KeyError):
+                code, name, typ = 2, "SEMANTIC_ERROR", "USER_ERROR"
+            else:
+                code, name, typ = 65536, "GENERIC_INTERNAL_ERROR", "INTERNAL_ERROR"
+            self.error = ErrorInfo(str(e), code, name, typ, traceback.format_exc())
+            self.state.set(QueryState.FAILED)
+        finally:
+            self.end_time = time.time()
+
+    def cancel(self) -> None:
+        self._cancelled.set()
+        if self.state.set(QueryState.CANCELED):
+            self.error = ErrorInfo("Query was canceled", 1, "USER_CANCELED", "USER_ERROR")
+            self.end_time = time.time()
+
+    # --- info -------------------------------------------------------------
+
+    def info(self) -> dict:
+        st = self.state.get()
+        elapsed = (self.end_time or time.time()) - self.create_time
+        return {
+            "queryId": self.query_id,
+            "state": st.value,
+            "query": self.sql,
+            "user": self.session.user,
+            "elapsedTimeMillis": int(elapsed * 1000),
+            "createTime": self.create_time,
+            "endTime": self.end_time,
+            "peakMemoryBytes": self.result.peak_memory_bytes if self.result else 0,
+            "updateType": self.result.update_type if self.result else None,
+            "error": self.error.to_json() if self.error else None,
+        }
+
+
+class QueryManager:
+    """Registry + dispatch pool (DispatchManager + SqlQueryManager).
+
+    ``admit`` is the resource-group hook: called before execution starts;
+    it may delay (queue) the query.
+    """
+
+    def __init__(self, engine: Engine, max_concurrent: int = 4, admit=None):
+        self.engine = engine
+        self._queries: dict[str, ManagedQuery] = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=max_concurrent)
+        self._admit = admit
+        self.max_history = 100
+
+    def create_query(self, sql: str, session: Session) -> ManagedQuery:
+        q = ManagedQuery(sql, session)
+        with self._lock:
+            self._queries[q.query_id] = q
+            self._gc_locked()
+        self._pool.submit(self._dispatch, q)
+        return q
+
+    def _dispatch(self, q: ManagedQuery) -> None:
+        try:
+            if self._admit is not None:
+                self._admit(q)  # may block (queued) or raise (rejected)
+            if q.state.get() == QueryState.QUEUED:
+                q.run(self.engine)
+        except Exception as e:  # noqa: BLE001
+            q.error = ErrorInfo(str(e), 3, "QUERY_REJECTED", "USER_ERROR")
+            q.state.set(QueryState.FAILED)
+            q.end_time = time.time()
+
+    def get(self, query_id: str) -> Optional[ManagedQuery]:
+        with self._lock:
+            return self._queries.get(query_id)
+
+    def queries(self) -> list[ManagedQuery]:
+        with self._lock:
+            return list(self._queries.values())
+
+    def cancel(self, query_id: str) -> bool:
+        q = self.get(query_id)
+        if q is None:
+            return False
+        q.cancel()
+        return True
+
+    def _gc_locked(self) -> None:
+        if len(self._queries) <= self.max_history:
+            return
+        # evict least-recently-ACCESSED terminal queries only: a client may
+        # still be paging a finished query's buffered results
+        now = time.time()
+        done = [
+            q
+            for q in self._queries.values()
+            if q.state.is_terminal() and now - q.last_access > 5.0
+        ]
+        done.sort(key=lambda q: q.last_access)
+        for q in done[: len(self._queries) - self.max_history]:
+            self._queries.pop(q.query_id, None)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait)

@@ -1,0 +1,81 @@
+"""Spill-to-host: partitioned execution for memory-revocable operators.
+
+Reference: ``core/trino-main/.../spiller/`` —
+``GenericPartitioningSpiller.java`` (hash-partition oversized join/agg
+state, process partitions sequentially) and the four revocable operators
+(HashBuilderOperator, HashAggregationOperator, OrderByOperator,
+WindowOperator). Our "disk" is host RAM: partitions are compacted numpy
+arrays (device -> host), processed one at a time on device, results
+concatenated host-side. HBM holds only one partition's working set at a
+time — the TPU analog of grouped/bucketed execution
+(``execution/Lifespan.java:26``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from trino_tpu.columnar import Batch, Column
+
+
+def partition_assignment(
+    hashes: np.ndarray, sel: np.ndarray, n_partitions: int
+) -> np.ndarray:
+    """partition id per row (-1 for unselected rows)."""
+    part = (hashes.astype(np.uint64) % np.uint64(n_partitions)).astype(np.int64)
+    return np.where(sel, part, -1)
+
+
+def slice_rows(batch: Batch, rows: np.ndarray) -> Batch:
+    """Physically gather ``rows`` (host-side compaction) into a new Batch."""
+    cols = []
+    for c in batch.columns:
+        data, valid = c.to_numpy()
+        cols.append(Column(c.type, data[rows], valid[rows], c.dictionary))
+    return Batch(cols, len(rows))
+
+
+def pad_to_one_unselected(batch: Batch) -> Batch:
+    """A 1-row batch with nothing selected (kernels reject 0-row arrays)."""
+    cols = []
+    for c in batch.columns:
+        data, _valid = c.to_numpy()
+        cols.append(
+            Column(
+                c.type,
+                np.zeros(1, dtype=data.dtype),
+                np.zeros(1, dtype=np.bool_),
+                c.dictionary,
+            )
+        )
+    return Batch(cols, 1, np.zeros(1, dtype=np.bool_))
+
+
+def partitioned_run(
+    batches: Sequence[tuple[Batch, np.ndarray]],
+    n_partitions: int,
+    run: Callable[[Sequence[Batch], int], Optional[Batch]],
+) -> list[Batch]:
+    """Split each (batch, hash) input into hash partitions; call ``run``
+    once per partition with the compacted per-input sub-batches.
+
+    Rows whose hash partition differs never join/aggregate together, so
+    per-partition processing is exact for equi-joins and group-bys (the
+    GenericPartitioningSpiller guarantee).
+    """
+    assignments = []
+    for batch, hashes in batches:
+        sel = np.asarray(batch.selection_mask())
+        assignments.append(partition_assignment(np.asarray(hashes), sel, n_partitions))
+    out: list[Batch] = []
+    for p in range(n_partitions):
+        subs = []
+        for (batch, _), assign in zip(batches, assignments):
+            rows = np.nonzero(assign == p)[0]
+            subs.append(slice_rows(batch, rows))
+        res = run(subs, p)
+        if res is not None and res.num_rows > 0:
+            out.append(res)
+    return out

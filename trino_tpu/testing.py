@@ -1,26 +1,22 @@
-"""Test harness: LocalQueryRunner analog.
+"""Test harness: LocalQueryRunner / DistributedQueryRunner analogs.
 
 Reference: ``core/trino-main/src/main/java/io/trino/testing/LocalQueryRunner.java:221,631``
-(single-process full stack) and the H2 oracle pattern
-(``testing/trino-testing/.../H2QueryRunner.java``) — our oracle is NumPy
-recomputation over the same generated data.
+(single-process full stack) and
+``testing/trino-testing/.../DistributedQueryRunner.java:72`` (N workers in
+one process — here N mesh shards with real collectives). Both delegate to
+:class:`trino_tpu.engine.Engine`, the same core the HTTP server serves.
+The correctness oracle is NumPy recomputation over the same generated data
+(the reference's H2-oracle pattern, ``H2QueryRunner.java``).
 """
 
 from __future__ import annotations
 
 from typing import Any, Optional, Sequence
 
-from trino_tpu.analyzer import Analyzer
-from trino_tpu.columnar import Batch
 from trino_tpu.config import Session
-from trino_tpu.connectors.api import CatalogManager
-from trino_tpu.connectors.blackhole import BlackHoleConnector
-from trino_tpu.connectors.memory import MemoryConnector
-from trino_tpu.connectors.tpch import TpchConnector
-from trino_tpu.exec.local import LocalExecutor
+from trino_tpu.engine import Engine
 from trino_tpu.planner import plan as P
 from trino_tpu.sql import parse_statement
-from trino_tpu.sql import tree as t
 
 
 class LocalQueryRunner:
@@ -28,37 +24,22 @@ class LocalQueryRunner:
 
     def __init__(self, session: Optional[Session] = None):
         self.session = session or Session()
-        self.catalogs = CatalogManager()
-        self.catalogs.register("tpch", TpchConnector())
-        self.catalogs.register("memory", MemoryConnector())
-        self.catalogs.register("blackhole", BlackHoleConnector())
+        self.engine = Engine()
+
+    @property
+    def catalogs(self):
+        return self.engine.catalogs
+
+    @property
+    def memory_pool(self):
+        return self.engine.memory_pool
 
     def plan(self, sql: str) -> P.PlanNode:
-        stmt = parse_statement(sql)
-        analyzer = Analyzer(self.catalogs, self.session)
-        plan = analyzer.plan_statement(stmt)
-        from trino_tpu.planner.optimizer import optimize
-
-        return optimize(plan, self.session, self.catalogs)
+        return self.engine.plan(parse_statement(sql), self.session)
 
     def execute(self, sql: str) -> tuple[list[tuple], list[str]]:
-        stmt = parse_statement(sql)
-        if isinstance(stmt, t.SetSession):
-            value = stmt.value
-            v: Any = value.value if isinstance(value, t.Literal) else None
-            self.session.set(stmt.name, v)
-            return [], ["result"]
-        plan = self._plan_stmt(stmt)
-        executor = LocalExecutor(self.catalogs, self.session)
-        batch, names = executor.execute(plan)
-        return batch.to_pylist(), names
-
-    def _plan_stmt(self, stmt) -> P.PlanNode:
-        analyzer = Analyzer(self.catalogs, self.session)
-        plan = analyzer.plan_statement(stmt)
-        from trino_tpu.planner.optimizer import optimize
-
-        return optimize(plan, self.session, self.catalogs)
+        res = self.engine.execute_statement(sql, self.session)
+        return res.rows, res.column_names
 
     def explain(self, sql: str) -> str:
         return P.plan_text(self.plan(sql))
@@ -71,24 +52,13 @@ class LocalQueryRunner:
 
 
 class DistributedQueryRunner(LocalQueryRunner):
-    """Multi-shard runner over a device mesh (reference:
-    ``testing/trino-testing/.../DistributedQueryRunner.java:72`` — N real
-    workers in one process; here N mesh shards in one process, with real
-    collectives between them)."""
+    """Multi-shard runner over a device mesh: every query executes SPMD
+    with real collectives between shards."""
 
     def __init__(self, session: Optional[Session] = None, n_devices: Optional[int] = None):
         super().__init__(session)
         from trino_tpu.parallel.mesh import make_mesh
 
         self.mesh = make_mesh(n_devices)
-
-    def execute(self, sql: str) -> tuple[list[tuple], list[str]]:
-        stmt = parse_statement(sql)
-        if isinstance(stmt, t.SetSession):
-            return super().execute(sql)
-        plan = self._plan_stmt(stmt)
-        from trino_tpu.parallel.distributed import DistributedExecutor
-
-        executor = DistributedExecutor(self.catalogs, self.session, self.mesh)
-        batch, names = executor.execute(plan)
-        return batch.to_pylist(), names
+        self.engine.mesh = self.mesh
+        self.session.set("execution_mode", "distributed")
