@@ -1,0 +1,116 @@
+"""Observability: EXPLAIN ANALYZE stats, event listeners, system tables.
+
+Mirrors reference tests ``execution/TestEventListenerBasic.java``,
+PlanPrinter stats rendering, and system connector tests.
+"""
+
+import pytest
+
+from trino_tpu.events import EventListener
+from trino_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+class TestExplainAnalyze:
+    def test_annotated_plan(self, runner):
+        rows, _ = runner.execute(
+            "explain analyze select o_orderpriority, count(*) "
+            "from tpch.tiny.orders where o_orderkey <= 1000 group by o_orderpriority"
+        )
+        text = "\n".join(r[0] for r in rows)
+        assert "wall:" in text and "rows:" in text
+        assert "Aggregate" in text and "TableScan" in text
+        assert "peak memory:" in text
+        assert "wall time:" in text
+
+    def test_explain_analyze_join_shows_all_nodes(self, runner):
+        rows, _ = runner.execute(
+            "explain analyze select count(*) from tpch.tiny.orders o "
+            "join tpch.tiny.customer c on o.o_custkey = c.c_custkey"
+        )
+        text = "\n".join(r[0] for r in rows)
+        assert "Join" in text
+        assert text.count("wall:") >= 3
+
+
+class TestEventListeners:
+    def test_created_and_completed(self, runner):
+        events = []
+
+        class Recorder(EventListener):
+            def query_created(self, e):
+                events.append(("created", e))
+
+            def query_completed(self, e):
+                events.append(("completed", e))
+
+        runner.engine.event_listeners.add(Recorder())
+        runner.execute("select count(*) from tpch.tiny.nation")
+        kinds = [k for k, _ in events]
+        assert kinds == ["created", "completed"]
+        done = events[1][1]
+        assert done.state == "FINISHED"
+        assert done.output_rows == 1
+        assert done.wall_seconds >= 0
+
+    def test_failed_query_event(self, runner):
+        events = []
+
+        class Recorder(EventListener):
+            def query_completed(self, e):
+                events.append(e)
+
+        runner.engine.event_listeners.add(Recorder())
+        with pytest.raises(Exception):
+            runner.execute("select bad_column from tpch.tiny.nation")
+        assert events and events[-1].state == "FAILED"
+        assert events[-1].error_message
+
+    def test_listener_exception_does_not_fail_query(self, runner):
+        class Bad(EventListener):
+            def query_created(self, e):
+                raise RuntimeError("boom")
+
+        runner.engine.event_listeners.add(Bad())
+        rows, _ = runner.execute("select 1")
+        assert rows == [(1,)]
+
+
+class TestSystemTables:
+    def test_runtime_queries(self, runner):
+        runner.execute("select 123456789")
+        rows, names = runner.execute(
+            "select query, state from system.runtime.queries"
+        )
+        assert any("123456789" in r[0] for r in rows)
+        assert all(r[1] in ("FINISHED", "FAILED", "RUNNING") for r in rows)
+
+    def test_runtime_nodes(self, runner):
+        rows, _ = runner.execute(
+            "select node_id, coordinator from system.runtime.nodes"
+        )
+        assert rows and rows[0][1] is True
+
+    def test_metadata_catalogs(self, runner):
+        rows, _ = runner.execute("select catalog_name from system.metadata.catalogs")
+        names = [r[0] for r in rows]
+        assert "tpch" in names and "system" in names
+
+    def test_system_tables_over_http(self):
+        from trino_tpu.client import Connection
+        from trino_tpu.server.http import TrinoTpuServer
+
+        s = TrinoTpuServer().start()
+        try:
+            c = Connection(s.base_uri)
+            c.execute("select 1")
+            rows, _ = c.execute("select state from system.runtime.queries")
+            assert rows
+            rows, _ = c.execute("select http_uri from system.runtime.nodes")
+            assert rows[0][0].startswith("http://")
+        finally:
+            s.stop()

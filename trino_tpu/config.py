@@ -40,6 +40,7 @@ class Session:
     user: str = "user"
     catalog: str | None = "tpch"
     schema: str | None = "tiny"
+    source: str = ""  # client-declared source (X-Trino-Source)
     properties: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # --- defaults for recognised properties -------------------------------

@@ -1,0 +1,93 @@
+"""System catalog: runtime introspection tables.
+
+Reference: ``core/trino-main/.../connector/system/`` —
+``system.runtime.queries`` / ``system.runtime.nodes`` (and
+``system.metadata.catalogs``), backed by the coordinator's live state.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch
+from trino_tpu.connectors.api import ColumnSchema, Connector, Split, TableSchema
+
+_SCHEMAS: dict[str, list[tuple[str, T.SqlType]]] = {
+    ("runtime", "queries"): [
+        ("query_id", T.VARCHAR),
+        ("state", T.VARCHAR),
+        ("user", T.VARCHAR),
+        ("source", T.VARCHAR),
+        ("query", T.VARCHAR),
+        ("elapsed_ms", T.BIGINT),
+        ("peak_memory_bytes", T.BIGINT),
+        ("output_rows", T.BIGINT),
+    ],
+    ("runtime", "nodes"): [
+        ("node_id", T.VARCHAR),
+        ("http_uri", T.VARCHAR),
+        ("node_version", T.VARCHAR),
+        ("coordinator", T.BOOLEAN),
+        ("state", T.VARCHAR),
+    ],
+    ("metadata", "catalogs"): [
+        ("catalog_name", T.VARCHAR),
+        ("connector_name", T.VARCHAR),
+    ],
+}
+
+
+class SystemConnector(Connector):
+    """Bound to an Engine; rows materialize live state at scan time."""
+
+    name = "system"
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def list_schemas(self):
+        return sorted({s for s, _ in _SCHEMAS})
+
+    def list_tables(self, schema):
+        return sorted(t for s, t in _SCHEMAS if s == schema)
+
+    def get_table(self, schema, table):
+        cols = _SCHEMAS.get((schema, table))
+        if cols is None:
+            return None
+        return TableSchema(
+            table, tuple(ColumnSchema(n, ty) for n, ty in cols)
+        )
+
+    def get_splits(self, schema, table, target_splits, constraint=None):
+        return [Split(table, 0, 1, info=schema)]
+
+    def read_split(self, schema, table, columns: Sequence[str], split):
+        schema = split.info or schema
+        spec = _SCHEMAS[(schema, table)]
+        rows = self._rows(schema, table)
+        names, batch = [n for n, _ in spec], Batch.from_pylist(spec, rows)
+        idx = {n: i for i, n in enumerate(names)}
+        cols = [batch.columns[idx[c]] for c in columns]
+        return Batch(cols, batch.num_rows)
+
+    def _rows(self, schema: str, table: str) -> list[tuple]:
+        eng = self._engine
+        if (schema, table) == ("runtime", "queries"):
+            return [
+                (
+                    q["queryId"], q["state"], q["user"], q.get("source", ""),
+                    q["query"], q["elapsedTimeMillis"],
+                    q.get("peakMemoryBytes", 0), q.get("outputRows", 0),
+                )
+                for q in eng.runtime_queries()
+            ]
+        if (schema, table) == ("runtime", "nodes"):
+            return [n for n in eng.runtime_nodes()]
+        if (schema, table) == ("metadata", "catalogs"):
+            return [
+                (name, eng.catalogs.get(name).name) for name in eng.catalogs.names()
+            ]
+        return []

@@ -59,17 +59,106 @@ class Engine:
         self.catalogs = catalogs
         self.memory_pool = MemoryPool(hbm_bytes)
         self.mesh = mesh  # used by execution_mode=distributed
+        import threading
+
         self._query_seq = 0
+        self._seq_lock = threading.Lock()
+        # observability (QueryMonitor -> EventListenerManager; system catalog)
+        from trino_tpu.events import EventListenerManager
+
+        self.event_listeners = EventListenerManager()
+        from collections import deque
+
+        self._recent_queries: "deque[dict]" = deque(maxlen=200)
+        self._runtime_nodes_fn = None  # server installs live node info
+        try:
+            from trino_tpu.connectors.system import SystemConnector
+
+            self.catalogs.register("system", SystemConnector(self))
+        except Exception:  # noqa: BLE001 — system catalog is best-effort
+            pass
+
+    # --- runtime introspection (system connector backend) -----------------
+
+    def runtime_queries(self) -> list[dict]:
+        import time as _time
+
+        out = []
+        for rec in list(self._recent_queries):
+            rec = dict(rec)
+            if rec["state"] == "RUNNING":  # live elapsed for in-flight queries
+                rec["elapsedTimeMillis"] = int(
+                    (_time.time() - rec["_start"]) * 1000
+                )
+            rec.pop("_start", None)
+            out.append(rec)
+        return out
+
+    def _next_query_id(self) -> str:
+        with self._seq_lock:
+            self._query_seq += 1
+            return f"q{self._query_seq}"
+
+    def runtime_nodes(self) -> list[tuple]:
+        if self._runtime_nodes_fn is not None:
+            return self._runtime_nodes_fn()
+        return [("local", "local://", "trino-tpu-0.1", True, "ACTIVE")]
 
     # === entry ============================================================
 
     def execute_statement(self, sql: str, session: Session) -> StatementResult:
+        import time as _time
+
+        from trino_tpu.events import QueryCompletedEvent, QueryCreatedEvent
+
+        qid = self._next_query_id()
+        t0 = _time.time()
+        self.event_listeners.fire_created(
+            QueryCreatedEvent(qid, sql, session.user, t0)
+        )
+        record = {
+            "queryId": qid, "state": "RUNNING", "user": session.user,
+            "query": sql, "elapsedTimeMillis": 0, "peakMemoryBytes": 0,
+            "outputRows": 0, "_start": t0,
+        }
+        self._recent_queries.append(record)
+        error: Optional[str] = None
+        res: Optional[StatementResult] = None
+        try:
+            res = self._execute_statement_inner(sql, session, qid)
+            return res
+        except Exception as e:  # noqa: BLE001
+            error = str(e)
+            raise
+        finally:
+            end = _time.time()
+            record["state"] = "FINISHED" if error is None else "FAILED"
+            record["elapsedTimeMillis"] = int((end - t0) * 1000)
+            if res is not None:
+                record["peakMemoryBytes"] = res.peak_memory_bytes
+                record["outputRows"] = len(res.rows)
+            self.event_listeners.fire_completed(
+                QueryCompletedEvent(
+                    qid, sql, session.user, t0, end,
+                    record["state"],
+                    output_rows=record["outputRows"],
+                    peak_memory_bytes=record["peakMemoryBytes"],
+                    error_message=error,
+                    wall_seconds=end - t0,
+                )
+            )
+
+    def _execute_statement_inner(
+        self, sql: str, session: Session, query_id: Optional[str] = None
+    ) -> StatementResult:
         stmt = parse_statement(sql)
         handler = getattr(self, f"_do_{type(stmt).__name__.lower()}", None)
         if handler is not None:
             return handler(stmt, session)
         if isinstance(stmt, t.Query):
-            return self._execute_query_plan(self.plan(stmt, session), session)
+            return self._execute_query_plan(
+                self.plan(stmt, session), session, query_id=query_id
+            )
         raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
 
     def plan(self, stmt: t.Node, session: Session) -> P.PlanNode:
@@ -81,17 +170,23 @@ class Engine:
 
     # === DQL ==============================================================
 
-    def _execute_query_plan(self, plan: P.PlanNode, session: Session) -> StatementResult:
+    def _execute_query_plan(
+        self,
+        plan: P.PlanNode,
+        session: Session,
+        collector=None,
+        query_id: Optional[str] = None,
+    ) -> StatementResult:
         from trino_tpu.memory import QueryMemoryContext
 
-        self._query_seq += 1
         ctx = QueryMemoryContext(
             self.memory_pool,
-            f"q{self._query_seq}",
+            query_id or self._next_query_id(),
             max_bytes=int(session.get("query_max_memory_bytes")),
         )
         try:
             executor = self._executor(session, ctx)
+            executor.stats_collector = collector
             batch, names = executor.execute(plan)
             return StatementResult(
                 batch.to_pylist(),
@@ -117,10 +212,9 @@ class Engine:
         plan = self.plan(query, session)
         from trino_tpu.memory import QueryMemoryContext
 
-        self._query_seq += 1
         ctx = QueryMemoryContext(
             self.memory_pool,
-            f"q{self._query_seq}",
+            self._next_query_id(),
             max_bytes=int(session.get("query_max_memory_bytes")),
         )
         try:
@@ -183,13 +277,17 @@ class Engine:
             inner = stmt.statement
             if not isinstance(inner, t.Query):
                 raise SemanticError("EXPLAIN ANALYZE supports queries only")
+            from trino_tpu.stats import StatsCollector, render_plan_with_stats
+
+            collector = StatsCollector()
             plan = self.plan(inner, session)
-            res = self._execute_query_plan(plan, session)
-            text = P.plan_text(plan)
+            res = self._execute_query_plan(plan, session, collector=collector)
+            text = render_plan_with_stats(plan, collector)
             text += (
-                f"\npeak memory: {res.peak_memory_bytes} bytes"
+                f"\n\npeak memory: {res.peak_memory_bytes} bytes"
                 f"\ndynamic filters: {res.dynamic_filters}"
                 f"\noutput rows: {len(res.rows)}"
+                f"\nwall time: {collector.total_wall() * 1000:.1f}ms"
             )
             return StatementResult(
                 [(line,) for line in text.splitlines()], ["Query Plan"], [T.VARCHAR]

@@ -1,0 +1,67 @@
+"""Query event listeners.
+
+Reference: ``event/QueryMonitor.java:92,134,210`` builds
+created/completed events → ``eventlistener/EventListenerManager.java`` →
+pluggable ``EventListener``s (``spi/eventlistener/``,
+``Plugin.getEventListenerFactories`` at ``spi/Plugin.java:80``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    user: str
+    create_time: float
+
+
+@dataclasses.dataclass
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    user: str
+    create_time: float
+    end_time: float
+    state: str  # FINISHED | FAILED
+    output_rows: int = 0
+    peak_memory_bytes: int = 0
+    error_message: Optional[str] = None
+    wall_seconds: float = 0.0
+
+
+class EventListener:
+    """Subclass and override; all hooks optional (spi/eventlistener)."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:  # noqa: B027
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:  # noqa: B027
+        pass
+
+
+class EventListenerManager:
+    def __init__(self):
+        self._listeners: list[EventListener] = []
+
+    def add(self, listener: EventListener) -> None:
+        self._listeners.append(listener)
+
+    def fire_created(self, event: QueryCreatedEvent) -> None:
+        for l in self._listeners:
+            try:
+                l.query_created(event)
+            except Exception:  # noqa: BLE001 — listeners never fail queries
+                pass
+
+    def fire_completed(self, event: QueryCompletedEvent) -> None:
+        for l in self._listeners:
+            try:
+                l.query_completed(event)
+            except Exception:  # noqa: BLE001
+                pass

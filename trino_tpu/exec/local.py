@@ -68,6 +68,8 @@ class LocalExecutor:
         # memory accounting (node -> query -> pool; see trino_tpu.memory)
         self.memory_ctx = memory_ctx
         self._reservations: dict[int, int] = {}
+        # per-node execution stats for EXPLAIN ANALYZE (OperatorStats chain)
+        self.stats_collector = None
 
     # === entry ==========================================================
     def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
@@ -84,7 +86,19 @@ class LocalExecutor:
         method = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
         if method is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
-        res = method(node)
+        if self.stats_collector is not None:
+            import time as _time
+
+            from trino_tpu.memory import batch_nbytes
+
+            t0 = _time.perf_counter()
+            res = method(node)
+            rows = int(res.batch.count_rows())  # device sync: exact timing
+            self.stats_collector.record(
+                node, _time.perf_counter() - t0, rows, batch_nbytes(res.batch)
+            )
+        else:
+            res = method(node)
         if self.memory_ctx is not None:
             from trino_tpu.memory import batch_nbytes
 

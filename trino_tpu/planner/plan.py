@@ -327,9 +327,8 @@ def walk_plan(node: PlanNode):
         yield from walk_plan(s)
 
 
-def plan_text(node: PlanNode, indent: int = 0) -> str:
-    """EXPLAIN-style tree rendering (reference: planprinter/PlanPrinter.java)."""
-    pad = "  " * indent
+def node_label(node: PlanNode) -> str:
+    """One-line description of a node (PlanPrinter's node header)."""
     name = type(node).__name__
     detail = ""
     if isinstance(node, TableScan):
@@ -352,7 +351,13 @@ def plan_text(node: PlanNode, indent: int = 0) -> str:
         detail = f" {node.scope}/{node.partitioning} keys={[s.name for s in node.keys]}"
     elif isinstance(node, Output):
         detail = f" columns={node.column_names}"
-    lines = [f"{pad}{name}{detail} -> {[s.name for s in node.output_symbols][:8]}"]
+    return f"{name}{detail} -> {[s.name for s in node.output_symbols][:8]}"
+
+
+def plan_text(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN-style tree rendering (reference: planprinter/PlanPrinter.java)."""
+    pad = "  " * indent
+    lines = [f"{pad}{node_label(node)}"]
     for s in node.sources:
         lines.append(plan_text(s, indent + 1))
     return "\n".join(lines)

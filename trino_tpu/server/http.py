@@ -51,17 +51,31 @@ class TrinoTpuServer:
         engine: Optional[Engine] = None,
         host: str = "127.0.0.1",
         port: int = 0,
-        max_concurrent: int = 4,
-        admit=None,
+        max_concurrent: int = 16,
+        resource_groups=None,
     ):
+        from trino_tpu.server.resourcegroups import ResourceGroupManager
+
         self.engine = engine or Engine()
-        self.query_manager = QueryManager(self.engine, max_concurrent, admit=admit)
+        self.resource_groups = resource_groups or ResourceGroupManager()
+        self.query_manager = QueryManager(
+            self.engine,
+            max_concurrent,
+            admit=lambda q: self.resource_groups.admit(
+                q.session.user, q.session.source
+            ),
+            complete=lambda q, group: self.resource_groups.finish(group),
+        )
         self.start_time = time.time()
         self.state = "ACTIVE"  # ACTIVE | SHUTTING_DOWN (NodeState)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
         self._thread: Optional[threading.Thread] = None
+        # live node info for system.runtime.nodes
+        self.engine._runtime_nodes_fn = lambda: [
+            ("coordinator", self.base_uri, VERSION, True, self.state)
+        ]
 
     # --- lifecycle --------------------------------------------------------
 
@@ -202,6 +216,7 @@ def _make_handler(server: TrinoTpuServer):
                 user=h.get(f"{PROTOCOL_HEADER}-User", "anonymous"),
                 catalog=h.get(f"{PROTOCOL_HEADER}-Catalog", "tpch"),
                 schema=h.get(f"{PROTOCOL_HEADER}-Schema", "tiny"),
+                source=h.get(f"{PROTOCOL_HEADER}-Source", ""),
             )
             raw = h.get(f"{PROTOCOL_HEADER}-Session", "")
             for part in raw.split(","):
@@ -259,6 +274,8 @@ def _make_handler(server: TrinoTpuServer):
                         "queries": len(server.query_manager.queries()),
                     }
                 )
+            if path == "/v1/resourceGroup":
+                return self._send_json(server.resource_groups.info())
             if path == "/v1/query":
                 return self._send_json(
                     [q.info() for q in server.query_manager.queries()]

@@ -142,12 +142,19 @@ class QueryManager:
     it may delay (queue) the query.
     """
 
-    def __init__(self, engine: Engine, max_concurrent: int = 4, admit=None):
+    def __init__(
+        self,
+        engine: Engine,
+        max_concurrent: int = 4,
+        admit=None,
+        complete=None,
+    ):
         self.engine = engine
         self._queries: dict[str, ManagedQuery] = {}
         self._lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=max_concurrent)
-        self._admit = admit
+        self._admit = admit  # (query) -> token; may block (queue) or raise
+        self._complete = complete  # (query, token) -> None
         self.max_history = 100
 
     def create_query(self, sql: str, session: Session) -> ManagedQuery:
@@ -159,15 +166,21 @@ class QueryManager:
         return q
 
     def _dispatch(self, q: ManagedQuery) -> None:
+        token = None
+        admitted = False
         try:
             if self._admit is not None:
-                self._admit(q)  # may block (queued) or raise (rejected)
+                token = self._admit(q)  # blocks while queued; raises on reject
+                admitted = True
             if q.state.get() == QueryState.QUEUED:
                 q.run(self.engine)
         except Exception as e:  # noqa: BLE001
             q.error = ErrorInfo(str(e), 3, "QUERY_REJECTED", "USER_ERROR")
             q.state.set(QueryState.FAILED)
             q.end_time = time.time()
+        finally:
+            if admitted and self._complete is not None:
+                self._complete(q, token)
 
     def get(self, query_id: str) -> Optional[ManagedQuery]:
         with self._lock:

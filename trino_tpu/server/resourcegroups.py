@@ -1,0 +1,271 @@
+"""Hierarchical resource groups: admission control and query queueing.
+
+Reference: ``core/trino-main/.../execution/resourcegroups/`` —
+``InternalResourceGroup.java`` (hierarchy, hard concurrency + queue caps,
+fair/weighted-fair/fifo scheduling), ``InternalResourceGroupManager``,
+selector-based group resolution and the file-based configuration format of
+``plugin/trino-resource-group-managers``
+(``resource_groups.json``: rootGroups + selectors).
+
+Queries queue *before* execution (dispatcher tier, L7): ``admit()`` blocks
+the dispatch thread until a slot frees, mirroring DispatchManager →
+ResourceGroupManager.submit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from collections import deque
+from typing import Any, Optional
+
+
+class QueryQueueFullError(Exception):
+    """Reference error code QUERY_QUEUE_FULL."""
+
+
+@dataclasses.dataclass
+class GroupConfig:
+    name: str
+    max_queued: int = 100
+    hard_concurrency_limit: int = 10
+    scheduling_weight: int = 1
+    scheduling_policy: str = "fair"  # fair | weighted_fair | fifo
+    subgroups: list["GroupConfig"] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class Selector:
+    """Maps (user, source) to a group path. ``${USER}`` expands."""
+
+    group: str
+    user_pattern: Optional[str] = None
+    source_pattern: Optional[str] = None
+
+    def matches(self, user: str, source: str) -> bool:
+        if self.user_pattern and not re.fullmatch(self.user_pattern, user or ""):
+            return False
+        if self.source_pattern and not re.fullmatch(self.source_pattern, source or ""):
+            return False
+        return True
+
+    def resolve(self, user: str) -> str:
+        return self.group.replace("${USER}", user or "unknown")
+
+
+class ResourceGroup:
+    """One node of the hierarchy. Thread-safe via the manager's lock."""
+
+    def __init__(self, config: GroupConfig, parent: Optional["ResourceGroup"], lock):
+        self.config = config
+        self.parent = parent
+        self._lock = lock
+        self.running = 0
+        self.queue: deque = deque()  # of (threading.Event, weight)
+        self.children: dict[str, ResourceGroup] = {}
+        for sub in config.subgroups:
+            self.children[sub.name] = ResourceGroup(sub, self, lock)
+        self.total_admitted = 0
+        self.total_queued_time = 0.0
+
+    @property
+    def full_name(self) -> str:
+        if self.parent is None:
+            return self.config.name
+        return f"{self.parent.full_name}.{self.config.name}"
+
+    def _can_run_locked(self) -> bool:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            if g.running >= g.config.hard_concurrency_limit:
+                return False
+            g = g.parent
+        return True
+
+    def _start_locked(self) -> None:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            g.running += 1
+            g = g.parent
+        self.total_admitted += 1
+
+    def _finish_locked(self) -> None:
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            g.running = max(0, g.running - 1)
+            g = g.parent
+
+    def _queued_count_locked(self) -> int:
+        n = len(self.queue)
+        for c in self.children.values():
+            n += c._queued_count_locked()
+        return n
+
+    def info(self) -> dict:
+        return {
+            "id": self.full_name,
+            "state": "FULL" if self.running >= self.config.hard_concurrency_limit else "CAN_RUN",
+            "runningQueries": self.running,
+            "queuedQueries": len(self.queue),
+            "hardConcurrencyLimit": self.config.hard_concurrency_limit,
+            "maxQueued": self.config.max_queued,
+            "schedulingPolicy": self.config.scheduling_policy,
+            "subGroups": [c.info() for c in self.children.values()],
+        }
+
+
+class ResourceGroupManager:
+    """Selector resolution + blocking admission (InternalResourceGroupManager).
+
+    ``configure(root_groups, selectors)`` mirrors resource_groups.json.
+    Without configuration, a permissive default group applies.
+    """
+
+    def __init__(self, max_wait_seconds: float = 60.0):
+        self._lock = threading.Lock()
+        self.roots: dict[str, ResourceGroup] = {}
+        self.selectors: list[Selector] = []
+        self.max_wait_seconds = max_wait_seconds
+        self.configure(
+            [GroupConfig("global", max_queued=1000, hard_concurrency_limit=100)],
+            [Selector(group="global")],
+        )
+
+    def configure(self, root_groups: list[GroupConfig], selectors: list[Selector]):
+        with self._lock:
+            self.roots = {
+                g.name: ResourceGroup(g, None, self._lock) for g in root_groups
+            }
+            self.selectors = list(selectors)
+
+    @classmethod
+    def from_config(cls, config: dict) -> "ResourceGroupManager":
+        """Build from the JSON shape of resource_groups.json."""
+
+        def group(d: dict) -> GroupConfig:
+            return GroupConfig(
+                name=d["name"],
+                max_queued=d.get("maxQueued", 100),
+                hard_concurrency_limit=d.get("hardConcurrencyLimit", 10),
+                scheduling_weight=d.get("schedulingWeight", 1),
+                scheduling_policy=d.get("schedulingPolicy", "fair"),
+                subgroups=[group(s) for s in d.get("subGroups", [])],
+            )
+
+        mgr = cls()
+        mgr.configure(
+            [group(g) for g in config.get("rootGroups", [])],
+            [
+                Selector(
+                    group=s["group"],
+                    user_pattern=s.get("user"),
+                    source_pattern=s.get("source"),
+                )
+                for s in config.get("selectors", [])
+            ],
+        )
+        return mgr
+
+    # --- resolution -------------------------------------------------------
+
+    def _resolve(self, user: str, source: str) -> ResourceGroup:
+        for sel in self.selectors:
+            if sel.matches(user, source):
+                path = sel.resolve(user).split(".")
+                with self._lock:
+                    g = self.roots.get(path[0])
+                    if g is None:
+                        continue
+                    for part in path[1:]:
+                        if part not in g.children:
+                            # dynamic per-user subgroup (template expansion)
+                            g.children[part] = ResourceGroup(
+                                GroupConfig(
+                                    part,
+                                    max_queued=g.config.max_queued,
+                                    hard_concurrency_limit=g.config.hard_concurrency_limit,
+                                ),
+                                g,
+                                self._lock,
+                            )
+                        g = g.children[part]
+                    return g
+        raise QueryQueueFullError("no resource group matches this query")
+
+    # --- admission --------------------------------------------------------
+
+    def admit(self, user: str, source: str = "") -> ResourceGroup:
+        """Blocks until a slot is available. Raises when the queue is full
+        or the wait times out."""
+        group = self._resolve(user, source)
+        event: Optional[threading.Event] = None
+        with self._lock:
+            if group._can_run_locked() and not group.queue:
+                group._start_locked()
+                return group
+            if len(group.queue) >= group.config.max_queued:
+                raise QueryQueueFullError(
+                    f"Too many queued queries for '{group.full_name}'"
+                )
+            event = threading.Event()
+            group.queue.append(event)
+        if not event.wait(self.max_wait_seconds):
+            with self._lock:
+                if event.is_set():
+                    return group  # admitted concurrently with the timeout
+                group.queue.remove(event)
+            raise QueryQueueFullError(
+                f"Query exceeded maximum queue wait for '{group.full_name}'"
+            )
+        return group
+
+    def finish(self, group: ResourceGroup) -> None:
+        with self._lock:
+            group._finish_locked()
+            self._wake_next_locked(group)
+
+    def _wake_next_locked(self, group: ResourceGroup) -> None:
+        """Wake queued queries anywhere in the hierarchy that can now run.
+        fair/fifo: FIFO within a group; weighted_fair: highest
+        weight/(running+1) subgroup first (WeightedFairQueue analog)."""
+        g: Optional[ResourceGroup] = group
+        while g is not None:
+            self._wake_in_subtree_locked(self._root_of(g))
+            g = None  # single pass over the root's subtree suffices
+
+    def _root_of(self, g: ResourceGroup) -> ResourceGroup:
+        while g.parent is not None:
+            g = g.parent
+        return g
+
+    def _wake_in_subtree_locked(self, g: ResourceGroup) -> None:
+        while True:
+            candidate = self._pick_candidate_locked(g)
+            if candidate is None:
+                return
+            ev = candidate.queue.popleft()
+            candidate._start_locked()
+            ev.set()
+
+    def _pick_candidate_locked(self, g: ResourceGroup) -> Optional[ResourceGroup]:
+        if not g._can_run_locked():
+            return None
+        if g.queue:
+            return g
+        kids = [c for c in g.children.values() if c._queued_count_locked() > 0]
+        if not kids:
+            return None
+        if g.config.scheduling_policy == "weighted_fair":
+            kids.sort(
+                key=lambda c: -(c.config.scheduling_weight / (c.running + 1))
+            )
+        for c in kids:
+            found = self._pick_candidate_locked(c)
+            if found is not None:
+                return found
+        return None
+
+    def info(self) -> list[dict]:
+        with self._lock:
+            return [g.info() for g in self.roots.values()]

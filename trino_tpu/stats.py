@@ -1,0 +1,72 @@
+"""Execution statistics: per-node stats chain + EXPLAIN ANALYZE rendering.
+
+Reference: ``operator/OperatorStats.java`` rolled up through
+Driver→Pipeline→Task→Stage→Query (``operator/DriverContext.java``,
+``execution/QueryStats.java``), surfaced by ``ExplainAnalyzeOperator.java:34``
+via ``sql/planner/planprinter/PlanPrinter.java:148``.
+
+Our executor materializes one plan node at a time, so stats attach per
+plan node (the reference's per-operator granularity at our altitude).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from trino_tpu.planner import plan as P
+
+
+@dataclasses.dataclass
+class NodeStats:
+    """One plan node's execution record (OperatorStats analog)."""
+
+    node_type: str
+    wall_seconds: float = 0.0
+    output_rows: int = 0
+    output_bytes: int = 0
+    detail: str = ""
+
+
+class StatsCollector:
+    """Keyed by plan-node identity; nodes kept alive by the plan itself."""
+
+    def __init__(self):
+        self.by_node: dict[int, NodeStats] = {}
+        self._inclusive: dict[int, float] = {}
+        self._keep: list = []  # retain node refs so id() stays valid
+
+    def record(self, node, wall: float, rows: int, bytes_: int, detail: str = ""):
+        """``wall`` is inclusive of children (the executor times the whole
+        subtree); stored per-node time is exclusive — children's inclusive
+        times are subtracted so nothing double-counts."""
+        self._keep.append(node)
+        self._inclusive[id(node)] = wall
+        children = sum(self._inclusive.get(id(s), 0.0) for s in node.sources)
+        self.by_node[id(node)] = NodeStats(
+            type(node).__name__, max(0.0, wall - children), rows, bytes_, detail
+        )
+
+    def total_wall(self) -> float:
+        return sum(s.wall_seconds for s in self.by_node.values())
+
+
+def render_plan_with_stats(
+    node: P.PlanNode, collector: Optional[StatsCollector], indent: int = 0
+) -> str:
+    """PlanPrinter.textDistributedPlan-with-stats analog: the logical plan
+    annotated with wall time / rows / bytes per node."""
+    pad = "  " * indent
+    line = f"{pad}{P.node_label(node)}"
+    if collector is not None:
+        st = collector.by_node.get(id(node))
+        if st is not None:
+            line += (
+                f"   [wall: {st.wall_seconds * 1000:.1f}ms, "
+                f"rows: {st.output_rows:,}, bytes: {st.output_bytes:,}]"
+            )
+    out = [line]
+    for s in node.sources:
+        out.append(render_plan_with_stats(s, collector, indent + 1))
+    return "\n".join(out)
