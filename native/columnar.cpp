@@ -1,0 +1,270 @@
+// Host-side columnar hot loops (C ABI, loaded via ctypes).
+//
+// Role in the architecture: the reference's performance tier is runtime
+// JVM bytecode generation (core/trino-main/.../sql/gen/) for its hot
+// loops; our device hot loops are XLA-compiled (jax.jit / pallas). What
+// remains hot on the HOST are columnar preparation loops feeding the
+// device and the exchange/spill wire format
+// (execution/buffer/PagesSerde.java:41,64 — per-block encodings +
+// compression). Those live here in C++:
+//
+//   - dictionary encoding of varchar batches (string -> dense int32 code)
+//   - RLE + bitpack + zigzag-varint integer codecs (page wire format)
+//   - byte-level LZ-style compression for spill/exchange pages
+//
+// Build: g++ -O3 -shared -fPIC (driven by trino_tpu/native/__init__.py).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ===== dictionary encode ====================================================
+// Strings are given as a concatenated UTF-8 buffer + (n+1) offsets.
+// Produces: codes[i] = dense id of string i (first-seen order), and
+// first_occurrence[j] = row index introducing code j. Returns #unique.
+// (MultiChannelGroupByHash-style open addressing, FILL_RATIO 0.5.)
+
+static inline uint64_t hash_bytes(const char* p, int64_t len) {
+    uint64_t h = 1469598103934665603ull;  // FNV-1a
+    for (int64_t i = 0; i < len; i++) {
+        h ^= (unsigned char)p[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+int64_t tt_dict_encode(const char* bytes, const int64_t* offsets, int64_t n,
+                       int32_t* codes, int64_t* first_occurrence) {
+    if (n <= 0) return 0;
+    int64_t cap = 16;
+    while (cap < n * 2) cap <<= 1;
+    std::vector<int64_t> table(cap, -1);  // slot -> first row of that string
+    std::vector<int32_t> slot_code(cap, -1);
+    const uint64_t mask = (uint64_t)cap - 1;
+    int64_t n_unique = 0;
+    for (int64_t i = 0; i < n; i++) {
+        const char* s = bytes + offsets[i];
+        const int64_t len = offsets[i + 1] - offsets[i];
+        uint64_t slot = hash_bytes(s, len) & mask;
+        for (;;) {
+            int64_t row = table[slot];
+            if (row < 0) {  // new string
+                table[slot] = i;
+                slot_code[slot] = (int32_t)n_unique;
+                first_occurrence[n_unique] = i;
+                codes[i] = (int32_t)n_unique;
+                n_unique++;
+                break;
+            }
+            const int64_t rlen = offsets[row + 1] - offsets[row];
+            if (rlen == len && memcmp(bytes + offsets[row], s, (size_t)len) == 0) {
+                codes[i] = slot_code[slot];
+                break;
+            }
+            slot = (slot + 1) & mask;
+        }
+    }
+    return n_unique;
+}
+
+// ===== integer codecs =======================================================
+// Zigzag varint: small signed deltas -> few bytes (PagesSerde's long
+// encodings analog). Returns bytes written; out must hold 10*n bytes.
+
+static inline uint64_t zigzag(int64_t v) {
+    return ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+}
+static inline int64_t unzigzag(uint64_t u) {
+    return (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+}
+
+int64_t tt_varint_encode(const int64_t* values, int64_t n, uint8_t* out) {
+    uint8_t* p = out;
+    int64_t prev = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t u = zigzag(values[i] - prev);  // delta encoding
+        prev = values[i];
+        while (u >= 0x80) {
+            *p++ = (uint8_t)(u | 0x80);
+            u >>= 7;
+        }
+        *p++ = (uint8_t)u;
+    }
+    return p - out;
+}
+
+// Returns bytes consumed, or -1 if the input is truncated/corrupt.
+int64_t tt_varint_decode(const uint8_t* in, int64_t in_len, int64_t n_values,
+                         int64_t* out) {
+    const uint8_t* p = in;
+    const uint8_t* end = in + in_len;
+    int64_t prev = 0;
+    for (int64_t i = 0; i < n_values; i++) {
+        uint64_t u = 0;
+        int shift = 0;
+        for (;;) {
+            if (p >= end || shift > 63) return -1;
+            uint8_t b = *p++;
+            u |= (uint64_t)(b & 0x7f) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        prev += unzigzag(u);
+        out[i] = prev;
+    }
+    return p - in;
+}
+
+// RLE: (run_len varint, value varint) pairs. Good for sorted/constant
+// columns (RunLengthEncodedBlock analog). Returns bytes written.
+
+int64_t tt_rle_encode(const int64_t* values, int64_t n, uint8_t* out) {
+    uint8_t* p = out;
+    int64_t i = 0;
+    while (i < n) {
+        int64_t run = 1;
+        while (i + run < n && values[i + run] == values[i]) run++;
+        uint64_t u = (uint64_t)run;
+        while (u >= 0x80) { *p++ = (uint8_t)(u | 0x80); u >>= 7; }
+        *p++ = (uint8_t)u;
+        u = zigzag(values[i]);
+        while (u >= 0x80) { *p++ = (uint8_t)(u | 0x80); u >>= 7; }
+        *p++ = (uint8_t)u;
+        i += run;
+    }
+    return p - out;
+}
+
+// Returns bytes consumed, or -1 if the input is truncated/corrupt.
+int64_t tt_rle_decode(const uint8_t* in, int64_t in_len, int64_t n_values,
+                      int64_t* out) {
+    const uint8_t* p = in;
+    const uint8_t* end = in + in_len;
+    int64_t i = 0;
+    while (i < n_values) {
+        uint64_t run = 0, u = 0;
+        int shift = 0;
+        for (;;) { if (p >= end || shift > 63) return -1;
+                   uint8_t b = *p++; run |= (uint64_t)(b & 0x7f) << shift;
+                   if (!(b & 0x80)) break; shift += 7; }
+        shift = 0;
+        for (;;) { if (p >= end || shift > 63) return -1;
+                   uint8_t b = *p++; u |= (uint64_t)(b & 0x7f) << shift;
+                   if (!(b & 0x80)) break; shift += 7; }
+        if (run == 0) return -1;
+        int64_t v = unzigzag(u);
+        for (uint64_t r = 0; r < run && i < n_values; r++) out[i++] = v;
+    }
+    return p - in;
+}
+
+// Bitpack: n values of fixed bit_width (caller computes width from max).
+// Returns bytes written = ceil(n*width/8).
+
+int64_t tt_bitpack_encode(const uint64_t* values, int64_t n, int32_t width,
+                          uint8_t* out) {
+    int64_t nbytes = (n * width + 7) / 8;
+    memset(out, 0, (size_t)nbytes);
+    int64_t bit = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t v = values[i];
+        for (int32_t b = 0; b < width; b++, bit++) {
+            if ((v >> b) & 1) out[bit >> 3] |= (uint8_t)(1u << (bit & 7));
+        }
+    }
+    return nbytes;
+}
+
+void tt_bitpack_decode(const uint8_t* in, int64_t n, int32_t width,
+                       uint64_t* out) {
+    int64_t bit = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t v = 0;
+        for (int32_t b = 0; b < width; b++, bit++) {
+            if ((in[bit >> 3] >> (bit & 7)) & 1) v |= (1ull << b);
+        }
+        out[i] = v;
+    }
+}
+
+// ===== byte compression =====================================================
+// LZ-style with 64Ki hash table, greedy matching (format: literal runs +
+// (offset,len) copies). The PagesSerde LZ4-compression analog for
+// spill/exchange pages. Self-inverse pair; not LZ4-frame compatible.
+
+int64_t tt_lz_compress(const uint8_t* in, int64_t n, uint8_t* out) {
+    // token: 1 byte — high bit 0: literal run (len = tok+1, max 128)
+    //                 high bit 1: match (len = (tok&0x7f)+4), then 2-byte LE offset
+    if (n == 0) return 0;
+    std::vector<int64_t> table(1 << 16, -1);
+    uint8_t* op = out;
+    int64_t i = 0, lit_start = 0;
+    auto flush_literals = [&](int64_t end) {
+        int64_t len = end - lit_start;
+        while (len > 0) {
+            int64_t take = len > 128 ? 128 : len;
+            *op++ = (uint8_t)(take - 1);
+            memcpy(op, in + lit_start, (size_t)take);
+            op += take;
+            lit_start += take;
+            len -= take;
+        }
+    };
+    while (i + 4 <= n) {
+        uint32_t key;
+        memcpy(&key, in + i, 4);
+        uint32_t h = (key * 2654435761u) >> 16;
+        int64_t cand = table[h];
+        table[h] = i;
+        if (cand >= 0 && i - cand <= 0xffff &&
+            memcmp(in + cand, in + i, 4) == 0) {
+            int64_t len = 4;
+            while (i + len < n && len < 131 && in[cand + len] == in[i + len]) len++;
+            flush_literals(i);
+            *op++ = (uint8_t)(0x80 | (len - 4));
+            uint16_t off = (uint16_t)(i - cand);
+            *op++ = (uint8_t)(off & 0xff);
+            *op++ = (uint8_t)(off >> 8);
+            i += len;
+            lit_start = i;
+        } else {
+            i++;
+        }
+    }
+    flush_literals(n);
+    return op - out;
+}
+
+// Returns bytes written, or -1 on truncated/corrupt input or out_cap
+// overflow (bounds-checked: pages arrive over the network).
+int64_t tt_lz_decompress(const uint8_t* in, int64_t in_len, uint8_t* out,
+                         int64_t out_cap) {
+    const uint8_t* ip = in;
+    const uint8_t* end = in + in_len;
+    uint8_t* op = out;
+    const uint8_t* out_end = out + out_cap;
+    while (ip < end) {
+        uint8_t tok = *ip++;
+        if (tok & 0x80) {
+            int64_t len = (tok & 0x7f) + 4;
+            if (ip + 2 > end || op + len > out_end) return -1;
+            uint16_t off = (uint16_t)(ip[0] | (ip[1] << 8));
+            ip += 2;
+            if (off == 0 || op - off < out) return -1;
+            uint8_t* src = op - off;
+            for (int64_t k = 0; k < len; k++) op[k] = src[k];  // may overlap
+            op += len;
+        } else {
+            int64_t len = tok + 1;
+            if (ip + len > end || op + len > out_end) return -1;
+            memcpy(op, ip, (size_t)len);
+            ip += len;
+            op += len;
+        }
+    }
+    return op - out;
+}
+
+}  // extern "C"

@@ -68,20 +68,14 @@ class Dictionary:
 
     @staticmethod
     def from_strings(strings: Iterable[str]) -> tuple["Dictionary", np.ndarray]:
-        """Build a dictionary and the code array for a string sequence."""
-        values: list[str] = []
-        index: dict[str, int] = {}
-        codes = []
-        for s in strings:
-            code = index.get(s)
-            if code is None:
-                code = len(values)
-                index[s] = code
-                values.append(s)
-            codes.append(code)
-        d = Dictionary(values)
-        d._index = index
-        return d, np.asarray(codes, dtype=np.int32)
+        """Build a dictionary and the code array for a string sequence.
+        Hot host loop — uses the native hash table (native/columnar.cpp
+        tt_dict_encode) when built, with a Python fallback inside."""
+        from trino_tpu.native import dict_encode
+
+        strings = strings if isinstance(strings, list) else list(strings)
+        codes, values = dict_encode(strings)
+        return Dictionary(values), codes
 
     def merged(self, other: "Dictionary") -> tuple["Dictionary", np.ndarray]:
         """Merge other into a new dictionary; returns (merged, remap) where

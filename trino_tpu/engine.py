@@ -118,8 +118,8 @@ class Engine:
         )
         record = {
             "queryId": qid, "state": "RUNNING", "user": session.user,
-            "query": sql, "elapsedTimeMillis": 0, "peakMemoryBytes": 0,
-            "outputRows": 0, "_start": t0,
+            "source": session.source, "query": sql, "elapsedTimeMillis": 0,
+            "peakMemoryBytes": 0, "outputRows": 0, "_start": t0,
         }
         self._recent_queries.append(record)
         error: Optional[str] = None

@@ -1,0 +1,319 @@
+"""Native host tier: ctypes bindings for native/columnar.cpp.
+
+Compiles the shared library on first import (g++ -O3 -shared -fPIC,
+rebuilt when the source changes) and exposes numpy-friendly wrappers.
+Every function has a pure-NumPy fallback so the engine works without a
+toolchain (``NATIVE_AVAILABLE`` reports which path is active).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "..", "..", "native", "columnar.cpp")
+_LIB: Optional[ctypes.CDLL] = None
+NATIVE_AVAILABLE = False
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return None
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.path.join(tempfile.gettempdir(), "trino_tpu_native")
+    os.makedirs(cache_dir, exist_ok=True)
+    lib_path = os.path.join(cache_dir, f"columnar_{digest}.so")
+    if not os.path.exists(lib_path):
+        tmp = lib_path + f".tmp{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, src],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, lib_path)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+    except OSError:
+        return None
+    i64, u8p, i64p, i32p, u64p = (
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_uint64),
+    )
+    lib.tt_dict_encode.restype = i64
+    lib.tt_dict_encode.argtypes = [ctypes.c_char_p, i64p, i64, i32p, i64p]
+    lib.tt_varint_encode.restype = i64
+    lib.tt_varint_encode.argtypes = [i64p, i64, u8p]
+    lib.tt_varint_decode.restype = i64
+    lib.tt_varint_decode.argtypes = [u8p, i64, i64, i64p]
+    lib.tt_rle_encode.restype = i64
+    lib.tt_rle_encode.argtypes = [i64p, i64, u8p]
+    lib.tt_rle_decode.restype = i64
+    lib.tt_rle_decode.argtypes = [u8p, i64, i64, i64p]
+    lib.tt_bitpack_encode.restype = i64
+    lib.tt_bitpack_encode.argtypes = [u64p, i64, ctypes.c_int32, u8p]
+    lib.tt_bitpack_decode.restype = None
+    lib.tt_bitpack_decode.argtypes = [u8p, i64, ctypes.c_int32, u64p]
+    lib.tt_lz_compress.restype = i64
+    lib.tt_lz_compress.argtypes = [u8p, i64, u8p]
+    lib.tt_lz_decompress.restype = i64
+    lib.tt_lz_decompress.argtypes = [u8p, i64, u8p, i64]
+    return lib
+
+
+_LIB = _build_and_load()
+NATIVE_AVAILABLE = _LIB is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# === dictionary encode ======================================================
+
+
+def dict_encode(strings: Sequence[str]) -> tuple[np.ndarray, list[str]]:
+    """codes (int32) + unique values in first-seen order."""
+    n = len(strings)
+    if n == 0:
+        return np.zeros(0, dtype=np.int32), []
+    if _LIB is not None:
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        pos = 0
+        enc = [s.encode("utf-8", "surrogatepass") for s in strings]
+        blob = b"".join(enc)
+        for i, e in enumerate(enc):
+            offsets[i] = pos
+            pos += len(e)
+        offsets[n] = pos
+        codes = np.empty(n, dtype=np.int32)
+        first = np.empty(n, dtype=np.int64)
+        n_unique = _LIB.tt_dict_encode(
+            blob,
+            _ptr(offsets, ctypes.c_int64),
+            n,
+            _ptr(codes, ctypes.c_int32),
+            _ptr(first, ctypes.c_int64),
+        )
+        uniques = [strings[first[j]] for j in range(n_unique)]
+        return codes, uniques
+    # fallback
+    index: dict[str, int] = {}
+    codes = np.empty(n, dtype=np.int32)
+    uniques: list[str] = []
+    for i, s in enumerate(strings):
+        c = index.get(s)
+        if c is None:
+            c = len(uniques)
+            index[s] = c
+            uniques.append(s)
+        codes[i] = c
+    return codes, uniques
+
+
+# === integer codecs =========================================================
+
+
+def varint_encode(values: np.ndarray) -> bytes:
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    n = len(values)
+    if n == 0:
+        return b""
+    if _LIB is not None:
+        out = np.empty(10 * n, dtype=np.uint8)
+        ln = _LIB.tt_varint_encode(
+            _ptr(values, ctypes.c_int64), n, _ptr(out, ctypes.c_uint8)
+        )
+        return out[:ln].tobytes()
+    # fallback: delta + zigzag varint in python
+    out = bytearray()
+    prev = 0
+    for v in values.tolist():
+        u = ((v - prev) << 1) ^ ((v - prev) >> 63) if (v - prev) < 0 else (v - prev) << 1
+        u &= (1 << 64) - 1
+        prev = v
+        while u >= 0x80:
+            out.append((u & 0x7F) | 0x80)
+            u >>= 7
+        out.append(u)
+    return bytes(out)
+
+
+def varint_decode(data: bytes, n: int) -> np.ndarray:
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    if _LIB is not None:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(n, dtype=np.int64)
+        rc = _LIB.tt_varint_decode(
+            _ptr(buf, ctypes.c_uint8), len(buf), n, _ptr(out, ctypes.c_int64)
+        )
+        if rc < 0:
+            raise ValueError("corrupt varint page")
+        return out
+    out = np.empty(n, dtype=np.int64)
+    pos = 0
+    prev = 0
+    for i in range(n):
+        u = 0
+        shift = 0
+        while True:
+            b = data[pos]
+            pos += 1
+            u |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        d = (u >> 1) ^ -(u & 1)
+        prev += d
+        out[i] = prev
+    return out
+
+
+def rle_encode(values: np.ndarray) -> bytes:
+    values = np.ascontiguousarray(values, dtype=np.int64)
+    n = len(values)
+    if n == 0:
+        return b""
+    if _LIB is not None:
+        out = np.empty(20 * n + 16, dtype=np.uint8)
+        ln = _LIB.tt_rle_encode(
+            _ptr(values, ctypes.c_int64), n, _ptr(out, ctypes.c_uint8)
+        )
+        return out[:ln].tobytes()
+    out = bytearray()
+    i = 0
+    vals = values.tolist()
+    while i < n:
+        run = 1
+        while i + run < n and vals[i + run] == vals[i]:
+            run += 1
+        for u in (run, (vals[i] << 1) ^ (vals[i] >> 63) if vals[i] < 0 else vals[i] << 1):
+            u &= (1 << 64) - 1
+            while u >= 0x80:
+                out.append((u & 0x7F) | 0x80)
+                u >>= 7
+            out.append(u)
+        i += run
+    return bytes(out)
+
+
+def rle_decode(data: bytes, n: int) -> np.ndarray:
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    if _LIB is not None:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(n, dtype=np.int64)
+        rc = _LIB.tt_rle_decode(
+            _ptr(buf, ctypes.c_uint8), len(buf), n, _ptr(out, ctypes.c_int64)
+        )
+        if rc < 0:
+            raise ValueError("corrupt RLE page")
+        return out
+    out = np.empty(n, dtype=np.int64)
+    pos = 0
+    i = 0
+    while i < n:
+        parts = []
+        for _ in range(2):
+            u = 0
+            shift = 0
+            while True:
+                b = data[pos]
+                pos += 1
+                u |= (b & 0x7F) << shift
+                if not (b & 0x80):
+                    break
+                shift += 7
+            parts.append(u)
+        run, u = parts
+        v = (u >> 1) ^ -(u & 1)
+        for _ in range(run):
+            if i < n:
+                out[i] = v
+                i += 1
+    return out
+
+
+def bitpack_encode(values: np.ndarray, width: int) -> bytes:
+    values = np.ascontiguousarray(values, dtype=np.uint64)
+    n = len(values)
+    if n == 0 or width == 0:
+        return b""
+    if _LIB is not None:
+        out = np.zeros((n * width + 7) // 8, dtype=np.uint8)
+        _LIB.tt_bitpack_encode(
+            _ptr(values, ctypes.c_uint64), n, width, _ptr(out, ctypes.c_uint8)
+        )
+        return out.tobytes()
+    bits = np.zeros(n * width, dtype=np.uint8)
+    for b in range(width):
+        bits[b::width] = (values >> np.uint64(b)) & np.uint64(1)
+    return np.packbits(bits, bitorder="little").tobytes()
+
+
+def bitpack_decode(data: bytes, n: int, width: int) -> np.ndarray:
+    if n == 0 or width == 0:
+        return np.zeros(n, dtype=np.uint64)
+    if _LIB is not None:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(n, dtype=np.uint64)
+        _LIB.tt_bitpack_decode(
+            _ptr(buf, ctypes.c_uint8), n, width, _ptr(out, ctypes.c_uint64)
+        )
+        return out
+    bits = np.unpackbits(np.frombuffer(data, dtype=np.uint8), bitorder="little")
+    bits = bits[: n * width].reshape(n, width).astype(np.uint64)
+    out = np.zeros(n, dtype=np.uint64)
+    for b in range(width):
+        out |= bits[:, b] << np.uint64(b)
+    return out
+
+
+def lz_compress(data: bytes) -> bytes:
+    if not data:
+        return b""
+    if _LIB is not None:
+        inp = np.frombuffer(data, dtype=np.uint8)
+        # worst case: all literals -> n + n/128 + 1 token bytes
+        out = np.empty(len(data) + len(data) // 128 + 16, dtype=np.uint8)
+        ln = _LIB.tt_lz_compress(
+            _ptr(inp, ctypes.c_uint8), len(data), _ptr(out, ctypes.c_uint8)
+        )
+        return out[:ln].tobytes()
+    import zlib
+
+    return zlib.compress(data, 1)
+
+
+def lz_decompress(data: bytes, expected_len: int) -> bytes:
+    if not data:
+        return b""
+    if _LIB is not None:
+        inp = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(expected_len, dtype=np.uint8)
+        ln = _LIB.tt_lz_decompress(
+            _ptr(inp, ctypes.c_uint8), len(data), _ptr(out, ctypes.c_uint8),
+            expected_len,
+        )
+        if ln < 0:
+            raise ValueError("corrupt compressed page")
+        return out[:ln].tobytes()
+    import zlib
+
+    return zlib.decompress(data)

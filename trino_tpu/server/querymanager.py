@@ -14,7 +14,6 @@ import secrets
 import threading
 import time
 import traceback
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Optional
 
 from trino_tpu import types as T
@@ -152,17 +151,24 @@ class QueryManager:
         self.engine = engine
         self._queries: dict[str, ManagedQuery] = {}
         self._lock = threading.Lock()
-        self._pool = ThreadPoolExecutor(max_workers=max_concurrent)
+        # dedicated thread per query: admission may BLOCK (queued state), so
+        # a bounded pool would let waiters exhaust dispatch slots and bypass
+        # the resource groups' own max_queued caps. Execution concurrency is
+        # bounded by resource-group admission (max_concurrent is advisory
+        # for the default permissive group installed by the server).
         self._admit = admit  # (query) -> token; may block (queue) or raise
         self._complete = complete  # (query, token) -> None
         self.max_history = 100
+        self._shutdown = False
 
     def create_query(self, sql: str, session: Session) -> ManagedQuery:
         q = ManagedQuery(sql, session)
         with self._lock:
+            if self._shutdown:
+                raise RuntimeError("query manager is shut down")
             self._queries[q.query_id] = q
             self._gc_locked()
-        self._pool.submit(self._dispatch, q)
+        threading.Thread(target=self._dispatch, args=(q,), daemon=True).start()
         return q
 
     def _dispatch(self, q: ManagedQuery) -> None:
@@ -213,4 +219,5 @@ class QueryManager:
             self._queries.pop(q.query_id, None)
 
     def shutdown(self, wait: bool = True) -> None:
-        self._pool.shutdown(wait=wait)
+        with self._lock:
+            self._shutdown = True

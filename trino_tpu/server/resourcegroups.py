@@ -57,12 +57,19 @@ class Selector:
 class ResourceGroup:
     """One node of the hierarchy. Thread-safe via the manager's lock."""
 
-    def __init__(self, config: GroupConfig, parent: Optional["ResourceGroup"], lock):
+    def __init__(
+        self,
+        config: GroupConfig,
+        parent: Optional["ResourceGroup"],
+        lock,
+        dynamic: bool = False,
+    ):
         self.config = config
         self.parent = parent
         self._lock = lock
+        self.dynamic = dynamic  # ${USER}-template subgroup: evicted when idle
         self.running = 0
-        self.queue: deque = deque()  # of (threading.Event, weight)
+        self.queue: deque = deque()  # waiting admissions (threading.Event)
         self.children: dict[str, ResourceGroup] = {}
         for sub in config.subgroups:
             self.children[sub.name] = ResourceGroup(sub, self, lock)
@@ -188,6 +195,7 @@ class ResourceGroupManager:
                                 ),
                                 g,
                                 self._lock,
+                                dynamic=True,
                             )
                         g = g.children[part]
                     return g
@@ -224,15 +232,22 @@ class ResourceGroupManager:
         with self._lock:
             group._finish_locked()
             self._wake_next_locked(group)
+            self._evict_idle_dynamic_locked(group)
+
+    def _evict_idle_dynamic_locked(self, group: ResourceGroup) -> None:
+        """Drop idle ${USER}-template subgroups so distinct users don't
+        grow the tree without bound (reference: disabled-group eviction)."""
+        g: Optional[ResourceGroup] = group
+        while g is not None and g.parent is not None:
+            if g.dynamic and g.running == 0 and not g.queue and not g.children:
+                g.parent.children.pop(g.config.name, None)
+            g = g.parent
 
     def _wake_next_locked(self, group: ResourceGroup) -> None:
         """Wake queued queries anywhere in the hierarchy that can now run.
         fair/fifo: FIFO within a group; weighted_fair: highest
         weight/(running+1) subgroup first (WeightedFairQueue analog)."""
-        g: Optional[ResourceGroup] = group
-        while g is not None:
-            self._wake_in_subtree_locked(self._root_of(g))
-            g = None  # single pass over the root's subtree suffices
+        self._wake_in_subtree_locked(self._root_of(group))
 
     def _root_of(self, g: ResourceGroup) -> ResourceGroup:
         while g.parent is not None:
