@@ -1,0 +1,141 @@
+"""TPC-DS connector: schemas, generation determinism, referential
+structure, and Q64/Q95-family query shapes.
+
+Mirrors reference tests in ``plugin/trino-tpcds``.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.tpcds import TpcdsConnector, _SCHEMAS
+from trino_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpcdsConnector()
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+class TestMetadata:
+    def test_all_24_tables(self, conn):
+        tables = conn.list_tables("tiny")
+        assert len(tables) == 24
+        for t in ("store_sales", "store_returns", "catalog_sales",
+                  "catalog_returns", "web_sales", "web_returns", "inventory",
+                  "date_dim", "item", "customer", "store", "warehouse"):
+            assert t in tables
+
+    def test_schemas_readable(self, conn):
+        for table in conn.list_tables("tiny"):
+            ts = conn.get_table("tiny", table)
+            splits = conn.get_splits("tiny", table, 4)
+            b = conn.read_split("tiny", table, ts.column_names()[:4], splits[0])
+            assert b.num_rows > 0, table
+
+    def test_deterministic(self, conn):
+        s = conn.get_splits("tiny", "store_sales", 4)[0]
+        a = conn.read_split("tiny", "store_sales", ["ss_item_sk", "ss_net_paid"], s)
+        b = conn.read_split("tiny", "store_sales", ["ss_item_sk", "ss_net_paid"], s)
+        assert np.array_equal(np.asarray(a.columns[0].data), np.asarray(b.columns[0].data))
+        assert np.array_equal(np.asarray(a.columns[1].data), np.asarray(b.columns[1].data))
+
+
+class TestReferentialStructure:
+    def test_fact_fks_in_dimension_range(self, conn):
+        s = conn.get_splits("tiny", "store_sales", 1)[0]
+        b = conn.read_split(
+            "tiny", "store_sales",
+            ["ss_item_sk", "ss_customer_sk", "ss_store_sk", "ss_sold_date_sk"], s
+        )
+        item = np.asarray(b.columns[0].data)
+        cust = np.asarray(b.columns[1].data)
+        store = np.asarray(b.columns[2].data)
+        n_items = conn.estimate_rows("tiny", "item")
+        n_cust = conn.estimate_rows("tiny", "customer")
+        n_store = conn.estimate_rows("tiny", "store")
+        assert item.min() >= 1 and item.max() <= n_items
+        assert cust.min() >= 1 and cust.max() <= n_cust
+        assert store.min() >= 1 and store.max() <= n_store
+
+    def test_returns_subset_of_sales(self, conn):
+        s = conn.get_splits("tiny", "store_sales", 1)[0]
+        sales = conn.read_split("tiny", "store_sales",
+                                ["ss_item_sk", "ss_ticket_number"], s)
+        rets = conn.read_split("tiny", "store_returns",
+                               ["sr_item_sk", "sr_ticket_number"], s)
+        sales_keys = set(zip(
+            np.asarray(sales.columns[0].data).tolist(),
+            np.asarray(sales.columns[1].data).tolist(),
+        ))
+        ret_keys = list(zip(
+            np.asarray(rets.columns[0].data).tolist(),
+            np.asarray(rets.columns[1].data).tolist(),
+        ))
+        assert ret_keys, "no returns generated"
+        assert all(k in sales_keys for k in ret_keys)
+        # ~10% return rate
+        assert 0.05 < len(ret_keys) / len(sales_keys) < 0.15
+
+    def test_date_dim_consistency(self, conn):
+        s = conn.get_splits("tiny", "date_dim", 1)[0]
+        b = conn.read_split("tiny", "date_dim",
+                            ["d_year", "d_moy", "d_dom", "d_date_sk"], s)
+        year = np.asarray(b.columns[0].data)
+        moy = np.asarray(b.columns[1].data)
+        assert year.min() == 1998 and year.max() == 2003
+        assert moy.min() == 1 and moy.max() == 12
+
+
+class TestQueries:
+    def test_simple_agg(self, runner):
+        rows, _ = runner.execute(
+            "select d_year, count(*) c from tpcds.tiny.date_dim "
+            "group by d_year order by d_year"
+        )
+        assert [r[0] for r in rows] == [1998, 1999, 2000, 2001, 2002, 2003]
+        assert sum(r[1] for r in rows) == 2191
+
+    def test_q95_shape(self, runner):
+        # Q95 family: ws/wr order-number semijoin with date/site filters
+        rows, _ = runner.execute(
+            "select count(distinct ws.ws_order_number) "
+            "from tpcds.tiny.web_sales ws "
+            "join tpcds.tiny.date_dim d on ws.ws_ship_date_sk = d.d_date_sk "
+            "where d.d_year = 1999 "
+            "and ws.ws_order_number in "
+            "(select wr_order_number from tpcds.tiny.web_returns)"
+        )
+        assert rows[0][0] > 0
+
+    def test_q64_shape(self, runner):
+        # Q64 family: store_sales x store_returns x item x date_dim
+        rows, _ = runner.execute(
+            "select i.i_category, count(*) cnt, sum(ss.ss_net_paid) paid "
+            "from tpcds.tiny.store_sales ss "
+            "join tpcds.tiny.store_returns sr "
+            "  on ss.ss_item_sk = sr.sr_item_sk "
+            " and ss.ss_ticket_number = sr.sr_ticket_number "
+            "join tpcds.tiny.item i on ss.ss_item_sk = i.i_item_sk "
+            "join tpcds.tiny.date_dim d on ss.ss_sold_date_sk = d.d_date_sk "
+            "where d.d_year between 1999 and 2001 "
+            "group by i.i_category order by cnt desc"
+        )
+        assert rows
+        assert sum(r[1] for r in rows) > 0
+
+    def test_channel_union(self, runner):
+        rows, _ = runner.execute(
+            "select 'store' channel, count(*) c from tpcds.tiny.store_sales "
+            "union all select 'web', count(*) from tpcds.tiny.web_sales "
+            "union all select 'catalog', count(*) from tpcds.tiny.catalog_sales"
+        )
+        assert len(rows) == 3 and all(r[1] > 0 for r in rows)
+
+    def test_show_tables(self, runner):
+        rows, _ = runner.execute("show tables from tpcds.tiny")
+        assert len(rows) == 24

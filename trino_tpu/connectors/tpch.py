@@ -292,7 +292,12 @@ class TpchConnector(Connector):
         return lo, hi
 
     def _rng(self, table: str, index: int) -> np.random.Generator:
-        return np.random.default_rng(abs(hash(("tpch", table, index))) % (2**63))
+        # process-stable seed: generation must be identical across workers
+        # and across runs (PYTHONHASHSEED randomizes str hash)
+        import hashlib
+
+        h = hashlib.sha256(f"tpch:{table}:{index}".encode()).digest()
+        return np.random.default_rng(int.from_bytes(h[:8], "little"))
 
     def _strings(self, name: str, values: list[str]) -> Dictionary:
         key = f"{name}:{len(values)}"

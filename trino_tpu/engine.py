@@ -52,8 +52,11 @@ class Engine:
             from trino_tpu.connectors.memory import MemoryConnector
             from trino_tpu.connectors.tpch import TpchConnector
 
+            from trino_tpu.connectors.tpcds import TpcdsConnector
+
             catalogs = CatalogManager()
             catalogs.register("tpch", TpchConnector())
+            catalogs.register("tpcds", TpcdsConnector())
             catalogs.register("memory", MemoryConnector())
             catalogs.register("blackhole", BlackHoleConnector())
         self.catalogs = catalogs
