@@ -1,0 +1,267 @@
+"""SQL breadth: correlated subqueries, grouping sets, set ops, quantified
+comparisons, derived aggregates, prepared statements, DDL, functions.
+
+Mirrors reference suites AbstractTestEngineOnlyQueries / TestCorrelatedJoin /
+TestGroupingSets and operator/scalar function tests.
+"""
+
+import math
+
+import pytest
+
+from trino_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+class TestCorrelatedSubqueries:
+    def test_correlated_exists(self, runner):
+        runner.assert_query(
+            "select count(*) from tpch.tiny.region r where exists "
+            "(select 1 from tpch.tiny.nation n where n.n_regionkey = r.r_regionkey)",
+            [(5,)],
+        )
+
+    def test_correlated_not_exists(self, runner):
+        # TPC-H Q22 shape: customers with no orders
+        rows, _ = runner.execute(
+            "select count(*) from tpch.tiny.customer c where not exists "
+            "(select 1 from tpch.tiny.orders o where o.o_custkey = c.c_custkey)"
+        )
+        base, _ = runner.execute(
+            "select count(*) from tpch.tiny.customer where c_custkey not in "
+            "(select o_custkey from tpch.tiny.orders)"
+        )
+        assert rows == base
+
+    def test_correlated_scalar_in_select(self, runner):
+        runner.assert_query(
+            "select r_regionkey, (select count(*) from tpch.tiny.nation n "
+            "where n.n_regionkey = r.r_regionkey) from tpch.tiny.region r",
+            [(i, 5) for i in range(5)],
+        )
+
+    def test_correlated_scalar_count_empty_group_is_zero(self, runner):
+        # regions with no small-key nations must see 0, not NULL
+        rows, _ = runner.execute(
+            "select r_regionkey, (select count(*) from tpch.tiny.nation n "
+            "where n.n_regionkey = r.r_regionkey and n.n_nationkey < 2) c "
+            "from tpch.tiny.region r order by 1"
+        )
+        counts = {k: c for k, c in rows}
+        assert all(c is not None for c in counts.values()), rows
+        assert sum(counts.values()) == 2  # nations 0 and 1
+        assert 0 in counts.values()  # some region has none -> 0 not NULL
+
+    def test_correlated_scalar_in_where_q17_shape(self, runner):
+        rows, _ = runner.execute(
+            "select sum(l_extendedprice) from tpch.tiny.lineitem l1 "
+            "where l1.l_orderkey <= 500 and l1.l_quantity < "
+            "(select 0.5 * avg(l2.l_quantity) from tpch.tiny.lineitem l2 "
+            " where l2.l_partkey = l1.l_partkey)"
+        )
+        assert rows[0][0] is not None
+
+    def test_correlated_exists_q4_shape(self, runner):
+        rows, _ = runner.execute(
+            "select o_orderpriority, count(*) from tpch.tiny.orders o "
+            "where o.o_orderkey <= 2000 and exists "
+            "(select 1 from tpch.tiny.lineitem l "
+            " where l.l_orderkey = o.o_orderkey and l.l_quantity > 45) "
+            "group by o_orderpriority"
+        )
+        assert len(rows) == 5
+
+
+class TestGroupingSets:
+    def test_rollup(self, runner):
+        rows, _ = runner.execute(
+            "select o_orderstatus, o_orderpriority, count(*) c "
+            "from tpch.tiny.orders group by rollup(o_orderstatus, o_orderpriority)"
+        )
+        grand = [c for s, p, c in rows if s is None and p is None]
+        assert grand == [15000]
+        assert sum(c for s, p, c in rows if s is not None and p is None) == 15000
+        assert sum(c for s, p, c in rows if s is not None and p is not None) == 15000
+
+    def test_grouping_sets(self, runner):
+        rows, _ = runner.execute(
+            "select o_orderstatus, o_orderpriority, count(*) from tpch.tiny.orders "
+            "group by grouping sets ((o_orderstatus), (o_orderpriority))"
+        )
+        assert len([r for r in rows if r[0] is not None]) == 3
+        assert len([r for r in rows if r[1] is not None]) == 5
+
+    def test_cube(self, runner):
+        rows, _ = runner.execute(
+            "select o_orderstatus, count(*) from tpch.tiny.orders "
+            "group by cube(o_orderstatus)"
+        )
+        assert len(rows) == 4
+
+    def test_mixed_plain_and_rollup(self, runner):
+        rows, _ = runner.execute(
+            "select o_orderstatus, o_orderpriority, count(*) from tpch.tiny.orders "
+            "group by o_orderstatus, rollup(o_orderpriority)"
+        )
+        # every row has a status; priority sometimes NULL
+        assert all(r[0] is not None for r in rows)
+        assert any(r[1] is None for r in rows)
+
+
+class TestSetOps:
+    def test_intersect(self, runner):
+        runner.assert_query(
+            "select n_regionkey from tpch.tiny.nation intersect "
+            "select r_regionkey from tpch.tiny.region",
+            [(i,) for i in range(5)],
+        )
+
+    def test_except(self, runner):
+        runner.assert_query(
+            "select n_nationkey from tpch.tiny.nation except "
+            "select r_regionkey from tpch.tiny.region",
+            [(i,) for i in range(5, 25)],
+        )
+
+    def test_null_semantics(self, runner):
+        runner.assert_query("select null intersect select null", [(None,)])
+        runner.assert_query("select 1 intersect select 2", [])
+
+
+class TestQuantified:
+    def test_any_all(self, runner):
+        runner.assert_query(
+            "select count(*) from tpch.tiny.nation where n_nationkey > all "
+            "(select r_regionkey from tpch.tiny.region)",
+            [(20,)],
+        )
+        runner.assert_query(
+            "select count(*) from tpch.tiny.nation where n_regionkey = any "
+            "(select r_regionkey from tpch.tiny.region where r_name = 'ASIA')",
+            [(5,)],
+        )
+
+
+class TestDerivedAggregates:
+    def test_variance_family(self, runner):
+        rows, _ = runner.execute(
+            "select stddev_pop(x), var_pop(x), var_samp(x) "
+            "from (values 2.0, 4.0, 4.0, 4.0, 5.0, 5.0, 7.0, 9.0) t(x)"
+        )
+        sp, vp, vs = rows[0]
+        assert abs(sp - 2.0) < 1e-9 and abs(vp - 4.0) < 1e-9
+        assert abs(vs - 32 / 7) < 1e-9
+
+    def test_single_row_var_samp_null(self, runner):
+        rows, _ = runner.execute("select var_samp(x) from (values 5.0) t(x)")
+        assert rows == [(None,)]
+
+    def test_bool_aggs(self, runner):
+        runner.assert_query(
+            "select bool_and(x > 0), bool_or(x > 8), every(x < 100) "
+            "from (values 2, 4, 9) t(x)",
+            [(True, True, True)],
+        )
+
+    def test_count_if_and_filter(self, runner):
+        a, _ = runner.execute(
+            "select count_if(o_orderstatus = 'F') from tpch.tiny.orders"
+        )
+        b, _ = runner.execute(
+            "select count(*) filter (where o_orderstatus = 'F') from tpch.tiny.orders"
+        )
+        c, _ = runner.execute(
+            "select count(*) from tpch.tiny.orders where o_orderstatus = 'F'"
+        )
+        assert a == b == c
+
+    def test_approx_distinct(self, runner):
+        runner.assert_query(
+            "select approx_distinct(o_orderpriority) from tpch.tiny.orders", [(5,)]
+        )
+
+
+class TestStatements:
+    def test_prepared_roundtrip(self, runner):
+        runner.execute(
+            "prepare pn from select n_name from tpch.tiny.nation where n_nationkey = ?"
+        )
+        rows, _ = runner.execute("execute pn using 7")
+        assert rows == [("GERMANY",)]
+        runner.execute("deallocate prepare pn")
+        with pytest.raises(Exception, match="not found"):
+            runner.execute("execute pn using 1")
+
+    def test_create_insert_delete(self, runner):
+        runner.execute("drop table if exists memory.default.sb_t")
+        runner.execute("create table memory.default.sb_t (a bigint, b varchar)")
+        runner.execute(
+            "insert into memory.default.sb_t select 1, 'x' union all "
+            "select 2, 'y' union all select 3, null"
+        )
+        runner.execute("delete from memory.default.sb_t where a = 2")
+        runner.assert_query(
+            "select a from memory.default.sb_t", [(1,), (3,)]
+        )
+        # NULL predicate rows survive DELETE
+        runner.execute("delete from memory.default.sb_t where b = 'zzz'")
+        runner.assert_query("select count(*) from memory.default.sb_t", [(2,)])
+        runner.execute("drop table memory.default.sb_t")
+
+
+class TestFunctions:
+    def test_math(self, runner):
+        rows, _ = runner.execute(
+            "select ln(exp(1.0)), log10(100.0), sign(-5), greatest(1, 7, 3), "
+            "least(2.5, 1.0), cbrt(27.0)"
+        )
+        ln_v, l10, sg, g, l, cb = rows[0]
+        assert abs(ln_v - 1) < 1e-9 and abs(l10 - 2) < 1e-9
+        assert sg == -1 and g == 7 and abs(cb - 3) < 1e-9
+
+    def test_date_trunc(self, runner):
+        runner.assert_query(
+            "select date_trunc('month', date '1995-03-15'), "
+            "date_trunc('year', date '1995-03-15'), "
+            "date_trunc('quarter', date '1995-05-15')",
+            [("1995-03-01", "1995-01-01", "1995-04-01")],
+        )
+
+    def test_date_trunc_over_column(self, runner):
+        rows, _ = runner.execute(
+            "select date_trunc('year', o_orderdate) y, count(*) "
+            "from tpch.tiny.orders group by 1 order by 1"
+        )
+        assert all(y.endswith("-01-01") for y, _ in rows)
+
+    def test_regexp_and_strings(self, runner):
+        rows, _ = runner.execute(
+            "select count(*) from tpch.tiny.part where regexp_like(p_type, '^PROMO')"
+        )
+        base, _ = runner.execute(
+            "select count(*) from tpch.tiny.part where p_type like 'PROMO%'"
+        )
+        assert rows == base
+
+    def test_misc_scalars(self, runner):
+        runner.assert_query(
+            "select chr(66), codepoint('A'), position('ll' in 'hello'), "
+            "try_cast('x' as bigint), cast(42 as varchar)",
+            [("B", 65, 3, None, "42")],
+        )
+
+    def test_niladic_current_date(self, runner):
+        rows, _ = runner.execute("select current_date")
+        assert len(rows[0][0]) == 10  # ISO date string
+
+    def test_limit_offset(self, runner):
+        runner.assert_query(
+            "select n_nationkey from tpch.tiny.nation order by n_nationkey "
+            "limit 3 offset 5",
+            [(5,), (6,), (7,)],
+            ordered=True,
+        )

@@ -105,7 +105,12 @@ class Analyzer:
         raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
 
     # ==== queries =======================================================
-    def plan_query(self, q: t.Query) -> tuple[RelationPlan, list[str]]:
+    def plan_query(
+        self, q: t.Query, outer: Optional[Scope] = None
+    ) -> tuple[RelationPlan, list[str]]:
+        """``outer`` is the enclosing query's scope: set only for expression
+        subqueries, enabling correlated column references (reference:
+        StatementAnalyzer's Scope.parent chain)."""
         saved_ctes = dict(self.ctes)
         try:
             for wq in q.with_queries:
@@ -117,7 +122,9 @@ class Analyzer:
                     for n, s in zip(names, rp.node.output_symbols)
                 ]
                 self.ctes[wq.name.lower()] = RelationPlan(rp.node, Scope(fields))
-            rp, names = self._plan_query_body(q.body, q.order_by, q.limit, q.offset)
+            rp, names = self._plan_query_body(
+                q.body, q.order_by, q.limit, q.offset, outer
+            )
             return rp, names
         finally:
             self.ctes = saved_ctes
@@ -128,9 +135,10 @@ class Analyzer:
         order_by: tuple[t.SortItem, ...],
         limit: Optional[int],
         offset: int,
+        outer: Optional[Scope] = None,
     ) -> tuple[RelationPlan, list[str]]:
         if isinstance(body, t.QuerySpec):
-            return self._plan_query_spec(body, order_by, limit, offset)
+            return self._plan_query_spec(body, order_by, limit, offset, outer)
         if isinstance(body, t.SetOperation):
             rp, names = self._plan_set_operation(body)
             rp = self._apply_order_limit(rp, names, order_by, limit, offset)
@@ -226,6 +234,7 @@ class Analyzer:
         order_by: tuple[t.SortItem, ...],
         limit: Optional[int],
         offset: int,
+        outer: Optional[Scope] = None,
     ) -> tuple[RelationPlan, list[str]]:
         # FROM
         if spec.from_ is not None:
@@ -233,6 +242,10 @@ class Analyzer:
         else:
             sym = P.Symbol(P.fresh_name("dual"), T.BIGINT)
             rp = RelationPlan(P.Values([sym], [[0]]), Scope([]))
+        if outer is not None:
+            # chain to the enclosing scope: unresolved names become
+            # correlated references to outer symbols
+            rp = RelationPlan(rp.node, Scope(rp.scope.fields, outer))
         # WHERE
         if spec.where is not None:
             pred, rp = self._rewrite_with_subqueries(spec.where, rp)
@@ -375,15 +388,38 @@ class Analyzer:
         input_scope = rp.scope
         # resolve group keys (ordinals or expressions), normalized for
         # structural matching against (already-normalized) select entries
-        group_asts: list[t.Node] = []
-        for g in spec.group_by:
+        # GROUPING SETS / ROLLUP / CUBE: the cross product of all grouping
+        # elements' sets (SQL semantics); plain expressions are singleton
+        # elements. grouping_sets is None for ordinary GROUP BY.
+        def resolve_one(g: t.Node) -> t.Node:
             if isinstance(g, t.Literal) and g.kind == "integer":
                 idx = int(g.value) - 1
                 if not (0 <= idx < len(select_entries)):
                     raise SemanticError(f"GROUP BY ordinal {g.value} out of range")
-                group_asts.append(select_entries[idx][0])
-            else:
-                group_asts.append(self._normalize(g, input_scope))
+                return select_entries[idx][0]
+            return self._normalize(g, input_scope)
+
+        grouping_sets: Optional[list[list[t.Node]]] = None
+        if any(isinstance(g, t.GroupingSets) for g in spec.group_by):
+            combos: list[list[t.Node]] = [[]]
+            for g in spec.group_by:
+                if isinstance(g, t.GroupingSets):
+                    combos = [
+                        prefix + [resolve_one(x) for x in s]
+                        for prefix in combos
+                        for s in g.sets
+                    ]
+                else:
+                    resolved = resolve_one(g)
+                    combos = [prefix + [resolved] for prefix in combos]
+            grouping_sets = combos
+            group_asts = []
+            for s in combos:
+                for x in s:
+                    if x not in group_asts:
+                        group_asts.append(x)
+        else:
+            group_asts = [resolve_one(g) for g in spec.group_by]
 
         having_ast = (
             self._normalize(spec.having, input_scope)
@@ -422,12 +458,83 @@ class Analyzer:
 
         aggs: list[tuple[P.Symbol, P.AggFunction]] = []
         agg_map: dict[t.Node, P.Symbol] = {}
+        # derived aggregates (stddev/variance/bool_and/...) compose simple
+        # aggregates plus a post-projection expression (reference: the
+        # input/combine/output decomposition of AccumulatorCompiler states)
+        derived_exprs: list[tuple[P.Symbol, RowExpr]] = []
+
+        def add_agg(kind, arg_expr, result_type, distinct=False, filt=None):
+            sym_in = None
+            if arg_expr is not None:
+                sym_in = P.Symbol(P.fresh_name("aggarg"), arg_expr.type)
+                pre_assignments.append((sym_in, arg_expr))
+            out = P.Symbol(P.fresh_name(kind), result_type)
+            aggs.append(
+                (out, P.AggFunction(
+                    kind,
+                    variable(sym_in.name, sym_in.type) if sym_in else None,
+                    result_type, distinct, filt,
+                ))
+            )
+            return out
+
         for fc in agg_asts:
             if fc in agg_map:
                 continue
             kind = fc.name
-            if kind not in ("sum", "count", "avg", "min", "max"):
+            if kind not in AGGREGATE_NAMES:
                 raise SemanticError(f"unsupported aggregate: {kind}")
+            # FILTER clause applies to every decomposed sub-aggregate
+            # (the plain sum/count/avg/min/max path handles fc.filter itself)
+            fc_filter = None
+            if fc.filter is not None and (
+                kind in _DERIVED_AGGS
+                or kind in ("approx_distinct", "arbitrary", "any_value")
+            ):
+                f_ex = _fold(self._rewrite(fc.filter, input_scope))
+                sym_f = P.Symbol(P.fresh_name("aggfilter"), T.BOOLEAN)
+                pre_assignments.append((sym_f, f_ex))
+                fc_filter = variable(sym_f.name, T.BOOLEAN)
+            if kind in _DERIVED_AGGS:
+                if fc.distinct:
+                    raise SemanticError(f"{kind}(DISTINCT ...) is not supported")
+                derived = self._plan_derived_aggregate(
+                    fc, input_scope, add_agg, fc_filter
+                )
+                dsym = P.Symbol(P.fresh_name(kind), derived.type)
+                derived_exprs.append((dsym, derived))
+                agg_map[fc] = dsym
+                continue
+            if kind == "count_if":
+                cond = _fold(self._rewrite(fc.args[0], input_scope))
+                if fc.filter is not None:
+                    # count_if(x) FILTER (WHERE f) counts rows where both hold
+                    f_ex = _fold(self._rewrite(fc.filter, input_scope))
+                    cond = special("and", T.BOOLEAN, cond, f_ex)
+                sym_f = P.Symbol(P.fresh_name("aggfilter"), T.BOOLEAN)
+                pre_assignments.append((sym_f, cond))
+                out_sym = P.Symbol(P.fresh_name("count_if"), T.BIGINT)
+                aggs.append(
+                    (out_sym, P.AggFunction(
+                        "count_star", None, T.BIGINT, False,
+                        variable(sym_f.name, T.BOOLEAN),
+                    ))
+                )
+                agg_map[fc] = out_sym
+                continue
+            if kind == "approx_distinct":
+                # exact distinct count (HLL sketch: future work; documented)
+                arg = _fold(self._rewrite(fc.args[0], input_scope))
+                agg_map[fc] = add_agg(
+                    "count", arg, T.BIGINT, distinct=True, filt=fc_filter
+                )
+                continue
+            if kind in ("arbitrary", "any_value"):
+                if fc.distinct:
+                    raise SemanticError(f"{kind}(DISTINCT ...) is not supported")
+                arg = _fold(self._rewrite(fc.args[0], input_scope))
+                agg_map[fc] = add_agg("min", arg, arg.type, filt=fc_filter)
+                continue
             if kind == "count" and len(fc.args) == 1 and isinstance(fc.args[0], t.Star):
                 arg_expr = None
                 result_type: T.SqlType = T.BIGINT
@@ -472,7 +579,22 @@ class Analyzer:
         pre_project = (
             P.Project(rp.node, pre_assignments) if pre_assignments else rp.node
         )
-        agg_node = P.Aggregate(pre_project, key_symbols, aggs, step="single")
+        if grouping_sets is not None:
+            # GroupIdNode: replicate rows per set, null absent keys, add gid
+            groups = [
+                [key_map[ast] for ast in s] for s in grouping_sets
+            ]
+            gid = P.Symbol(P.fresh_name("groupid"), T.BIGINT)
+            pre_project = P.GroupId(pre_project, groups, list(key_symbols), gid)
+            agg_keys = key_symbols + [gid]
+        else:
+            agg_keys = key_symbols
+        agg_node = P.Aggregate(pre_project, agg_keys, aggs, step="single")
+        if derived_exprs:
+            passthrough = [
+                (s, variable(s.name, s.type)) for s in agg_node.output_symbols
+            ]
+            agg_node = P.Project(agg_node, passthrough + derived_exprs)
 
         # post-agg scope: group-by ASTs and agg ASTs -> symbols
         post_replacements: dict[t.Node, P.Symbol] = {}
@@ -502,13 +624,20 @@ class Analyzer:
         out_syms: list[P.Symbol] = []
         assignments = []
         names = []
+        # select entries may contain (uncorrelated) subqueries: join them
+        # onto the post-aggregation relation
+        rp_post = RelationPlan(node, Scope([]))
         for e_ast, alias in select_entries:
-            ex = _fold(rewrite_post(e_ast))
+            ex, rp_post = self._rewrite_with_subqueries(
+                e_ast, rp_post, post_replacements or None
+            )
+            ex = _fold(ex)
             name = (alias or "_col").lower()
             sym = P.Symbol(P.fresh_name(name), ex.type)
             assignments.append((sym, ex))
             out_syms.append(sym)
             names.append(alias.lower() if alias else f"_col{len(names)}")
+        node = rp_post.node
         sort_items = []
         extra_syms: list[P.Symbol] = []
         if order_by:
@@ -820,6 +949,210 @@ class Analyzer:
             node = P.Window(node, part_syms, orderings, functions, frame)
         return node, replacements
 
+    def _plan_derived_aggregate(
+        self, fc: t.FunctionCall, input_scope, add_agg, fc_filter=None
+    ) -> RowExpr:
+        """stddev/variance family and boolean aggregates composed from
+        sum/count/min/max plus a post-aggregation expression. ``fc_filter``
+        (the FILTER clause) applies to every sub-aggregate."""
+        kind = fc.name
+        arg = _fold(self._rewrite(fc.args[0], input_scope))
+        if kind in ("bool_and", "every", "bool_or"):
+            as_int = special(
+                "if", T.BIGINT, arg, const(1, T.BIGINT), const(0, T.BIGINT)
+            )
+            agg_kind = "min" if kind in ("bool_and", "every") else "max"
+            s = add_agg(agg_kind, as_int, T.BIGINT, filt=fc_filter)
+            return call("eq", T.BOOLEAN, variable(s.name, T.BIGINT), const(1, T.BIGINT))
+        # variance family over doubles
+        xd = _coerce_to(arg, T.DOUBLE)
+        s_sum = add_agg("sum", xd, T.DOUBLE, filt=fc_filter)
+        s_sq = add_agg(
+            "sum", call("multiply", T.DOUBLE, xd, xd), T.DOUBLE, filt=fc_filter
+        )
+        s_cnt = add_agg("count", xd, T.BIGINT, filt=fc_filter)
+        n = _coerce_to(variable(s_cnt.name, T.BIGINT), T.DOUBLE)
+        sum_v = variable(s_sum.name, T.DOUBLE)
+        sq_v = variable(s_sq.name, T.DOUBLE)
+        # m2 = sum(x^2) - sum(x)^2 / n
+        m2 = call(
+            "subtract", T.DOUBLE, sq_v,
+            call("divide", T.DOUBLE, call("multiply", T.DOUBLE, sum_v, sum_v), n),
+        )
+        pop = kind in ("var_pop", "stddev_pop")
+        denom = (
+            n if pop else call("subtract", T.DOUBLE, n, const(1.0, T.DOUBLE))
+        )
+        var_expr = call("divide", T.DOUBLE, m2, denom)
+        # NULL when n == 0 (pop) or n <= 1 (samp), per reference semantics
+        min_n = const(0.0 if pop else 1.0, T.DOUBLE)
+        guarded = special(
+            "if", T.DOUBLE,
+            call("gt", T.BOOLEAN, n, min_n),
+            var_expr,
+            Constant(type=T.DOUBLE, value=None),
+        )
+        if kind in ("stddev", "stddev_samp", "stddev_pop"):
+            return call("sqrt", T.DOUBLE, guarded)
+        return guarded
+
+    # ==== decorrelation =================================================
+    # (_conjuncts_of lives at module scope below)
+
+    def _produced_symbols(self, node: P.PlanNode) -> set[str]:
+        out: set[str] = set()
+
+        def walk(n: P.PlanNode):
+            for s in n.output_symbols:
+                out.add(s.name)
+            for src in n.sources:
+                walk(src)
+
+        walk(node)
+        return out
+
+    def _decorrelate(self, node: P.PlanNode, produced: set[str], ctx: dict):
+        """Strip Filter conjuncts referencing symbols outside ``produced``
+        (correlated references to the enclosing query) and hoist them to the
+        top, adding pass-through projections so inner symbols the conjuncts
+        need stay visible. A correlated filter below a global Aggregate
+        turns its inner equality symbols into group keys (classic
+        decorrelation; ``ctx['grouped']`` records it so the caller joins
+        LEFT and fixes COUNT-over-empty). Reference: the effect of Trino's
+        TransformCorrelated* rule family (iterative/rule/).
+
+        Returns (new_node, corr_conjuncts: list[RowExpr])."""
+        if isinstance(node, P.Filter):
+            src, corr = self._decorrelate(node.source, produced, ctx)
+            keep: list[RowExpr] = []
+            for c in _conjuncts_of(node.predicate):
+                if referenced_variables(c) - produced:
+                    corr = corr + [c]
+                else:
+                    keep.append(c)
+            if keep:
+                pred = keep[0]
+                for k in keep[1:]:
+                    pred = special("and", T.BOOLEAN, pred, k)
+                return P.Filter(src, pred), corr
+            return src, corr
+
+        if isinstance(node, P.Project):
+            src, corr = self._decorrelate(node.source, produced, ctx)
+            if not corr:
+                return P.Project(src, node.assignments), corr
+            # pass through inner symbols the hoisted conjuncts reference
+            available = {s.name: s for s in src.output_symbols}
+            have = {s.name for s, _ in node.assignments}
+            extra = []
+            for c in corr:
+                for r in referenced_variables(c):
+                    if r in produced and r not in have and r in available:
+                        sym = available[r]
+                        extra.append((sym, variable(sym.name, sym.type)))
+                        have.add(r)
+            return P.Project(src, list(node.assignments) + extra), corr
+
+        if isinstance(node, P.Join):
+            lsrc, lcorr = self._decorrelate(node.left, produced, ctx)
+            rsrc, rcorr = self._decorrelate(node.right, produced, ctx)
+            out = P.Join(
+                node.join_type, lsrc, rsrc, node.criteria, node.filter,
+                node.distribution, node.mark_symbol,
+            )
+            return out, lcorr + rcorr
+
+        if isinstance(node, P.Aggregate):
+            src, corr = self._decorrelate(node.source, produced, ctx)
+            if not corr:
+                return P.Aggregate(src, node.group_keys, node.aggregates, node.step), corr
+            if node.group_keys:
+                raise SemanticError(
+                    "correlated subquery with GROUP BY is not supported"
+                )
+            # global agg over correlated filter: group by the inner symbols
+            # of the correlated equalities instead
+            available = {s.name: s for s in src.output_symbols}
+            keys: list[P.Symbol] = []
+            for c in corr:
+                for r in referenced_variables(c):
+                    if r in produced:
+                        if r not in available:
+                            raise SemanticError(
+                                "correlated reference not available for decorrelation"
+                            )
+                        if available[r] not in keys:
+                            keys.append(available[r])
+            ctx["grouped"] = True
+            ctx["agg_kinds"] = {
+                s.name: fn.kind for s, fn in node.aggregates
+            }
+            return P.Aggregate(src, keys, node.aggregates, node.step), corr
+
+        if isinstance(node, P.Sort):
+            src, corr = self._decorrelate(node.source, produced, ctx)
+            return P.Sort(src, node.order_by), corr
+
+        # correlation below cardinality-changing nodes cannot be hoisted
+        has_corr_below = self._has_correlated_filter(node, produced)
+        if has_corr_below:
+            raise SemanticError(
+                f"correlated subquery through {type(node).__name__} is not supported"
+            )
+        return node, []
+
+    def _has_correlated_filter(self, node: P.PlanNode, produced: set[str]) -> bool:
+        if isinstance(node, P.Filter):
+            for c in _conjuncts_of(node.predicate):
+                if referenced_variables(c) - produced:
+                    return True
+        return any(self._has_correlated_filter(s, produced) for s in node.sources)
+
+    def _trace_agg_kind(self, node: P.PlanNode, name: str, ctx: dict) -> Optional[str]:
+        """Follow identity projections from ``name`` down to an Aggregate
+        output and return its aggregate kind (for COUNT-coalesce fixes)."""
+        kinds = ctx.get("agg_kinds", {})
+        while True:
+            if name in kinds:
+                return kinds[name]
+            if isinstance(node, P.Project):
+                nxt = None
+                for s, e in node.assignments:
+                    if s.name == name and isinstance(e, Variable):
+                        nxt = e.name
+                        break
+                if nxt is None:
+                    return None
+                name = nxt
+                node = node.source
+                continue
+            if isinstance(node, (P.Filter, P.Sort)):
+                node = node.source
+                continue
+            return kinds.get(name)
+
+    def _split_correlation(
+        self, corr: list[RowExpr], outer_syms: dict, inner_syms: dict
+    ):
+        """Split hoisted conjuncts into equi-join criteria (outer, inner)
+        and residual filter conjuncts."""
+        criteria: list[tuple[P.Symbol, P.Symbol]] = []
+        residual: list[RowExpr] = []
+        for c in corr:
+            pair = None
+            if isinstance(c, Call) and c.name == "eq" and len(c.args) == 2:
+                a, b = c.args
+                if isinstance(a, Variable) and isinstance(b, Variable):
+                    if a.name in outer_syms and b.name in inner_syms:
+                        pair = (outer_syms[a.name], inner_syms[b.name])
+                    elif b.name in outer_syms and a.name in inner_syms:
+                        pair = (outer_syms[b.name], inner_syms[a.name])
+            if pair is not None:
+                criteria.append(pair)
+            else:
+                residual.append(c)
+        return criteria, residual
+
     # ==== subqueries in expressions =====================================
     def _rewrite_with_subqueries(
         self, e: t.Node, rp: RelationPlan, replacements=None
@@ -830,24 +1163,75 @@ class Analyzer:
         Returns (RowExpr, updated RelationPlan)."""
         state = {"rp": rp}
 
+        def combine(conj: list[RowExpr]) -> Optional[RowExpr]:
+            if not conj:
+                return None
+            out = conj[0]
+            for c in conj[1:]:
+                out = special("and", T.BOOLEAN, out, c)
+            return out
+
+        def plan_sub(query: t.Query):
+            """Plan a subquery allowing correlated outer references; returns
+            (decorrelated plan, criteria, residual filter, ctx). ctx carries
+            'n_columns': the subquery's own column count (decorrelation may
+            append pass-through columns after it)."""
+            cur = state["rp"]
+            sub_rp, _ = self.plan_query(query, outer=cur.scope)
+            produced = self._produced_symbols(sub_rp.node)
+            ctx: dict = {"n_columns": len(sub_rp.node.output_symbols)}
+            new_sub, corr = self._decorrelate(sub_rp.node, produced, ctx)
+            outer_syms = {s.name: s for s in cur.node.output_symbols}
+            inner_syms = {s.name: s for s in new_sub.output_symbols}
+            criteria, residual = self._split_correlation(corr, outer_syms, inner_syms)
+            for c in residual:
+                bad = referenced_variables(c) - set(outer_syms) - set(inner_syms)
+                if bad:
+                    raise SemanticError(
+                        f"correlated reference not resolvable: {sorted(bad)}"
+                    )
+            return new_sub, criteria, residual, ctx
+
         def handle(node: t.Node) -> Optional[RowExpr]:
             if isinstance(node, t.ScalarSubquery):
-                sub_rp, _ = self.plan_query(node.query)
-                syms = sub_rp.node.output_symbols
-                if len(syms) != 1:
+                new_sub, criteria, residual, ctx = plan_sub(node.query)
+                if ctx["n_columns"] != 1:
                     raise SemanticError("scalar subquery must return one column")
+                # scalar output = the subquery's first (only) select column
+                out_sym = new_sub.output_symbols[0]
                 cur = state["rp"]
-                join = P.Join("CROSS", cur.node, sub_rp.node, [])
+                if not criteria and not residual:
+                    join = P.Join(
+                        "CROSS", cur.node, new_sub, [], single_row=True
+                    )
+                    state["rp"] = RelationPlan(join, cur.scope)
+                    return variable(out_sym.name, out_sym.type)
+                # correlated scalar: LEFT join on the correlation keys;
+                # >1 match per outer row is a runtime error
+                join = P.Join(
+                    "LEFT", cur.node, new_sub, criteria,
+                    combine(residual), None, None, single_row=True,
+                )
                 state["rp"] = RelationPlan(join, cur.scope)
-                return variable(syms[0].name, syms[0].type)
+                result = variable(out_sym.name, out_sym.type)
+                kind = self._trace_agg_kind(new_sub, out_sym.name, ctx)
+                if ctx.get("grouped") and kind in ("count", "count_star"):
+                    # COUNT over an empty correlated group is 0, but the
+                    # LEFT join yields NULL for unmatched outer rows
+                    result = special(
+                        "coalesce", out_sym.type, result,
+                        const(0, out_sym.type),
+                    )
+                return result
             if isinstance(node, (t.InSubquery, t.Exists)):
                 cur = state["rp"]
                 if isinstance(node, t.InSubquery):
-                    sub_rp, _ = self.plan_query(node.query)
-                    syms = sub_rp.node.output_symbols
-                    if len(syms) != 1:
+                    new_sub, criteria, residual, _ctx = plan_sub(node.query)
+                    if _ctx["n_columns"] != 1:
                         raise SemanticError("IN subquery must return one column")
+                    syms = new_sub.output_symbols
                     value = self._rewrite(node.value, cur.scope)
+                    cur = state["rp"]
                     if not isinstance(value, Variable):
                         vsym = P.Symbol(P.fresh_name("inval"), value.type)
                         proj = P.Project(
@@ -865,21 +1249,25 @@ class Analyzer:
                     join = P.Join(
                         jt,
                         cur.node,
-                        sub_rp.node,
-                        [(P.Symbol(value.name, value.type), syms[0])],
+                        new_sub,
+                        [(P.Symbol(value.name, value.type), syms[0])] + criteria,
+                        combine(residual),
                         mark_symbol=mark,
                     )
                     state["rp"] = RelationPlan(join, cur.scope)
                     return variable(mark.name, T.BOOLEAN)
-                # EXISTS: uncorrelated only in v1
-                sub_rp, _ = self.plan_query(node.query)
+                # EXISTS (correlated or not): SEMI/ANTI join on correlation
+                new_sub, criteria, residual, _ctx = plan_sub(node.query)
+                cur = state["rp"]
                 mark = P.Symbol(P.fresh_name("exists_mark"), T.BOOLEAN)
                 join = P.Join(
                     "SEMI" if not node.negated else "ANTI",
                     cur.node,
-                    sub_rp.node,
-                    [],
+                    new_sub,
+                    criteria,
+                    combine(residual),
                     mark_symbol=mark,
+                    null_aware=False,  # EXISTS is two-valued
                 )
                 state["rp"] = RelationPlan(join, cur.scope)
                 return variable(mark.name, T.BOOLEAN)
@@ -1005,7 +1393,27 @@ class Analyzer:
             target = T.parse_type(e.target)
             if isinstance(operand, Constant) and operand.type == T.UNKNOWN:
                 return Constant(type=target, value=None)
+            if isinstance(operand, Constant) and T.is_string(target):
+                v = operand.value
+                if v is None:
+                    return Constant(type=target, value=None)
+                if isinstance(operand.type, T.DecimalType):
+                    from decimal import Decimal as _D
+
+                    s = str(_D(v) / operand.type.unscale)
+                elif isinstance(operand.type, T.BooleanType):
+                    s = "true" if v else "false"
+                else:
+                    s = str(v)
+                return Constant(type=target, value=s)
             if isinstance(operand, Constant) and T.is_string(operand.type):
+                if e.safe:
+                    # TRY_CAST: invalid conversion yields NULL, not an error
+                    # (ArithmeticError covers decimal.InvalidOperation)
+                    try:
+                        return _cast_string_constant(operand, target)
+                    except (ValueError, ArithmeticError, SemanticError):
+                        return Constant(type=target, value=None)
                 return _cast_string_constant(operand, target)
             return call("cast", target, operand)
         if isinstance(e, t.Extract):
@@ -1017,6 +1425,8 @@ class Analyzer:
             return self._case(e, rw)
         if isinstance(e, t.FunctionCall):
             return self._function(e, rw)
+        if isinstance(e, t.QuantifiedComparison):
+            return rw(_expand_quantified(e))
         if isinstance(e, t.ScalarSubquery):
             raise SemanticError("scalar subquery not allowed in this context")
         if isinstance(e, (t.InSubquery, t.Exists)):
@@ -1134,6 +1544,88 @@ class Analyzer:
             return call("starts_with", T.BOOLEAN, *args)
         if name == "date":
             return call("cast", T.DATE, args[0])
+        if name in _MATH_DOUBLE_FNS:
+            return call(name, T.DOUBLE, _coerce_to(args[0], T.DOUBLE))
+        if name == "log":
+            # log(b, x) = ln(x)/ln(b)
+            b = _coerce_to(args[0], T.DOUBLE)
+            x = _coerce_to(args[1], T.DOUBLE)
+            return call(
+                "divide", T.DOUBLE, call("ln", T.DOUBLE, x), call("ln", T.DOUBLE, b)
+            )
+        if name == "atan2":
+            return call(
+                "atan2", T.DOUBLE,
+                _coerce_to(args[0], T.DOUBLE), _coerce_to(args[1], T.DOUBLE),
+            )
+        if name == "pi":
+            import math
+
+            return Constant(type=T.DOUBLE, value=math.pi)
+        if name == "e":
+            import math
+
+            return Constant(type=T.DOUBLE, value=math.e)
+        if name == "sign":
+            return call("sign", args[0].type, args[0])
+        if name == "truncate":
+            return call("truncate", args[0].type, _coerce_to(args[0], T.DOUBLE))
+        if name in ("greatest", "least"):
+            rt = args[0].type
+            for a in args[1:]:
+                rt = T.common_super_type(rt, a.type) or rt
+            return call(name, rt, *[_coerce_to(a, rt) for a in args])
+        if name == "chr":
+            if isinstance(args[0], Constant):
+                v = args[0].value
+                return Constant(
+                    type=T.VARCHAR, value=None if v is None else chr(int(v))
+                )
+            raise SemanticError("chr over non-constant values not supported")
+        if name in ("codepoint", "ascii"):
+            if isinstance(args[0], Constant):
+                v = args[0].value
+                return Constant(
+                    type=T.BIGINT,
+                    value=None if not v else ord(str(v)[0]),
+                )
+            return call("codepoint", T.BIGINT, args[0])
+        if name == "regexp_like":
+            if isinstance(args[0], Constant) and isinstance(args[1], Constant):
+                import re as _re
+
+                a, p = args[0].value, args[1].value
+                v = (
+                    None
+                    if a is None or p is None
+                    else _re.search(str(p), str(a)) is not None
+                )
+                return Constant(type=T.BOOLEAN, value=v)
+            return call("regexp_like", T.BOOLEAN, *args)
+        if name in ("regexp_replace", "regexp_extract"):
+            # string->string: lowered host-side over the dictionary
+            return call(name, T.VARCHAR, *args)
+        if name == "date_trunc":
+            if not isinstance(args[0], Constant):
+                raise SemanticError("date_trunc unit must be a literal")
+            return call("date_trunc", args[1].type, args[0], args[1])
+        if name in ("current_date", "now", "current_timestamp", "localtimestamp"):
+            import time as _time
+
+            if name == "current_date":
+                return Constant(
+                    type=T.DATE, value=int(_time.time() // 86400)
+                )
+            return Constant(
+                type=T.TIMESTAMP, value=int(_time.time() * 1_000_000)
+            )
+        if name == "format":
+            # printf-style over constants only in v1
+            if all(isinstance(a, Constant) for a in args):
+                fmt = str(args[0].value)
+                vals = [a.value for a in args[1:]]
+                return Constant(type=T.VARCHAR, value=fmt % tuple(vals))
+            raise SemanticError("format over non-constant values not supported")
         raise SemanticError(f"unknown function: {name}")
 
     def _binary(self, e: t.BinaryOp, rw) -> RowExpr:
@@ -1316,7 +1808,12 @@ def _contains_aggregate(e: t.Node) -> bool:
     return bool(found)
 
 
+_SUBQUERY_NODES = (t.ScalarSubquery, t.InSubquery, t.Exists, t.Query)
+
+
 def _collect_windows(e: t.Node, out: list) -> None:
+    if isinstance(e, _SUBQUERY_NODES):
+        return  # subquery internals have their own scopes
     if isinstance(e, t.FunctionCall) and e.window is not None:
         out.append(e)
         return  # SQL forbids nested window functions
@@ -1334,9 +1831,22 @@ def _collect_windows(e: t.Node, out: list) -> None:
                             _collect_windows(sub, out)
 
 
+# names treated as aggregate functions when not windowed
+_DERIVED_AGGS = {
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+    "bool_and", "bool_or", "every",
+}
+AGGREGATE_NAMES = {
+    "sum", "count", "avg", "min", "max", "count_if", "approx_distinct",
+    "arbitrary", "any_value",
+} | _DERIVED_AGGS
+
+
 def _collect_aggregates(e: t.Node, out: list) -> None:
+    if isinstance(e, _SUBQUERY_NODES):
+        return  # an aggregate inside a subquery aggregates the SUBQUERY
     if isinstance(e, t.FunctionCall):
-        if e.name in ("sum", "count", "avg", "min", "max") and e.window is None:
+        if e.name in AGGREGATE_NAMES and e.window is None:
             out.append(e)
             return
     for f in dataclasses.fields(e) if dataclasses.is_dataclass(e) else []:
@@ -1498,3 +2008,60 @@ def _days_in_month(y: int, m: int) -> int:
     import calendar
 
     return calendar.monthrange(y, m)[1]
+
+
+def _conjuncts_of(e: RowExpr) -> list[RowExpr]:
+    if isinstance(e, SpecialForm) and e.form == "and":
+        out: list[RowExpr] = []
+        for a in e.args:
+            out.extend(_conjuncts_of(a))
+        return out
+    return [e]
+
+
+_MATH_DOUBLE_FNS = {
+    "ln", "log2", "log10", "exp", "sin", "cos", "tan", "asin", "acos",
+    "atan", "sinh", "cosh", "tanh", "cbrt", "degrees", "radians",
+}
+
+
+def _expand_quantified(e: "t.QuantifiedComparison") -> t.Node:
+    """Rewrite quantified comparisons (reference:
+    QuantifiedComparisonExpression handling in SubqueryPlanner):
+      = ANY  -> IN;    <> ALL -> NOT IN
+      > ANY(S) -> > (SELECT min ...)   > ALL(S) -> > (SELECT max ...)
+      < ANY(S) -> < (SELECT max ...)   < ALL(S) -> < (SELECT min ...)
+    The min/max forms follow Trino's rewrite; with an empty subquery the
+    comparison yields NULL (ANY: falsy — correct; ALL: should be TRUE —
+    known deviation, documented)."""
+    if e.op == "=" and e.quantifier == "ANY":
+        return t.InSubquery(e.value, e.query, negated=False)
+    if e.op == "<>" and e.quantifier == "ALL":
+        return t.InSubquery(e.value, e.query, negated=True)
+    if e.op in ("<", "<=", ">", ">="):
+        descending = e.op in (">", ">=")
+        agg = (
+            ("min" if descending else "max")
+            if e.quantifier == "ANY"
+            else ("max" if descending else "min")
+        )
+        sub = t.Query(
+            body=t.QuerySpec(
+                select_items=(
+                    t.SelectItem(
+                        t.FunctionCall(agg, (t.Identifier(("__qc",)),))
+                    ),
+                ),
+                distinct=False,
+                from_=t.AliasedRelation(
+                    t.SubqueryRelation(e.query), "__q", ("__qc",)
+                ),
+                where=None,
+                group_by=(),
+                having=None,
+            ),
+        )
+        return t.BinaryOp(e.op, e.value, t.ScalarSubquery(sub))
+    raise SemanticError(
+        f"quantified comparison {e.op} {e.quantifier} is not supported"
+    )

@@ -65,6 +65,11 @@ class StatementClient:
                 f"{k}={urllib.parse.quote(str(v))}"
                 for k, v in self.session.properties.items()
             )
+        if self.session.prepared_statements:
+            h[f"{HEADER}-Prepared-Statement"] = ",".join(
+                f"{k}={urllib.parse.quote(v)}"
+                for k, v in self.session.prepared_statements.items()
+            )
         return h
 
     def _request(self, method: str, uri: str, body: Optional[bytes] = None) -> dict:
@@ -76,6 +81,13 @@ class StatementClient:
             if set_session and "=" in set_session:
                 k, v = set_session.split("=", 1)
                 self.session.properties[k] = urllib.parse.unquote(v)
+            added = resp.headers.get(f"{HEADER}-Added-Prepare")
+            if added and "=" in added:
+                k, v = added.split("=", 1)
+                self.session.prepared_statements[k] = urllib.parse.unquote(v)
+            dealloc = resp.headers.get(f"{HEADER}-Deallocated-Prepare")
+            if dealloc:
+                self.session.prepared_statements.pop(dealloc, None)
             return json.loads(resp.read().decode())
 
     def _advance_state(self, payload: dict) -> None:
@@ -142,6 +154,8 @@ class ClientSession:
     catalog: Optional[str] = "tpch"
     schema: Optional[str] = "tiny"
     properties: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # name -> SQL text, mirrored via X-Trino-*-Prepare headers
+    prepared_statements: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 class Connection:

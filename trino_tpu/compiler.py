@@ -236,7 +236,71 @@ class ExprCompiler:
             # host-compiled predicate over a dictionary column:
             # args = (col, Constant(mask_table)) — see analyzer lowering
             raise NotImplementedError
+        if name in _UNARY_MATH:
+            d, v = self._eval(expr.args[0])
+            return _UNARY_MATH[name](d), v
+        if name == "atan2":
+            a, av = self._eval(expr.args[0])
+            b, bv = self._eval(expr.args[1])
+            return jnp.arctan2(a, b), av & bv
+        if name == "sign":
+            d, v = self._eval(expr.args[0])
+            return jnp.sign(d), v
+        if name == "truncate":
+            d, v = self._eval(expr.args[0])
+            return jnp.trunc(d), v
+        if name in ("greatest", "least"):
+            # SQL: NULL if ANY argument is NULL (spi semantics)
+            pairs = [self._eval(a) for a in expr.args]
+            fn = jnp.maximum if name == "greatest" else jnp.minimum
+            out, valid = pairs[0]
+            for d, v in pairs[1:]:
+                out = fn(out, d)
+                valid = valid & v
+            return out, valid
+        if name in ("regexp_like", "codepoint"):
+            return self._string_table(expr)
+        if name == "date_trunc":
+            return self._date_trunc(expr)
         raise NotImplementedError(f"scalar function {name}")
+
+    def _date_trunc(self, expr: Call) -> Pair:
+        unit_e = expr.args[0]
+        assert isinstance(unit_e, Constant)
+        unit = str(unit_e.value).lower()
+        d, v = self._eval(expr.args[1])
+        st = expr.args[1].type
+        if isinstance(st, T.TimestampType):
+            us_per = {"second": 10**6, "minute": 60 * 10**6, "hour": 3600 * 10**6,
+                      "day": 86_400 * 10**6}
+            if unit in us_per:
+                p = us_per[unit]
+                return (d // p) * p, v
+            days = (d // 86_400_000_000).astype(jnp.int32)
+            trunc_days = self._trunc_days(days, unit)
+            return trunc_days.astype(jnp.int64) * 86_400_000_000, v
+        days = d.astype(jnp.int32)
+        if unit == "day":
+            return days, v
+        return self._trunc_days(days, unit), v
+
+    def _trunc_days(self, days, unit: str):
+        y, m, dd = _civil_from_days(days)
+        if unit == "year":
+            m = jnp.ones_like(m)
+            dd = jnp.ones_like(dd)
+        elif unit == "quarter":
+            m = ((m - 1) // 3) * 3 + 1
+            dd = jnp.ones_like(dd)
+        elif unit == "month":
+            dd = jnp.ones_like(dd)
+        elif unit == "week":
+            # ISO-style: truncate to Monday (1970-01-01 was a Thursday)
+            dow = (days + 3) % 7  # 0 = Monday
+            return days - dow
+        else:
+            raise NotImplementedError(f"date_trunc unit {unit}")
+        return _days_from_civil_vec(y, m, dd)
 
     def _arith(self, expr: Call) -> Pair:
         a_t, b_t = expr.args[0].type, expr.args[1].type
@@ -373,6 +437,22 @@ class ExprCompiler:
         name = expr.name
         if name == "length":
             table = np.asarray([len(v) for v in dictionary.values] + [0], dtype=np.int64)
+        elif name == "codepoint":
+            table = np.asarray(
+                [ord(v[0]) if v else 0 for v in dictionary.values] + [0],
+                dtype=np.int64,
+            )
+        elif name == "regexp_like":
+            import re as _re
+
+            pat_e = expr.args[1]
+            if not isinstance(pat_e, Constant) or pat_e.value is None:
+                raise NotImplementedError("regexp pattern must be a literal")
+            rx = _re.compile(str(pat_e.value))
+            table = np.asarray(
+                [rx.search(v) is not None for v in dictionary.values] + [False],
+                dtype=np.bool_,
+            )
         else:
             lit_e = expr.args[1]
             if not isinstance(lit_e, Constant) or lit_e.value is None:
@@ -511,6 +591,27 @@ def _kleene_or(a: Pair, b: Pair) -> Pair:
     value = av | bv
     valid = (a[1] & b[1]) | (a[1] & a[0]) | (b[1] & b[0])
     return value, valid
+
+
+# unary double-valued math kernels (analyzer coerces args to DOUBLE)
+_UNARY_MATH = {
+    "ln": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "exp": jnp.exp,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "cbrt": jnp.cbrt,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+}
 
 
 def _civil_from_days(days: jnp.ndarray):

@@ -42,6 +42,8 @@ class Session:
     schema: str | None = "tiny"
     source: str = ""  # client-declared source (X-Trino-Source)
     properties: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # prepared statements (reference: Session.preparedStatements)
+    prepared: dict[str, Any] = dataclasses.field(default_factory=dict)
 
     # --- defaults for recognised properties -------------------------------
     DEFAULTS: ClassVar[tuple[tuple[str, Any], ...]] = (

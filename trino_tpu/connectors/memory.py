@@ -40,6 +40,12 @@ class MemoryConnector(Connector):
         self._stats.pop((schema, table), None)
         return compacted.num_rows
 
+    def truncate(self, schema, table):
+        if (schema, table) not in self._tables:
+            raise KeyError(f"table not found: {schema}.{table}")
+        self._data[(schema, table)] = []
+        self._stats.pop((schema, table), None)
+
     def drop_table(self, schema, table):
         self._tables.pop((schema, table), None)
         self._data.pop((schema, table), None)

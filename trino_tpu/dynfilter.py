@@ -199,5 +199,6 @@ def _filter_above(node: P.PlanNode, symbol: P.Symbol, domain: Domain) -> P.PlanN
 def _replace_join_sides(node: P.Join, left: P.PlanNode, right: P.PlanNode) -> P.Join:
     return P.Join(
         node.join_type, left, right, node.criteria, node.filter,
-        node.distribution, node.mark_symbol,
+        node.distribution, node.mark_symbol, node.null_aware,
+        node.single_row,
     )

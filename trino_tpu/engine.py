@@ -34,6 +34,9 @@ class StatementResult:
     set_session: dict[str, Any] = dataclasses.field(default_factory=dict)
     peak_memory_bytes: int = 0
     dynamic_filters: int = 0
+    # prepared-statement session mutations (ride X-Trino-*-Prepare headers)
+    added_prepare: Optional[tuple[str, str]] = None  # (name, sql)
+    deallocated_prepare: Optional[str] = None
 
 
 class Engine:
@@ -155,6 +158,23 @@ class Engine:
         self, sql: str, session: Session, query_id: Optional[str] = None
     ) -> StatementResult:
         stmt = parse_statement(sql)
+        if isinstance(stmt, t.Prepare):
+            # keep the statement's SQL text: it must survive the stateless
+            # HTTP protocol via X-Trino-Added-Prepare
+            import re as _re
+
+            m = _re.match(
+                r"\s*prepare\s+\S+\s+from\s+(.*)$",
+                sql.strip().rstrip(";"),
+                _re.IGNORECASE | _re.DOTALL,
+            )
+            if m:
+                stmt = dataclasses.replace(stmt, sql=m.group(1).strip())
+        return self._dispatch_parsed(stmt, session, query_id)
+
+    def _dispatch_parsed(
+        self, stmt: t.Node, session: Session, query_id: Optional[str] = None
+    ) -> StatementResult:
         handler = getattr(self, f"_do_{type(stmt).__name__.lower()}", None)
         if handler is not None:
             return handler(stmt, session)
@@ -365,6 +385,84 @@ class Engine:
         conn.drop_table(schema, table)
         return StatementResult([], ["result"], [T.BOOLEAN], update_type="DROP TABLE")
 
+    def _do_createtable(self, stmt: t.CreateTable, session: Session) -> StatementResult:
+        catalog, schema, table = self._qualify(stmt.name, session)
+        conn = self.catalogs.get(catalog)
+        if conn.get_table(schema, table) is not None:
+            if stmt.not_exists:
+                return StatementResult(
+                    [], ["result"], [T.BOOLEAN], update_type="CREATE TABLE"
+                )
+            raise SemanticError(f"table already exists: {catalog}.{schema}.{table}")
+        cols = tuple(
+            ColumnSchema(n.lower(), T.parse_type(ty)) for n, ty in stmt.columns
+        )
+        conn.create_table(schema, table, TableSchema(table, cols))
+        return StatementResult([], ["result"], [T.BOOLEAN], update_type="CREATE TABLE")
+
+    def _do_delete(self, stmt: t.Delete, session: Session) -> StatementResult:
+        """DELETE removes rows where the predicate is TRUE; rows where it is
+        FALSE or NULL remain (reference DELETE semantics). Implemented as
+        keep-filter + truncate + reinsert (connector-neutral)."""
+        catalog, schema, table = self._qualify(stmt.name, session)
+        conn = self.catalogs.get(catalog)
+        ts = conn.get_table(schema, table)
+        if ts is None:
+            raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
+        if not hasattr(conn, "truncate"):
+            raise SemanticError(f"{conn.name}: DELETE not supported")
+        before = conn.estimate_rows(schema, table) or 0
+        if stmt.where is None:
+            conn.truncate(schema, table)
+            return StatementResult(
+                [], ["rows"], [T.BIGINT], update_type="DELETE", update_count=before
+            )
+        keep_pred = t.BinaryOp(
+            "OR", t.UnaryOp("NOT", stmt.where), t.IsNull(stmt.where)
+        )
+        keep_query = t.Query(
+            body=t.QuerySpec(
+                select_items=(t.SelectItem(t.Star()),),
+                from_=t.Table((catalog, schema, table)),
+                where=keep_pred,
+            )
+        )
+        batch, _names = self._run_query_rows(keep_query, session)
+        conn.truncate(schema, table)
+        if batch.num_rows:
+            conn.insert(schema, table, batch)
+        return StatementResult(
+            [], ["rows"], [T.BIGINT],
+            update_type="DELETE", update_count=before - batch.num_rows,
+        )
+
+    # === prepared statements (reference: Session.preparedStatements) ======
+
+    def _do_prepare(self, stmt: t.Prepare, session: Session) -> StatementResult:
+        # store SQL text when available (portable across protocol requests);
+        # fall back to the AST for purely in-process sessions
+        session.prepared[stmt.name.lower()] = stmt.sql or stmt.statement
+        return StatementResult(
+            [], ["result"], [T.BOOLEAN], update_type="PREPARE",
+            added_prepare=(stmt.name.lower(), stmt.sql or ""),
+        )
+
+    def _do_execute(self, stmt: t.Execute, session: Session) -> StatementResult:
+        inner = session.prepared.get(stmt.name.lower())
+        if inner is None:
+            raise SemanticError(f"prepared statement not found: {stmt.name}")
+        if isinstance(inner, str):
+            inner = parse_statement(inner)
+        bound = _bind_parameters(inner, stmt.parameters)
+        return self._dispatch_parsed(bound, session)
+
+    def _do_deallocate(self, stmt: t.Deallocate, session: Session) -> StatementResult:
+        session.prepared.pop(stmt.name.lower(), None)
+        return StatementResult(
+            [], ["result"], [T.BOOLEAN], update_type="DEALLOCATE",
+            deallocated_prepare=stmt.name.lower(),
+        )
+
     def _qualify(self, name_parts, session: Session) -> tuple[str, str, str]:
         parts = list(name_parts)
         if len(parts) == 1:
@@ -372,3 +470,39 @@ class Engine:
         if len(parts) == 2:
             return session.catalog, parts[0], parts[1]
         return parts[0], parts[1], parts[2]
+
+
+def _bind_parameters(stmt: t.Node, params: tuple) -> t.Node:
+    """Replace ? placeholders with the EXECUTE ... USING expressions."""
+    import dataclasses as _dc
+
+    def walk(node):
+        if isinstance(node, t.Parameter):
+            if node.index >= len(params):
+                raise SemanticError(
+                    f"no value provided for parameter {node.index + 1}"
+                )
+            return params[node.index]
+        if _dc.is_dataclass(node) and isinstance(node, t.Node):
+            changes = {}
+            for f in _dc.fields(node):
+                v = getattr(node, f.name)
+                if isinstance(v, t.Node):
+                    changes[f.name] = walk(v)
+                elif isinstance(v, tuple):
+                    changes[f.name] = tuple(
+                        walk(x) if isinstance(x, t.Node)
+                        else (
+                            tuple(
+                                walk(y) if isinstance(y, t.Node) else y
+                                for y in x
+                            )
+                            if isinstance(x, tuple)
+                            else x
+                        )
+                        for x in v
+                    )
+            return _dc.replace(node, **changes) if changes else node
+        return node
+
+    return walk(stmt)

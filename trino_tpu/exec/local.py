@@ -331,6 +331,16 @@ class LocalExecutor:
         string_aggs: list[Optional[Dictionary]] = []
         for _, fn in node.aggregates:
             if fn.kind == "count_star":
+                if fn.filter is not None:
+                    # count(*) FILTER (WHERE f) == count over the f mask
+                    fsym = P.Symbol(fn.filter.name, T.BOOLEAN)
+                    fc = res.column(fsym)
+                    ones = jnp.ones(res.batch.capacity, dtype=jnp.int64)
+                    pair = (ones, fc.data & fc.valid_mask())
+                    string_aggs.append(None)
+                    agg_inputs.append(pair)
+                    specs.append(AggSpec("count"))
+                    continue
                 pair = None
                 string_aggs.append(None)
             else:
@@ -734,6 +744,14 @@ class LocalExecutor:
         bpos_np = np.asarray(bpos)
         osel_np = np.asarray(osel)
         is_outer = bpos_np == J.MISSING
+        if node.single_row:
+            # scalar subquery: each outer row may match at most one row
+            # (reference: EnforceSingleRowNode)
+            matched_probe = ppos_np[osel_np & ~is_outer]
+            if matched_probe.size and np.bincount(matched_probe).max() > 1:
+                raise ExecutionError(
+                    "Scalar sub-query has returned multiple rows"
+                )
         cols: list[Column] = []
         layout: dict[str, int] = {}
         for s in node.left.output_symbols:
@@ -808,9 +826,15 @@ class LocalExecutor:
         left = self._exec(node.left)
         right = self._exec(node.right)
         if not node.criteria:
+            if node.filter is not None:
+                raise ExecutionError(
+                    "non-equi correlated EXISTS without equality criteria "
+                    "is not supported yet"
+                )
             # uncorrelated EXISTS: right side non-empty?
             nonempty = right.batch.count_rows() > 0
-            mark_val = np.full(left.batch.capacity, nonempty, dtype=np.bool_)
+            mark = nonempty if node.join_type == "SEMI" else not nonempty
+            mark_val = np.full(left.batch.capacity, mark, dtype=np.bool_)
             cols = list(left.batch.columns) + [Column(T.BOOLEAN, mark_val)]
             layout = dict(left.layout)
             layout[node.mark_symbol.name] = len(cols) - 1
@@ -832,6 +856,30 @@ class LocalExecutor:
                 break
             out_capacity = bucket_capacity(int(total))
         osel = J.verify_equal(lkeys, rkeys, ppos, bpos, osel)
+        if node.filter is not None:
+            # residual correlated condition: evaluate over (probe row,
+            # build row) pairs and drop non-qualifying matches
+            safe_b = jnp.where(bpos == J.MISSING, 0, bpos)
+            fcols: list[Column] = []
+            flayout: dict[str, int] = {}
+            for s in node.left.output_symbols:
+                c = left.column(s)
+                data, valid = c.to_numpy()
+                p_np = np.asarray(ppos)
+                fcols.append(Column(c.type, data[p_np], valid[p_np], c.dictionary))
+                flayout[s.name] = len(fcols) - 1
+            for s in node.right.output_symbols:
+                c = right.column(s)
+                data, valid = c.to_numpy()
+                b_np = np.asarray(safe_b)
+                fcols.append(Column(c.type, data[b_np], valid[b_np], c.dictionary))
+                flayout[s.name] = len(fcols) - 1
+            from trino_tpu.strings import lower_string_calls
+
+            fexpr = self._bind(node.filter, flayout)
+            fexpr = lower_string_calls(fexpr, fcols)
+            fmask = ExprCompiler(fcols).predicate_mask(fexpr)
+            osel = osel & fmask
         matched = (
             jnp.zeros(left.batch.capacity, dtype=jnp.bool_)
             .at[jnp.where(osel, ppos, left.batch.capacity)]
@@ -848,7 +896,8 @@ class LocalExecutor:
         pv = jnp.ones(left.batch.capacity, dtype=jnp.bool_)
         for _, kv in lkeys:
             pv = pv & kv
-        if not build_nonempty:
+        if not node.null_aware or not build_nonempty:
+            # EXISTS semantics: strictly TRUE/FALSE (NULL keys never match)
             valid = jnp.ones(left.batch.capacity, dtype=jnp.bool_)
         else:
             valid = matched | (pv & (not any_null_build))
@@ -865,6 +914,21 @@ class LocalExecutor:
         lb = left.batch.compact()
         rb = right.batch.compact()
         nl, nr = lb.num_rows, rb.num_rows
+        if node.single_row and nr > 1:
+            raise ExecutionError("Scalar sub-query has returned multiple rows")
+        if node.single_row and nr == 0:
+            # scalar over empty subquery yields NULL: pad one all-NULL row
+            from trino_tpu.spill import pad_to_one_unselected
+
+            padded = pad_to_one_unselected(rb)
+            rb = Batch(
+                [
+                    Column(c.type, np.asarray(c.data), np.zeros(1, dtype=np.bool_), c.dictionary)
+                    for c in padded.columns
+                ],
+                1,
+            )
+            nr = 1
         if nl * nr > (1 << 24):
             raise ExecutionError("cross join too large")
         cols: list[Column] = []
@@ -884,9 +948,35 @@ class LocalExecutor:
         return Result(Batch(cols, nl * nr), layout)
 
     # === set operations =================================================
+    def _exec_groupid(self, node: P.GroupId) -> Result:
+        """Replicate input once per grouping set, nulling absent key
+        columns; appends the group-id column (GroupIdOperator analog)."""
+        res = self._exec(node.source)
+        base = res.batch.compact()
+        parts: list[Batch] = []
+        all_key_names = {s.name for s in node.all_keys}
+        for gidx, group in enumerate(node.groups):
+            present = {s.name for s in group}
+            cols = []
+            for s in node.source.output_symbols:
+                c = base.columns[res.layout[s.name]]
+                if s.name in all_key_names and s.name not in present:
+                    data, _valid = c.to_numpy()
+                    c = Column(
+                        c.type, data, np.zeros(base.num_rows, dtype=np.bool_),
+                        c.dictionary,
+                    )
+                cols.append(c)
+            cols.append(
+                Column(T.BIGINT, np.full(base.num_rows, gidx, dtype=np.int64))
+            )
+            parts.append(Batch(cols, base.num_rows))
+        merged = concat_batches(parts) if len(parts) > 1 else parts[0]
+        layout = {s.name: i for i, s in enumerate(node.source.output_symbols)}
+        layout[node.gid.name] = len(node.source.output_symbols)
+        return Result(merged, layout)
+
     def _exec_setop(self, node: P.SetOp) -> Result:
-        if node.op != "UNION":
-            raise ExecutionError(f"{node.op} not supported yet")
         parts = []
         for inp in node.inputs:
             r = self._exec(inp)
@@ -894,23 +984,75 @@ class LocalExecutor:
             # reorder columns to this input's output symbol order
             cols = [b.columns[r.layout[s.name]] for s in inp.output_symbols]
             parts.append(Batch(cols, b.num_rows))
-        merged = concat_batches(parts)
-        # coerce column types to the setop's output types
-        cols = []
-        for j, s in enumerate(node.symbols):
-            c = merged.columns[j]
-            if c.type != s.type:
+        # coerce every input's column types to the setop's output types
+        coerced = []
+        for p in parts:
+            cols = []
+            for j, s in enumerate(node.symbols):
+                c = p.columns[j]
+                if c.type != s.type:
+                    data, valid = c.to_numpy()
+                    data = _host_cast(data, c.type, s.type)
+                    c = Column(s.type, data, None if valid.all() else valid, c.dictionary)
+                cols.append(c)
+            coerced.append(Batch(cols, p.num_rows))
+        if node.op == "UNION":
+            merged = concat_batches(coerced)
+            res = Result(
+                merged, {s.name: i for i, s in enumerate(node.symbols)}
+            )
+            if node.distinct:
+                return self._exec_distinct(P.Distinct(_FixedNode(node.symbols, res)))
+            return res
+        if node.op in ("INTERSECT", "EXCEPT"):
+            # set semantics (reference: ALL variants unsupported in v1 too):
+            # dedupe left, then keep rows [not] present in the right side —
+            # a distinct + null-aware membership test on all columns
+            return self._exec_setop_membership(node, coerced)
+        raise ExecutionError(f"{node.op} not supported yet")
+
+    def _exec_setop_membership(self, node: P.SetOp, parts: list[Batch]) -> Result:
+        left, right = parts[0], parts[1]
+        # host-side: row tuples (NULL-safe via sentinel) — set ops are
+        # usually small (DISTINCT results); device path is a later optim
+        def keys(b: Batch) -> list[tuple]:
+            # one device->host conversion per column, then row tuples
+            col_data = []
+            for c in b.columns:
                 data, valid = c.to_numpy()
-                data = _host_cast(data, c.type, s.type)
-                c = Column(s.type, data, None if valid.all() else valid, c.dictionary)
-            cols.append(c)
-        res = Result(
-            Batch(cols, merged.num_rows),
+                if c.dictionary is not None:
+                    values = [
+                        c.dictionary.decode(int(code)) if ok else None
+                        for code, ok in zip(data.tolist(), valid.tolist())
+                    ]
+                else:
+                    values = [
+                        v if ok else None
+                        for v, ok in zip(data.tolist(), valid.tolist())
+                    ]
+                col_data.append(values)
+            return list(zip(*col_data)) if col_data else []
+
+        lkeys = keys(left)
+        rset = set(keys(right))
+        seen: set[tuple] = set()
+        rows: list[int] = []
+        for i, k in enumerate(lkeys):
+            if k in seen:
+                continue
+            seen.add(k)
+            member = k in rset
+            if (node.op == "INTERSECT") == member:
+                rows.append(i)
+        idx = np.asarray(rows, dtype=np.int64)
+        cols = []
+        for c in left.columns:
+            data, valid = c.to_numpy()
+            cols.append(Column(c.type, data[idx], valid[idx], c.dictionary))
+        return Result(
+            Batch(cols, len(rows)),
             {s.name: i for i, s in enumerate(node.symbols)},
         )
-        if node.distinct:
-            return self._exec_distinct(P.Distinct(_FixedNode(node.symbols, res)))
-        return res
 
     def _exec__fixednode(self, node: "_FixedNode") -> Result:
         return node.result

@@ -216,6 +216,13 @@ class DistributedExecutor(LocalExecutor):
         string_aggs: list = []
         for _, fn in node.aggregates:
             if fn.kind == "count_star":
+                if fn.filter is not None:
+                    fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
+                    ones = jnp.ones_like(fc.data, dtype=jnp.int64)
+                    agg_inputs.append((ones, fc.data & fc.valid_mask()))
+                    specs.append(AggSpec("count"))
+                    string_aggs.append(None)
+                    continue
                 pair = None
                 string_aggs.append(None)
             else:

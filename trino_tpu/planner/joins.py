@@ -61,7 +61,7 @@ def determine_join_distribution(
                 dist = "partitioned"
         return P.Join(
             node.join_type, left, right, node.criteria, node.filter,
-            dist, node.mark_symbol,
+            dist, node.mark_symbol, node.null_aware, node.single_row,
         )
     new_sources = [determine_join_distribution(s, stats, session) for s in node.sources]
     if new_sources:

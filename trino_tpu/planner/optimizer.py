@@ -180,7 +180,8 @@ def push_down_predicates(node: P.PlanNode, inherited: Optional[list[RowExpr]] = 
         right = push_down_predicates(node.right, to_right)
         out = P.Join(
             join_type, left, right, criteria, node.filter,
-            node.distribution, node.mark_symbol,
+            node.distribution, node.mark_symbol, node.null_aware,
+            node.single_row,
         )
         return _with_filter(out, kept)
 
@@ -303,7 +304,8 @@ def prune_columns(node: P.PlanNode, required: Optional[set[str]] = None) -> P.Pl
         right = prune_columns(node.right, needed & right_names)
         return P.Join(
             node.join_type, left, right, node.criteria, node.filter,
-            node.distribution, node.mark_symbol,
+            node.distribution, node.mark_symbol, node.null_aware,
+            node.single_row,
         )
 
     if isinstance(node, P.Sort):
@@ -318,6 +320,15 @@ def prune_columns(node: P.PlanNode, required: Optional[set[str]] = None) -> P.Pl
 
     if isinstance(node, P.Limit):
         return P.Limit(prune_columns(node.source, set(required)), node.count, node.offset)
+
+    if isinstance(node, P.GroupId):
+        # the aggregate above always needs every grouping key + the gid;
+        # the source additionally feeds any required agg inputs
+        src_required = (set(required) - {node.gid.name}) | {
+            s.name for s in node.all_keys
+        }
+        src = prune_columns(node.source, src_required)
+        return P.GroupId(src, node.groups, node.all_keys, node.gid)
 
     if isinstance(node, P.Distinct):
         # distinct keys are all output columns — everything is required

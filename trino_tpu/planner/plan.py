@@ -165,6 +165,12 @@ class Join(PlanNode):
     distribution: Optional[str] = None
     # for SEMI/ANTI: the output mark symbol replaces right outputs
     mark_symbol: Optional[Symbol] = None
+    # SEMI/ANTI mark semantics: True = 3-valued IN (NULL keys/build-NULLs
+    # yield NULL marks); False = 2-valued EXISTS (TRUE/FALSE only)
+    null_aware: bool = True
+    # scalar-subquery join: error if a probe row matches >1 build row
+    # (reference: EnforceSingleRowNode)
+    single_row: bool = False
 
     @property
     def output_symbols(self):
@@ -177,6 +183,26 @@ class Join(PlanNode):
     @property
     def sources(self):
         return [self.left, self.right]
+
+
+@dataclasses.dataclass
+class GroupId(PlanNode):
+    """Replicates input rows once per grouping set, nulling the key columns
+    absent from each set and emitting a group-id column.
+    Reference: ``plan/GroupIdNode.java`` + ``operator/GroupIdOperator.java``."""
+
+    source: PlanNode
+    groups: list[list[Symbol]]  # key subset per grouping set
+    all_keys: list[Symbol]
+    gid: Symbol
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols + [self.gid]
+
+    @property
+    def sources(self):
+        return [self.source]
 
 
 @dataclasses.dataclass

@@ -173,6 +173,10 @@ class TrinoTpuServer:
             out["partialCancelUri"] = None
         if res.set_session:
             out["_setSession"] = {k: v for k, v in res.set_session.items()}
+        if res.added_prepare is not None:
+            out["_addedPrepare"] = res.added_prepare
+        if res.deallocated_prepare is not None:
+            out["_deallocatedPrepare"] = res.deallocated_prepare
         return out
 
 
@@ -225,6 +229,15 @@ def _make_handler(server: TrinoTpuServer):
                     continue
                 k, v = part.split("=", 1)
                 s.set(k.strip(), _decode_session_value(urllib.parse.unquote(v.strip())))
+            # prepared statements ride headers (the protocol is stateless):
+            # X-Trino-Prepared-Statement: name=<urlencoded sql>[,name=...]
+            raw = h.get(f"{PROTOCOL_HEADER}-Prepared-Statement", "")
+            for part in raw.split(","):
+                part = part.strip()
+                if not part or "=" not in part:
+                    continue
+                k, v = part.split("=", 1)
+                s.prepared[k.strip().lower()] = urllib.parse.unquote(v.strip())
             return s
 
         # --- routes ------------------------------------------------------
@@ -314,6 +327,14 @@ def _make_handler(server: TrinoTpuServer):
                         headers[f"{PROTOCOL_HEADER}-Set-Session"] = (
                             f"{k}={urllib.parse.quote(str(v))}"
                         )
+                added = out.pop("_addedPrepare", None)
+                if added:
+                    headers[f"{PROTOCOL_HEADER}-Added-Prepare"] = (
+                        f"{added[0]}={urllib.parse.quote(added[1])}"
+                    )
+                dealloc = out.pop("_deallocatedPrepare", None)
+                if dealloc:
+                    headers[f"{PROTOCOL_HEADER}-Deallocated-Prepare"] = dealloc
                 return self._send_json(out, headers=headers)
             return self._error(404, f"unknown path: {path}")
 

@@ -44,10 +44,11 @@ KEYWORDS = {
     "SHOW", "DESCRIBE", "EXPLAIN", "ANALYZE", "SUBSTRING", "FOR", "OFFSET",
     "FETCH", "NEXT", "ONLY", "GROUPING", "SETS", "ROLLUP", "CUBE", "IF",
     "SESSION", "TABLES", "SCHEMAS", "CATALOGS", "COLUMNS", "FILTER",
+    "PREPARE", "EXECUTE", "DEALLOCATE", "ANY", "SOME", "POSITION",
 }
 
 _MULTI_OPS = ("<>", "<=", ">=", "!=", "||")
-_SINGLE_OPS = "+-*/%(),.;<>=[]"
+_SINGLE_OPS = "+-*/%(),.;<>=[]?"
 
 
 def tokenize(sql: str) -> list[Token]:

@@ -28,6 +28,7 @@ class Parser:
     def __init__(self, tokens: list[Token]):
         self.tokens = tokens
         self.pos = 0
+        self._param_index = 0
 
     # --- token helpers ---------------------------------------------------
     def peek(self, ahead: int = 0) -> Token:
@@ -137,9 +138,54 @@ class Parser:
         if self.at_kw("CREATE"):
             self.next()
             self.expect_kw("TABLE")
+            not_exists = False
+            if self.at_kw("IF"):
+                self.next()
+                tok = self.peek()
+                if tok.kind == "KW" and tok.upper == "NOT":
+                    self.next()
+                    self.expect_kw("EXISTS")
+                    not_exists = True
             name = self.qualified_name()
+            if self.at_op("("):
+                self.expect_op("(")
+                cols = []
+                while True:
+                    cname = self.identifier()
+                    ty = self._type_text()
+                    cols.append((cname, ty))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                return t.CreateTable(name, tuple(cols), not_exists)
             self.expect_kw("AS")
             return t.CreateTableAsSelect(name, self.query())
+        if self.at_kw("DELETE"):
+            self.next()
+            self.expect_kw("FROM")
+            name = self.qualified_name()
+            where = self.expression() if self.accept_kw("WHERE") else None
+            return t.Delete(name, where)
+        if self.at_kw("PREPARE"):
+            self.next()
+            name = self.identifier()
+            self.expect_kw("FROM")
+            stmt = self._statement()
+            return t.Prepare(name, stmt)
+        if self.at_kw("EXECUTE"):
+            self.next()
+            name = self.identifier()
+            params: tuple[t.Node, ...] = ()
+            if self.accept_kw("USING"):
+                ps = [self.expression()]
+                while self.accept_op(","):
+                    ps.append(self.expression())
+                params = tuple(ps)
+            return t.Execute(name, params)
+        if self.at_kw("DEALLOCATE"):
+            self.next()
+            self.expect_kw("PREPARE")
+            return t.Deallocate(self.identifier())
         if self.at_kw("INSERT"):
             self.next()
             self.expect_kw("INTO")
@@ -209,6 +255,9 @@ class Parser:
         if self.accept_kw("LIMIT"):
             tok = self.next()
             limit = None if tok.upper == "ALL" else int(tok.text)
+            if self.accept_kw("OFFSET"):  # LIMIT n OFFSET m order
+                offset = int(self.next().text)
+                self.accept_kw("ROWS") or self.accept_kw("ROW")
         elif self.accept_kw("FETCH"):
             self.accept_kw("FIRST") or self.accept_kw("NEXT")
             limit = int(self.next().text)
@@ -297,12 +346,58 @@ class Parser:
         group_by: tuple[t.Node, ...] = ()
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            exprs = [self.expression()]
+            exprs = [self._group_by_element()]
             while self.accept_op(","):
-                exprs.append(self.expression())
+                exprs.append(self._group_by_element())
             group_by = tuple(exprs)
         having = self.expression() if self.accept_kw("HAVING") else None
         return t.QuerySpec(tuple(items), distinct, from_, where, group_by, having)
+
+    def _group_by_element(self) -> t.Node:
+        """Plain expression, or ROLLUP/CUBE/GROUPING SETS
+        (reference grammar: SqlBase.g4 groupingElement)."""
+        if self.at_kw("ROLLUP") or self.at_kw("CUBE"):
+            kind = self.next().upper
+            self.expect_op("(")
+            cols = [self.expression()]
+            while self.accept_op(","):
+                cols.append(self.expression())
+            self.expect_op(")")
+            cols = tuple(cols)
+            if kind == "ROLLUP":
+                sets = tuple(tuple(cols[:i]) for i in range(len(cols), -1, -1))
+            else:  # CUBE: all subsets, larger first
+                import itertools as _it
+
+                sets = tuple(
+                    tuple(c)
+                    for r in range(len(cols), -1, -1)
+                    for c in _it.combinations(cols, r)
+                )
+            return t.GroupingSets(kind, sets)
+        if self.at_kw("GROUPING"):
+            save = self.pos
+            self.next()
+            if self.accept_kw("SETS"):
+                self.expect_op("(")
+                sets = []
+                while True:
+                    if self.accept_op("("):
+                        inner = []
+                        if not self.at_op(")"):
+                            inner.append(self.expression())
+                            while self.accept_op(","):
+                                inner.append(self.expression())
+                        self.expect_op(")")
+                        sets.append(tuple(inner))
+                    else:
+                        sets.append((self.expression(),))
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                return t.GroupingSets("GROUPING SETS", tuple(sets))
+            self.pos = save  # grouping(...) function call
+        return self.expression()
 
     def _select_item(self) -> t.SelectItem:
         if self.at_op("*"):
@@ -439,6 +534,15 @@ class Parser:
                 op = self.next().text
                 if op == "!=":
                     op = "<>"
+                if self.at_kw("ANY", "SOME", "ALL"):
+                    quant = self.next().upper
+                    if quant == "SOME":
+                        quant = "ANY"
+                    self.expect_op("(")
+                    q = self.query()
+                    self.expect_op(")")
+                    left = t.QuantifiedComparison(op, quant, left, q)
+                    continue
                 right = self._additive()
                 left = t.BinaryOp(op, left, right)
                 continue
@@ -516,6 +620,10 @@ class Parser:
         if tok.kind == "STRING":
             self.next()
             return t.Literal(tok.text, "string")
+        if tok.kind == "OP" and tok.text == "?":
+            self.next()
+            self._param_index += 1
+            return t.Parameter(self._param_index - 1)
         if tok.kind == "OP" and tok.text == "(":
             self.next()
             if self.at_kw("SELECT", "WITH"):
@@ -608,6 +716,15 @@ class Parser:
                 self.expect_op(")")
                 whens = ((cond, then),)
                 return t.Case(None, whens, default)
+            if kw == "POSITION" and self.peek(1).kind == "OP" and self.peek(1).text == "(":
+                # POSITION(needle IN haystack) -> strpos(haystack, needle)
+                self.next()
+                self.expect_op("(")
+                needle = self._additive()
+                self.expect_kw("IN")
+                haystack = self.expression()
+                self.expect_op(")")
+                return t.FunctionCall("strpos", (haystack, needle))
             if kw in _NONRESERVED:
                 pass  # fall through to identifier handling
             else:
@@ -619,6 +736,9 @@ class Parser:
             name = self.qualified_name()
             if self.at_op("(") :
                 return self._function_call(".".join(name))
+            if len(name) == 1 and name[0].lower() in _NILADIC:
+                # current_date / current_timestamp etc. take no parens
+                return t.FunctionCall(name[0].lower(), ())
             return t.Identifier(name)
         raise SqlSyntaxError(f"unexpected token {tok.text!r}", tok.line, tok.col)
 
@@ -646,9 +766,14 @@ class Parser:
         if self.at_op("*"):
             self.next()
             self.expect_op(")")
-            args = []
-            name_l = name.lower()
-            fc = t.FunctionCall(name_l, (t.Star(),))
+            fc = t.FunctionCall(name.lower(), (t.Star(),))
+            if self.at_kw("FILTER"):  # count(*) FILTER (WHERE ...)
+                self.next()
+                self.expect_op("(")
+                self.expect_kw("WHERE")
+                cond = self.expression()
+                self.expect_op(")")
+                fc = t.FunctionCall(fc.name, fc.args, fc.distinct, filter=cond)
             return self._maybe_over(fc)
         if not self.at_op(")"):
             if self.accept_kw("DISTINCT"):
@@ -732,5 +857,8 @@ _NONRESERVED = {
     "TIMESTAMP", "IF", "FILTER", "SHOW", "TABLES", "SCHEMAS", "CATALOGS",
     "COLUMNS", "SESSION", "ANALYZE", "OVER", "PARTITION", "RANGE", "ROWS",
     "ROW", "FIRST", "LAST", "NEXT", "ONLY", "VALUES", "SETS", "OFFSET",
-    "SUBSTRING", "CURRENT", "GROUPING",
+    "SUBSTRING", "CURRENT", "GROUPING", "POSITION", "PREPARE",
+    "EXECUTE", "DEALLOCATE",
 }
+
+_NILADIC = {"current_date", "current_timestamp", "localtimestamp", "now"}

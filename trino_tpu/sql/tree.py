@@ -80,6 +80,24 @@ class InList(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class QuantifiedComparison(Node):
+    """value OP ANY|ALL (query) — reference: tree/QuantifiedComparisonExpression."""
+
+    op: str = "="
+    quantifier: str = "ANY"  # ANY | ALL (SOME == ANY)
+    value: Optional[Node] = None
+    query: Optional["Query"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupingSets(Node):
+    """GROUP BY GROUPING SETS / ROLLUP / CUBE — reference: tree/GroupingSets."""
+
+    kind: str = "GROUPING SETS"  # GROUPING SETS | ROLLUP | CUBE
+    sets: tuple[tuple[Node, ...], ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class InSubquery(Node):
     value: Node
     query: "Query"
@@ -277,6 +295,46 @@ class InsertInto(Node):
     name: tuple[str, ...] = ()
     columns: tuple[str, ...] = ()
     query: Optional[Query] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable(Node):
+    """CREATE TABLE name (col type, ...) — reference: tree/CreateTable."""
+
+    name: tuple[str, ...] = ()
+    columns: tuple[tuple[str, str], ...] = ()  # (name, type text)
+    not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Delete(Node):
+    name: tuple[str, ...] = ()
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    name: str = ""
+    statement: Optional[Node] = None
+    sql: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Execute(Node):
+    name: str = ""
+    parameters: tuple[Node, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    name: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class Parameter(Node):
+    """A ? placeholder in a prepared statement."""
+
+    index: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
