@@ -608,8 +608,12 @@ class Analyzer:
 
         node: P.PlanNode = agg_node
         if having_ast is not None:
-            pred = _fold(rewrite_post(having_ast))
-            node = P.Filter(node, pred)
+            # HAVING may contain (uncorrelated) subqueries — TPC-H Q11
+            rp_h = RelationPlan(node, Scope([]))
+            pred, rp_h = self._rewrite_with_subqueries(
+                having_ast, rp_h, post_replacements or None
+            )
+            node = P.Filter(rp_h.node, _fold(pred))
 
         # windows over aggregation results (rank() OVER (ORDER BY sum(x)))
         window_calls: list[t.FunctionCall] = []
@@ -958,8 +962,14 @@ class Analyzer:
         kind = fc.name
         arg = _fold(self._rewrite(fc.args[0], input_scope))
         if kind in ("bool_and", "every", "bool_or"):
+            # NULL inputs are IGNORED by aggregates: map TRUE->1, FALSE->0,
+            # NULL->NULL (the nested IF keeps NULL invalid, so min/max skip it)
             as_int = special(
-                "if", T.BIGINT, arg, const(1, T.BIGINT), const(0, T.BIGINT)
+                "if", T.BIGINT, arg, const(1, T.BIGINT),
+                special(
+                    "if", T.BIGINT, special("not", T.BOOLEAN, arg),
+                    const(0, T.BIGINT), Constant(type=T.BIGINT, value=None),
+                ),
             )
             agg_kind = "min" if kind in ("bool_and", "every") else "max"
             s = add_agg(agg_kind, as_int, T.BIGINT, filt=fc_filter)
@@ -1071,10 +1081,23 @@ class Analyzer:
                     "correlated subquery with GROUP BY is not supported"
                 )
             # global agg over correlated filter: group by the inner symbols
-            # of the correlated equalities instead
+            # of the correlated EQUALITIES. Non-equality correlated
+            # predicates cannot be hoisted above the aggregate (they would
+            # filter after aggregation, changing its input) — reject.
             available = {s.name: s for s in src.output_symbols}
             keys: list[P.Symbol] = []
             for c in corr:
+                is_eq = (
+                    isinstance(c, Call)
+                    and c.name == "eq"
+                    and len(c.args) == 2
+                    and all(isinstance(a, Variable) for a in c.args)
+                )
+                if not is_eq:
+                    raise SemanticError(
+                        "correlated aggregate subquery supports only "
+                        "equality correlation predicates"
+                    )
                 for r in referenced_variables(c):
                     if r in produced:
                         if r not in available:
@@ -1400,7 +1423,8 @@ class Analyzer:
                 if isinstance(operand.type, T.DecimalType):
                     from decimal import Decimal as _D
 
-                    s = str(_D(v) / operand.type.unscale)
+                    # scaleb keeps the declared scale: 1.50 -> '1.50'
+                    s = str(_D(v).scaleb(-operand.type.scale))
                 elif isinstance(operand.type, T.BooleanType):
                     s = "true" if v else "false"
                 else:
@@ -2010,13 +2034,9 @@ def _days_in_month(y: int, m: int) -> int:
     return calendar.monthrange(y, m)[1]
 
 
-def _conjuncts_of(e: RowExpr) -> list[RowExpr]:
-    if isinstance(e, SpecialForm) and e.form == "and":
-        out: list[RowExpr] = []
-        for a in e.args:
-            out.extend(_conjuncts_of(a))
-        return out
-    return [e]
+# shared AND-flattening helper (no OR factoring — decorrelation must see
+# filters exactly as written)
+from trino_tpu.planner.optimizer import _conjuncts_no_or as _conjuncts_of  # noqa: E402
 
 
 _MATH_DOUBLE_FNS = {

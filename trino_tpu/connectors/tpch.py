@@ -349,14 +349,15 @@ class TpchConnector(Connector):
         names = self._strings(
             "s_name_pool", [f"Supplier#{i:09d}" for i in range(1, min(rows, 100_000) + 1)]
         )
+        nationkey = rng.integers(0, 25, n).astype(np.int64)
         return {
             "s_suppkey": Column(T.BIGINT, keys),
             "s_name": Column(
                 T.VARCHAR, ((keys - 1) % len(names)).astype(np.int32), None, names
             ),
             "s_address": self._comments(rng, n, "addr"),
-            "s_nationkey": Column(T.BIGINT, rng.integers(0, 25, n).astype(np.int64)),
-            "s_phone": self._comments(rng, n, "phone"),
+            "s_nationkey": Column(T.BIGINT, nationkey),
+            "s_phone": _phone_col(nationkey, rng),
             "s_acctbal": Column(DEC, rng.integers(-99999, 999999, n).astype(np.int64)),
             "s_comment": self._comments(rng, n, "supplier"),
         }
@@ -370,14 +371,15 @@ class TpchConnector(Connector):
         names = self._strings(
             "c_name_pool", [f"Customer#{i:09d}" for i in range(1, min(rows, 150_000) + 1)]
         )
+        nationkey = rng.integers(0, 25, n).astype(np.int64)
         return {
             "c_custkey": Column(T.BIGINT, keys),
             "c_name": Column(
                 T.VARCHAR, ((keys - 1) % len(names)).astype(np.int32), None, names
             ),
             "c_address": self._comments(rng, n, "addr"),
-            "c_nationkey": Column(T.BIGINT, rng.integers(0, 25, n).astype(np.int64)),
-            "c_phone": self._comments(rng, n, "phone"),
+            "c_nationkey": Column(T.BIGINT, nationkey),
+            "c_phone": _phone_col(nationkey, rng),
             "c_acctbal": Column(DEC, rng.integers(-99999, 999999, n).astype(np.int64)),
             "c_mktsegment": self._dict_col(
                 "c_mktsegment", _SEGMENTS, rng.integers(0, 5, n)
@@ -391,9 +393,15 @@ class TpchConnector(Connector):
         n = hi - lo
         keys = np.arange(lo + 1, hi + 1, dtype=np.int64)
         rng = self._rng("part", index)
+        # spec color vocabulary subset incl. words TPC-H predicates probe
+        # for ('%green%' in Q9, 'forest%' in Q20)
         name_words = [
             "almond", "antique", "aquamarine", "azure", "beige", "bisque",
             "black", "blanched", "blue", "blush", "brown", "burlywood",
+            "chartreuse", "chocolate", "coral", "cornflower", "cream",
+            "cyan", "dark", "deep", "dim", "dodger", "drab", "firebrick",
+            "forest", "frosted", "gainsboro", "ghost", "goldenrod", "green",
+            "grey", "honeydew", "hot", "indian", "ivory", "khaki",
         ]
         pnames = self._strings(
             "p_name_pool",
@@ -543,3 +551,16 @@ def _line_numbers(nlines: np.ndarray) -> np.ndarray:
     total = int(nlines.sum())
     starts = np.repeat(np.cumsum(nlines) - nlines, nlines)
     return (np.arange(total, dtype=np.int64) - starts).astype(np.int64)
+
+
+def _phone_col(nationkey: np.ndarray, rng) -> Column:
+    """Spec phone shape CC-NNN-NNN-NNNN with CC = nationkey + 10 — Q22
+    filters on the country-code prefix, so it must be meaningful."""
+    local = rng.integers(0, 1000, (len(nationkey), 3))
+    last = rng.integers(0, 10000, len(nationkey))
+    values = [
+        f"{int(nk) + 10}-{a:03d}-{b:03d}-{c:03d}{d % 10}"
+        for nk, (a, b, c), d in zip(nationkey, local, last)
+    ]
+    d, codes = Dictionary.from_strings(values)
+    return Column(T.VARCHAR, codes, None, d)

@@ -81,6 +81,16 @@ class LocalExecutor:
         res = self._exec(node)
         return res.batch.compact(), [s.name for s in node.output_symbols]
 
+    @staticmethod
+    def _nonempty(res: Result) -> Result:
+        """Kernels reject 0-capacity arrays; represent an empty relation as
+        one unselected padding row."""
+        if res.batch.capacity > 0:
+            return res
+        from trino_tpu.spill import pad_to_one_unselected
+
+        return Result(pad_to_one_unselected(res.batch), res.layout)
+
     # === dispatch =======================================================
     def _exec(self, node: P.PlanNode) -> Result:
         method = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
@@ -316,6 +326,7 @@ class LocalExecutor:
     def _aggregate_result(
         self, node: P.Aggregate, res: Result, allow_spill: bool = True
     ) -> Result:
+        res = self._nonempty(res)
         if (
             allow_spill
             and node.group_keys
@@ -721,6 +732,8 @@ class LocalExecutor:
         return left_plan
 
     def _join_result(self, node: P.Join, left: Result, right: Result) -> Result:
+        left = self._nonempty(left)
+        right = self._nonempty(right)
         lkeys, rkeys = self._join_keys(left, right, node.criteria)
         bh, bv = J.hash_keys(rkeys)
         ph, pv = J.hash_keys(lkeys)
@@ -823,8 +836,8 @@ class LocalExecutor:
         return lkeys, rkeys
 
     def _exec_semi_join(self, node: P.Join) -> Result:
-        left = self._exec(node.left)
-        right = self._exec(node.right)
+        left = self._nonempty(self._exec(node.left))
+        right = self._nonempty(self._exec(node.right))
         if not node.criteria:
             if node.filter is not None:
                 raise ExecutionError(
@@ -1034,16 +1047,36 @@ class LocalExecutor:
             return list(zip(*col_data)) if col_data else []
 
         lkeys = keys(left)
-        rset = set(keys(right))
-        seen: set[tuple] = set()
         rows: list[int] = []
-        for i, k in enumerate(lkeys):
-            if k in seen:
-                continue
-            seen.add(k)
-            member = k in rset
-            if (node.op == "INTERSECT") == member:
-                rows.append(i)
+        if node.distinct:
+            rset = set(keys(right))
+            seen: set[tuple] = set()
+            for i, k in enumerate(lkeys):
+                if k in seen:
+                    continue
+                seen.add(k)
+                member = k in rset
+                if (node.op == "INTERSECT") == member:
+                    rows.append(i)
+        else:
+            # ALL variants: bag semantics — INTERSECT ALL keeps
+            # min(mult_l, mult_r) copies; EXCEPT ALL keeps mult_l - mult_r
+            from collections import Counter
+
+            rcount = Counter(keys(right))
+            if node.op == "INTERSECT":
+                taken: Counter = Counter()
+                for i, k in enumerate(lkeys):
+                    if taken[k] < rcount.get(k, 0):
+                        taken[k] += 1
+                        rows.append(i)
+            else:  # EXCEPT ALL
+                skipped: Counter = Counter()
+                for i, k in enumerate(lkeys):
+                    if skipped[k] < rcount.get(k, 0):
+                        skipped[k] += 1
+                    else:
+                        rows.append(i)
         idx = np.asarray(rows, dtype=np.int64)
         cols = []
         for c in left.columns:

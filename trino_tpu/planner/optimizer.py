@@ -102,7 +102,59 @@ def _conjuncts(e: RowExpr) -> list[RowExpr]:
         for a in e.args:
             out.extend(_conjuncts(a))
         return out
+    e = _extract_common_or_conjuncts(e)
+    if isinstance(e, SpecialForm) and e.form == "and":
+        return _conjuncts(e)
     return [e]
+
+
+def _extract_common_or_conjuncts(e: RowExpr) -> RowExpr:
+    """OR(A∧X, A∧Y) -> A ∧ OR(X, Y): factor conjuncts common to every OR
+    branch so they can push down / become join criteria (reference:
+    sql/ExpressionUtils.extractCommonPredicates, essential for TPC-H Q19's
+    OR-of-conjunction-with-shared-join-key shape)."""
+    if not (isinstance(e, SpecialForm) and e.form == "or"):
+        return e
+    branches = [_conjuncts_no_or(b) for b in _disjuncts(e)]
+    common = [c for c in branches[0] if all(c in b for b in branches[1:])]
+    if not common:
+        return e
+    remainders = []
+    for b in branches:
+        rem = [c for c in b if c not in common]
+        if not rem:  # a branch reduced to TRUE: the OR is implied by common
+            remainders = None
+            break
+        remainders.append(_combine_and(rem))
+    parts = list(common)
+    if remainders is not None:
+        parts.append(SpecialForm(type=T.BOOLEAN, form="or", args=tuple(remainders)))
+    return _combine_and(parts)
+
+
+def _disjuncts(e: RowExpr) -> list[RowExpr]:
+    if isinstance(e, SpecialForm) and e.form == "or":
+        out = []
+        for a in e.args:
+            out.extend(_disjuncts(a))
+        return out
+    return [e]
+
+
+def _conjuncts_no_or(e: RowExpr) -> list[RowExpr]:
+    if isinstance(e, SpecialForm) and e.form == "and":
+        out = []
+        for a in e.args:
+            out.extend(_conjuncts_no_or(a))
+        return out
+    return [e]
+
+
+def _combine_and(parts: list[RowExpr]) -> RowExpr:
+    out = parts[0]
+    for p in parts[1:]:
+        out = special("and", T.BOOLEAN, out, p)
+    return out
 
 
 def _combine(conjuncts: list[RowExpr]) -> Optional[RowExpr]:

@@ -290,16 +290,30 @@ class Parser:
         return t.SortItem(e, ascending, nulls_first)
 
     def _set_operation(self) -> t.Node:
-        left = self._query_term()
-        while self.at_kw("UNION", "EXCEPT", "INTERSECT"):
+        # SQL precedence: INTERSECT binds tighter than UNION/EXCEPT
+        left = self._intersect_term()
+        while self.at_kw("UNION", "EXCEPT"):
             op = self.next().upper
             distinct = True
             if self.accept_kw("ALL"):
                 distinct = False
             else:
                 self.accept_kw("DISTINCT")
-            right = self._query_term()
+            right = self._intersect_term()
             left = t.SetOperation(op, distinct, left, right)
+        return left
+
+    def _intersect_term(self) -> t.Node:
+        left = self._query_term()
+        while self.at_kw("INTERSECT"):
+            self.next()
+            distinct = True
+            if self.accept_kw("ALL"):
+                distinct = False
+            else:
+                self.accept_kw("DISTINCT")
+            right = self._query_term()
+            left = t.SetOperation("INTERSECT", distinct, left, right)
         return left
 
     def _query_term(self) -> t.Node:
