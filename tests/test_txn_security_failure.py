@@ -1,0 +1,137 @@
+"""Transactions, access control, heartbeat failure detection.
+
+Mirrors reference tests for ``transaction/``, ``security/`` (file-based
+access control), and ``failuredetector/``.
+"""
+
+import time
+
+import pytest
+
+from trino_tpu.config import Session
+from trino_tpu.security import (
+    AccessControlManager,
+    AccessDeniedError,
+    FileBasedAccessControl,
+)
+from trino_tpu.server.failuredetector import HeartbeatFailureDetector
+from trino_tpu.testing import LocalQueryRunner
+
+
+class TestTransactions:
+    def test_rollback_restores_data(self):
+        r = LocalQueryRunner()
+        r.execute("create table memory.default.txn_t (a bigint)")
+        r.execute("insert into memory.default.txn_t select 1")
+        r.execute("start transaction")
+        r.execute("insert into memory.default.txn_t select 2")
+        r.assert_query("select count(*) from memory.default.txn_t", [(2,)])
+        r.execute("rollback")
+        r.assert_query("select count(*) from memory.default.txn_t", [(1,)])
+
+    def test_commit_keeps_data(self):
+        r = LocalQueryRunner()
+        r.execute("create table memory.default.txn_c (a bigint)")
+        r.execute("start transaction")
+        r.execute("insert into memory.default.txn_c select 42")
+        r.execute("commit")
+        r.assert_query("select a from memory.default.txn_c", [(42,)])
+
+    def test_rollback_restores_dropped_table(self):
+        r = LocalQueryRunner()
+        r.execute("create table memory.default.txn_d (a bigint)")
+        r.execute("insert into memory.default.txn_d select 7")
+        r.execute("start transaction")
+        r.execute("drop table memory.default.txn_d")
+        r.execute("rollback")
+        r.assert_query("select a from memory.default.txn_d", [(7,)])
+
+    def test_errors(self):
+        r = LocalQueryRunner()
+        with pytest.raises(Exception, match="no transaction"):
+            r.execute("commit")
+        r.execute("start transaction")
+        with pytest.raises(Exception, match="already in progress"):
+            r.execute("start transaction")
+        r.execute("rollback")
+
+
+class TestAccessControl:
+    def _runner_with_rules(self, rules):
+        r = LocalQueryRunner()
+        r.engine.access_control.add(FileBasedAccessControl({"catalogs": rules}))
+        return r
+
+    def test_deny_select(self):
+        r = self._runner_with_rules(
+            [{"user": "admin", "catalog": ".*", "allow": "all"}]
+        )
+        r.session.user = "bob"
+        with pytest.raises(AccessDeniedError):
+            r.execute("select count(*) from tpch.tiny.nation")
+        r.session.user = "admin"
+        r.assert_query("select count(*) from tpch.tiny.nation", [(25,)])
+
+    def test_read_only_catalog(self):
+        r = self._runner_with_rules(
+            [{"user": ".*", "catalog": "memory", "allow": "read-only"},
+             {"user": ".*", "catalog": ".*", "allow": "all"}]
+        )
+        with pytest.raises(AccessDeniedError):
+            r.execute("create table memory.default.denied (a bigint)")
+        # reads on other catalogs unaffected
+        r.assert_query("select count(*) from tpch.tiny.region", [(5,)])
+
+    def test_default_allows_all(self):
+        r = LocalQueryRunner()
+        r.assert_query("select count(*) from tpch.tiny.region", [(5,)])
+        r.execute("create table memory.default.ok_t (a bigint)")
+        r.execute("drop table memory.default.ok_t")
+
+    def test_filter_catalogs(self):
+        ac = AccessControlManager()
+        ac.add(FileBasedAccessControl(
+            {"catalogs": [{"user": "u", "catalog": "tpch", "allow": "all"}]}
+        ))
+        assert ac.filter_catalogs("u", ["tpch", "memory"]) == ["tpch"]
+
+
+class TestFailureDetector:
+    def test_marks_failed_and_recovers(self):
+        state = {"up": True}
+        fd = HeartbeatFailureDetector(lambda uri: state["up"], interval=0.01, decay_seconds=0.1)
+        fd.register("w1", "http://w1")
+        for _ in range(5):
+            fd.ping_all()
+            time.sleep(0.01)
+        assert fd.active_nodes() == ["w1"]
+        state["up"] = False
+        for _ in range(10):
+            fd.ping_all()
+            time.sleep(0.01)
+        assert fd.is_failed("w1")
+        assert fd.active_nodes() == []
+        state["up"] = True
+        deadline = time.time() + 10
+        while fd.is_failed("w1") and time.time() < deadline:
+            fd.ping_all()
+            time.sleep(0.05)
+        assert not fd.is_failed("w1")  # exponential-decay recovery
+
+    def test_background_loop(self):
+        fd = HeartbeatFailureDetector(lambda uri: True, interval=0.01).start()
+        fd.register("w1", "u")
+        time.sleep(0.1)
+        fd.stop()
+        assert fd.info()[0]["lastSeen"] is not None
+
+    def test_ping_exception_counts_as_failure(self):
+        def bad(uri):
+            raise ConnectionError("down")
+
+        fd = HeartbeatFailureDetector(bad, interval=0.01)
+        fd.register("w1", "u")
+        for _ in range(8):
+            fd.ping_all()
+            time.sleep(0.01)
+        assert fd.is_failed("w1")

@@ -92,9 +92,15 @@ class RelationPlan:
 
 
 class Analyzer:
-    def __init__(self, catalogs: CatalogManager, session: Session):
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        session: Session,
+        access_control=None,
+    ):
         self.catalogs = catalogs
         self.session = session
+        self.access_control = access_control
         self.ctes: dict[str, RelationPlan] = {}
 
     # ==== entry =========================================================
@@ -721,6 +727,10 @@ class Analyzer:
         ts = connector.get_table(schema, table)
         if ts is None:
             raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
+        if self.access_control is not None:
+            self.access_control.check_can_select(
+                self.session.user, catalog, schema, table
+            )
         symbols = [
             P.Symbol(P.fresh_name(c.name), c.type) for c in ts.columns
         ]

@@ -70,6 +70,8 @@ class StatementClient:
                 f"{k}={urllib.parse.quote(v)}"
                 for k, v in self.session.prepared_statements.items()
             )
+        if self.session.transaction_id:
+            h[f"{HEADER}-Transaction-Id"] = self.session.transaction_id
         return h
 
     def _request(self, method: str, uri: str, body: Optional[bytes] = None) -> dict:
@@ -88,6 +90,11 @@ class StatementClient:
             dealloc = resp.headers.get(f"{HEADER}-Deallocated-Prepare")
             if dealloc:
                 self.session.prepared_statements.pop(dealloc, None)
+            started = resp.headers.get(f"{HEADER}-Started-Transaction-Id")
+            if started:
+                self.session.transaction_id = started
+            if resp.headers.get(f"{HEADER}-Clear-Transaction-Id"):
+                self.session.transaction_id = None
             return json.loads(resp.read().decode())
 
     def _advance_state(self, payload: dict) -> None:
@@ -156,6 +163,8 @@ class ClientSession:
     properties: dict[str, Any] = dataclasses.field(default_factory=dict)
     # name -> SQL text, mirrored via X-Trino-*-Prepare headers
     prepared_statements: dict[str, str] = dataclasses.field(default_factory=dict)
+    # explicit transaction id (X-Trino-Transaction-Id roundtrip)
+    transaction_id: Optional[str] = None
 
 
 class Connection:

@@ -40,6 +40,20 @@ class MemoryConnector(Connector):
         self._stats.pop((schema, table), None)
         return compacted.num_rows
 
+    # --- transaction snapshot support (see trino_tpu.transaction) --------
+
+    def snapshot_state(self):
+        return (
+            dict(self._tables),
+            {k: list(v) for k, v in self._data.items()},
+        )
+
+    def restore_state(self, snap):
+        tables, data = snap
+        self._tables = dict(tables)
+        self._data = {k: list(v) for k, v in data.items()}
+        self._stats.clear()
+
     def truncate(self, schema, table):
         if (schema, table) not in self._tables:
             raise KeyError(f"table not found: {schema}.{table}")

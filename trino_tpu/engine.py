@@ -37,6 +37,9 @@ class StatementResult:
     # prepared-statement session mutations (ride X-Trino-*-Prepare headers)
     added_prepare: Optional[tuple[str, str]] = None  # (name, sql)
     deallocated_prepare: Optional[str] = None
+    # transaction mutations (X-Trino-Started-Transaction-Id / Clear-...)
+    started_transaction_id: Optional[str] = None
+    cleared_transaction: bool = False
 
 
 class Engine:
@@ -77,6 +80,12 @@ class Engine:
 
         self._recent_queries: "deque[dict]" = deque(maxlen=200)
         self._runtime_nodes_fn = None  # server installs live node info
+        # transactions + access control (SURVEY §2 Transactions / Security)
+        from trino_tpu.security import AccessControlManager
+        from trino_tpu.transaction import TransactionManager
+
+        self.transaction_manager = TransactionManager(self.catalogs)
+        self.access_control = AccessControlManager()
         try:
             from trino_tpu.connectors.system import SystemConnector
 
@@ -187,7 +196,7 @@ class Engine:
     def plan(self, stmt: t.Node, session: Session) -> P.PlanNode:
         from trino_tpu.planner.optimizer import optimize
 
-        analyzer = Analyzer(self.catalogs, session)
+        analyzer = Analyzer(self.catalogs, session, self.access_control)
         plan = analyzer.plan_statement(stmt)
         return optimize(plan, session, self.catalogs)
 
@@ -259,8 +268,10 @@ class Engine:
     # === metadata / SHOW ==================================================
 
     def _do_showcatalogs(self, stmt, session) -> StatementResult:
-        rows = [(name,) for name in self.catalogs.names()]
-        return StatementResult(rows, ["Catalog"], [T.VARCHAR])
+        names = self.access_control.filter_catalogs(
+            session.user, self.catalogs.names()
+        )
+        return StatementResult([(n,) for n in names], ["Catalog"], [T.VARCHAR])
 
     def _do_showschemas(self, stmt, session) -> StatementResult:
         catalog = stmt.catalog or session.catalog
@@ -327,6 +338,7 @@ class Engine:
         self, stmt: t.CreateTableAsSelect, session: Session
     ) -> StatementResult:
         catalog, schema, table = self._qualify(stmt.name, session)
+        self.access_control.check_can_create(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
         batch, names = self._run_query_rows(stmt.query, session)
         cols = tuple(
@@ -340,10 +352,15 @@ class Engine:
 
     def _do_insertinto(self, stmt: t.InsertInto, session: Session) -> StatementResult:
         catalog, schema, table = self._qualify(stmt.name, session)
+        self.access_control.check_can_insert(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
         ts = conn.get_table(schema, table)
         if ts is None:
             raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
+        with self._write_guard(session):
+            return self._do_insert_locked(stmt, session, conn, schema, table, ts)
+
+    def _do_insert_locked(self, stmt, session, conn, schema, table, ts) -> StatementResult:
         batch, names = self._run_query_rows(stmt.query, session)
         ncols = len(stmt.columns) if stmt.columns else len(ts.columns)
         if len(batch.columns) != ncols:
@@ -379,6 +396,7 @@ class Engine:
 
     def _do_droptable(self, stmt: t.DropTable, session: Session) -> StatementResult:
         catalog, schema, table = self._qualify(stmt.name, session)
+        self.access_control.check_can_drop(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
         if conn.get_table(schema, table) is None and stmt.if_exists:
             return StatementResult([], ["result"], [T.BOOLEAN], update_type="DROP TABLE")
@@ -387,6 +405,7 @@ class Engine:
 
     def _do_createtable(self, stmt: t.CreateTable, session: Session) -> StatementResult:
         catalog, schema, table = self._qualify(stmt.name, session)
+        self.access_control.check_can_create(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
         if conn.get_table(schema, table) is not None:
             if stmt.not_exists:
@@ -405,12 +424,17 @@ class Engine:
         FALSE or NULL remain (reference DELETE semantics). Implemented as
         keep-filter + truncate + reinsert (connector-neutral)."""
         catalog, schema, table = self._qualify(stmt.name, session)
+        self.access_control.check_can_insert(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
         ts = conn.get_table(schema, table)
         if ts is None:
             raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
         if not hasattr(conn, "truncate"):
             raise SemanticError(f"{conn.name}: DELETE not supported")
+        with self._write_guard(session):
+            return self._do_delete_locked(stmt, session, conn, catalog, schema, table)
+
+    def _do_delete_locked(self, stmt, session, conn, catalog, schema, table) -> StatementResult:
         before = conn.estimate_rows(schema, table) or 0
         if stmt.where is None:
             conn.truncate(schema, table)
@@ -434,6 +458,64 @@ class Engine:
         return StatementResult(
             [], ["rows"], [T.BIGINT],
             update_type="DELETE", update_count=before - batch.num_rows,
+        )
+
+
+    def _write_guard(self, session: Session):
+        """Single-writer enforcement for autocommit writes: inside an
+        explicit transaction the session already holds the write lock;
+        otherwise hold it for the duration of this statement."""
+        import contextlib
+
+        if session.properties.get("__txn"):
+            return contextlib.nullcontext()
+        lock = self.transaction_manager.write_lock
+
+        @contextlib.contextmanager
+        def guard():
+            if not lock.acquire(timeout=60):
+                from trino_tpu.transaction import TransactionError
+
+                raise TransactionError("timed out waiting for the write lock")
+            try:
+                yield
+            finally:
+                lock.release()
+
+        return guard()
+
+    # === transactions =====================================================
+
+    def _do_starttransaction(self, stmt, session: Session) -> StatementResult:
+        if session.properties.get("__txn"):
+            raise SemanticError("transaction already in progress")
+        txn_id = self.transaction_manager.begin()
+        session.properties["__txn"] = txn_id
+        return StatementResult(
+            [], ["result"], [T.BOOLEAN], update_type="START TRANSACTION",
+            started_transaction_id=txn_id,
+        )
+
+    def _do_commit(self, stmt, session: Session) -> StatementResult:
+        txn = session.properties.get("__txn")
+        if not txn:
+            raise SemanticError("no transaction in progress")
+        self.transaction_manager.commit(txn)
+        session.properties.pop("__txn", None)
+        return StatementResult(
+            [], ["result"], [T.BOOLEAN], update_type="COMMIT",
+            cleared_transaction=True,
+        )
+
+    def _do_rollback(self, stmt, session: Session) -> StatementResult:
+        txn = session.properties.get("__txn")
+        if not txn:
+            raise SemanticError("no transaction in progress")
+        self.transaction_manager.rollback(txn)
+        session.properties.pop("__txn", None)
+        return StatementResult(
+            [], ["result"], [T.BOOLEAN], update_type="ROLLBACK",
+            cleared_transaction=True,
         )
 
     # === prepared statements (reference: Session.preparedStatements) ======

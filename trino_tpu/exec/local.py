@@ -791,11 +791,52 @@ class LocalExecutor:
             fcols = list(out.batch.columns)
             expr = lower_string_calls(expr, fcols)
             mask = ExprCompiler(fcols).predicate_mask(expr)
+            mask_np = np.asarray(mask)
             if node.join_type == "LEFT":
-                # filter applies to matched rows only; outer rows survive
-                mask = mask | jnp.asarray(is_outer)
+                # ON-clause filter applies to MATCHES, not probe rows: a
+                # probe row whose matches all fail must still appear once,
+                # null-extended (the kernel emitted outer padding only for
+                # rows with zero raw matches)
+                sel_np = mask_np & osel_np
+                keep = sel_np | (osel_np & is_outer)
+                probe_n = left.batch.capacity
+                raw_match = np.zeros(probe_n, dtype=bool)
+                raw_match[ppos_np[osel_np & ~is_outer]] = True
+                surviving = np.zeros(probe_n, dtype=bool)
+                surviving[ppos_np[sel_np & ~is_outer]] = True
+                need_outer = np.nonzero(raw_match & ~surviving)[0]
+                if need_outer.size:
+                    n_left = len(node.left.output_symbols)
+                    cols2 = []
+                    for j, c in enumerate(out.batch.columns):
+                        data, valid = c.to_numpy()
+                        if j < n_left:  # probe columns: gather the rows
+                            lc = left.column(node.left.output_symbols[j])
+                            ld, lv = lc.to_numpy()
+                            add, addv = ld[need_outer], lv[need_outer]
+                        else:  # build columns: null-extended
+                            add = np.zeros(need_outer.size, dtype=data.dtype)
+                            addv = np.zeros(need_outer.size, dtype=bool)
+                        cols2.append(
+                            Column(
+                                c.type,
+                                np.concatenate([data, add]),
+                                np.concatenate([valid, addv]),
+                                c.dictionary,
+                            )
+                        )
+                    keep = np.concatenate(
+                        [keep, np.ones(need_outer.size, dtype=bool)]
+                    )
+                    return Result(
+                        Batch(cols2, out.batch.num_rows + need_outer.size, keep),
+                        out.layout,
+                    )
+                return Result(
+                    Batch(out.batch.columns, out.batch.num_rows, keep), out.layout
+                )
             out = Result(
-                Batch(out.batch.columns, out.batch.num_rows, np.asarray(mask) & osel_np),
+                Batch(out.batch.columns, out.batch.num_rows, mask_np & osel_np),
                 out.layout,
             )
         return out

@@ -371,6 +371,10 @@ class DistributedExecutor(LocalExecutor):
     def _exec_join(self, node: P.Join) -> Result:
         if node.join_type in ("CROSS", "SEMI", "ANTI", "RIGHT"):
             return super()._exec_join(node)
+        if node.join_type == "LEFT" and node.filter is not None:
+            # ON-clause filters on outer joins need the null-extension
+            # repair implemented in the local join path
+            return super()._exec_join(node)
         right = self._exec(node.right)  # build first: enables dynamic filter
         left = self._exec(self._apply_dynamic_filters(node, right))
         if not (_is_sharded(left.batch) or _is_sharded(right.batch)):

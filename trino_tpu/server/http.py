@@ -177,6 +177,10 @@ class TrinoTpuServer:
             out["_addedPrepare"] = res.added_prepare
         if res.deallocated_prepare is not None:
             out["_deallocatedPrepare"] = res.deallocated_prepare
+        if res.started_transaction_id:
+            out["_startedTransaction"] = res.started_transaction_id
+        if res.cleared_transaction:
+            out["_clearedTransaction"] = True
         return out
 
 
@@ -229,6 +233,9 @@ def _make_handler(server: TrinoTpuServer):
                     continue
                 k, v = part.split("=", 1)
                 s.set(k.strip(), _decode_session_value(urllib.parse.unquote(v.strip())))
+            txn = h.get(f"{PROTOCOL_HEADER}-Transaction-Id", "")
+            if txn and txn.upper() != "NONE":
+                s.properties["__txn"] = txn
             # prepared statements ride headers (the protocol is stateless):
             # X-Trino-Prepared-Statement: name=<urlencoded sql>[,name=...]
             raw = h.get(f"{PROTOCOL_HEADER}-Prepared-Statement", "")
@@ -335,6 +342,11 @@ def _make_handler(server: TrinoTpuServer):
                 dealloc = out.pop("_deallocatedPrepare", None)
                 if dealloc:
                     headers[f"{PROTOCOL_HEADER}-Deallocated-Prepare"] = dealloc
+                started = out.pop("_startedTransaction", None)
+                if started:
+                    headers[f"{PROTOCOL_HEADER}-Started-Transaction-Id"] = started
+                if out.pop("_clearedTransaction", None):
+                    headers[f"{PROTOCOL_HEADER}-Clear-Transaction-Id"] = "true"
                 return self._send_json(out, headers=headers)
             return self._error(404, f"unknown path: {path}")
 

@@ -45,6 +45,7 @@ KEYWORDS = {
     "FETCH", "NEXT", "ONLY", "GROUPING", "SETS", "ROLLUP", "CUBE", "IF",
     "SESSION", "TABLES", "SCHEMAS", "CATALOGS", "COLUMNS", "FILTER",
     "PREPARE", "EXECUTE", "DEALLOCATE", "ANY", "SOME", "POSITION",
+    "START", "TRANSACTION", "COMMIT", "ROLLBACK",
 }
 
 _MULTI_OPS = ("<>", "<=", ">=", "!=", "||")

@@ -182,6 +182,16 @@ class Parser:
                     ps.append(self.expression())
                 params = tuple(ps)
             return t.Execute(name, params)
+        if self.at_kw("START"):
+            self.next()
+            self.expect_kw("TRANSACTION")
+            return t.StartTransaction()
+        if self.at_kw("COMMIT"):
+            self.next()
+            return t.Commit()
+        if self.at_kw("ROLLBACK"):
+            self.next()
+            return t.Rollback()
         if self.at_kw("DEALLOCATE"):
             self.next()
             self.expect_kw("PREPARE")
@@ -872,7 +882,7 @@ _NONRESERVED = {
     "COLUMNS", "SESSION", "ANALYZE", "OVER", "PARTITION", "RANGE", "ROWS",
     "ROW", "FIRST", "LAST", "NEXT", "ONLY", "VALUES", "SETS", "OFFSET",
     "SUBSTRING", "CURRENT", "GROUPING", "POSITION", "PREPARE",
-    "EXECUTE", "DEALLOCATE",
+    "EXECUTE", "DEALLOCATE", "START", "TRANSACTION", "COMMIT", "ROLLBACK",
 }
 
 _NILADIC = {"current_date", "current_timestamp", "localtimestamp", "now"}

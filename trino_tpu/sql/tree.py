@@ -338,6 +338,21 @@ class Parameter(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class StartTransaction(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Commit(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollback(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class DropTable(Node):
     name: tuple[str, ...] = ()
     if_exists: bool = False

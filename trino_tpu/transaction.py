@@ -1,0 +1,108 @@
+"""Transaction management.
+
+Reference: ``core/trino-main/.../transaction/InMemoryTransactionManager.java``
+— per-connector ``ConnectorTransactionHandle``s coordinated by a
+transaction id; autocommit wraps single statements; explicit transactions
+span statements and abort on access conflicts.
+
+v1 scope matches the engine's connector surface: the memory connector is
+the only writable store, so commit/rollback snapshot-and-restore its
+table data; read-only connectors participate trivially (their handle is a
+marker). Isolation is snapshot-at-begin for writes (READ COMMITTED-ish,
+single-writer — the reference's default is also READ UNCOMMITTED-adjacent
+per connector capability)."""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Any, Optional
+
+_txn_counter = itertools.count(1)
+
+
+class TransactionError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class TransactionInfo:
+    transaction_id: str
+    create_time: float
+    autocommit: bool
+    snapshots: dict[str, Any] = dataclasses.field(default_factory=dict)
+    state: str = "ACTIVE"  # ACTIVE | COMMITTED | ABORTED
+
+
+class TransactionManager:
+    """Registry + 2-phase-ish commit over snapshot-capable connectors."""
+
+    def __init__(self, catalogs):
+        self.catalogs = catalogs
+        self._lock = threading.Lock()
+        self._transactions: dict[str, TransactionInfo] = {}
+        # single-writer enforcement: an explicit transaction holds this for
+        # its whole lifetime; autocommit writes take it per statement. This
+        # is what makes snapshot-at-begin rollback sound — no concurrent
+        # committed write can be erased because none can start.
+        # (threading.Lock may be released from a different thread than the
+        # acquirer — required: HTTP requests hop threads.)
+        self.write_lock = threading.Lock()
+
+    def begin(self, autocommit: bool = False) -> str:
+        if not self.write_lock.acquire(timeout=60):
+            raise TransactionError("timed out waiting for the write lock")
+        txn = TransactionInfo(
+            f"txn_{next(_txn_counter)}", time.time(), autocommit
+        )
+        with self._lock:
+            self._transactions[txn.transaction_id] = txn
+        # snapshot writable connectors (memory): rollback restores
+        for name in self.catalogs.names():
+            conn = self.catalogs.get(name)
+            snap = getattr(conn, "snapshot_state", None)
+            if snap is not None:
+                txn.snapshots[name] = snap()
+        return txn.transaction_id
+
+    def get(self, txn_id: str) -> TransactionInfo:
+        with self._lock:
+            txn = self._transactions.get(txn_id)
+        if txn is None:
+            raise TransactionError(f"unknown transaction: {txn_id}")
+        return txn
+
+    def commit(self, txn_id: str) -> None:
+        txn = self.get(txn_id)
+        if txn.state != "ACTIVE":
+            raise TransactionError(f"transaction {txn_id} is {txn.state}")
+        txn.state = "COMMITTED"
+        txn.snapshots.clear()
+        self._finish(txn_id)
+
+    def rollback(self, txn_id: str) -> None:
+        txn = self.get(txn_id)
+        if txn.state != "ACTIVE":
+            raise TransactionError(f"transaction {txn_id} is {txn.state}")
+        for name, snap in txn.snapshots.items():
+            conn = self.catalogs.get(name)
+            restore = getattr(conn, "restore_state", None)
+            if restore is not None:
+                restore(snap)
+        txn.state = "ABORTED"
+        txn.snapshots.clear()
+        self._finish(txn_id)
+
+    def _finish(self, txn_id: str) -> None:
+        with self._lock:
+            self._transactions.pop(txn_id, None)  # no unbounded history
+        try:
+            self.write_lock.release()
+        except RuntimeError:
+            pass
+
+    def active_transactions(self) -> list[TransactionInfo]:
+        with self._lock:
+            return [t for t in self._transactions.values() if t.state == "ACTIVE"]
