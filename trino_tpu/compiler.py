@@ -424,6 +424,34 @@ class ExprCompiler:
                 _cmp_op(expr.name, ranks[jnp.maximum(col[0], 0)], ranks[jnp.maximum(other[0], 0)]),
                 col[1] & other[1],
             )
+        if dictionary is not None and d2 is not None:
+            # cross-dictionary compare: remap A's codes into B's code space
+            # (equality) or into a merged rank space (ordered) — host-built
+            # tables, one gather each on device
+            if expr.name in ("eq", "ne"):
+                # null codes (-1) gather the -2 sentinel, which never equals
+                # any valid B code or B's -1; validity masks them regardless
+                remap = np.asarray(
+                    [d2.encode(v) for v in dictionary.values] + [-2],
+                    dtype=np.int64,
+                )
+                idx = jnp.where(col[0] >= 0, col[0], len(dictionary.values))
+                a_in_b = jnp.asarray(remap)[idx]
+                res = a_in_b == other[0] if expr.name == "eq" else a_in_b != other[0]
+                return res, col[1] & other[1]
+            # ordered: compare through the merged dictionary's rank space
+            md, remap_b = d2.merged(dictionary)
+            mranks = np.asarray(md.ranks())
+            rb = jnp.asarray(np.append(mranks[: len(d2.values)], 0))
+            ra = jnp.asarray(np.append(mranks[remap_b], 0))
+            return (
+                _cmp_op(
+                    expr.name,
+                    ra[jnp.maximum(col[0], 0)],
+                    rb[jnp.maximum(other[0], 0)],
+                ),
+                col[1] & other[1],
+            )
         raise NotImplementedError("cross-dictionary string comparison (remap first)")
 
     def _string_table(self, expr: Call) -> Pair:
