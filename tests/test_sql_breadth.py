@@ -265,3 +265,92 @@ class TestFunctions:
             [(5,), (6,), (7,)],
             ordered=True,
         )
+
+
+class TestWindowBreadth:
+    def test_percent_rank_cume_dist(self, runner):
+        rows, _ = runner.execute(
+            "select n_nationkey, percent_rank() over (order by n_nationkey), "
+            "cume_dist() over (order by n_nationkey) "
+            "from tpch.tiny.nation order by 1 limit 2"
+        )
+        assert rows[0][1] == 0.0 and abs(rows[0][2] - 1 / 25) < 1e-12
+        assert abs(rows[1][1] - 1 / 24) < 1e-12
+
+    def test_nth_value(self, runner):
+        rows, _ = runner.execute(
+            "select nth_value(n_name, 2) over (order by n_nationkey) "
+            "from tpch.tiny.nation order by 1 nulls first limit 3"
+        )
+        assert rows[0][0] is None  # first row: frame has 1 row
+        assert rows[1][0] == rows[2][0] == "ARGENTINA"
+
+    def test_rows_preceding_frames(self, runner):
+        rows, _ = runner.execute(
+            "select sum(n_nationkey) over (order by n_nationkey "
+            "rows between 2 preceding and current row), "
+            "min(n_nationkey) over (order by n_nationkey "
+            "rows between 1 preceding and current row), "
+            "count(*) over (order by n_nationkey "
+            "rows between 3 preceding and current row) "
+            "from tpch.tiny.nation order by 1 limit 4"
+        )
+        assert [r[0] for r in rows] == [0, 1, 3, 6]
+        assert [r[1] for r in rows] == [0, 0, 1, 2]
+        assert [r[2] for r in rows] == [1, 2, 3, 4]
+
+    def test_frame_respects_partitions(self, runner):
+        rows, _ = runner.execute(
+            "select n_regionkey, n_nationkey, "
+            "sum(n_nationkey) over (partition by n_regionkey order by n_nationkey "
+            "rows between 1 preceding and current row) s "
+            "from tpch.tiny.nation order by n_regionkey, n_nationkey"
+        )
+        # first row of each partition must equal its own key (no leakage)
+        seen = set()
+        for rk, nk, s in rows:
+            if rk not in seen:
+                assert s == nk, (rk, nk, s)
+                seen.add(rk)
+
+
+class TestDatetimeFunctions:
+    def test_date_add_diff(self, runner):
+        runner.assert_query(
+            "select date_add('day', 10, date '1995-01-01'), "
+            "date_add('month', 2, date '1995-01-31'), "
+            "date_diff('day', date '1995-01-01', date '1995-03-01'), "
+            "date_diff('month', date '1995-01-15', date '1996-03-01'), "
+            "date_diff('year', date '1990-06-01', date '1995-01-01')",
+            [("1995-01-11", "1995-03-31", 59, 13, 4)],
+        )
+
+    def test_date_fields(self, runner):
+        runner.assert_query(
+            "select day_of_week(date '1995-01-01'), day_of_year(date '1995-02-01'), "
+            "week(date '1995-06-15'), quarter(date '1995-06-15'), "
+            "last_day_of_month(date '1996-02-10')",
+            [(7, 32, 24, 2, "1996-02-29")],
+        )
+
+    def test_iso_week_edges(self, runner):
+        # 1995-01-01 was a Sunday -> ISO week 52 of 1994
+        runner.assert_query(
+            "select week(date '1995-01-01'), week(date '1995-01-02')",
+            [(52, 1)],
+        )
+
+    def test_extract_extended(self, runner):
+        runner.assert_query(
+            "select extract(dow from date '1995-01-02'), "
+            "extract(quarter from date '1995-12-01'), "
+            "extract(doy from date '1995-01-10')",
+            [(1, 4, 10)],
+        )
+
+    def test_string_extras(self, runner):
+        runner.assert_query(
+            "select concat_ws('-', 'a', 'b', 'c'), repeat('ab', 3), "
+            "regexp_replace('a1b2', '[0-9]', ''), regexp_extract('foo123', '[0-9]+')",
+            [("a-b-c", "ababab", "ab", "123")],
+        )

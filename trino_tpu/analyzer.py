@@ -836,8 +836,10 @@ class Analyzer:
         return None
 
     # ==== window functions ==============================================
-    _RANKING_WINDOW = ("row_number", "rank", "dense_rank", "ntile")
-    _VALUE_WINDOW = ("lead", "lag", "first_value", "last_value")
+    _RANKING_WINDOW = (
+        "row_number", "rank", "dense_rank", "ntile", "percent_rank", "cume_dist"
+    )
+    _VALUE_WINDOW = ("lead", "lag", "first_value", "last_value", "nth_value")
     _AGG_WINDOW = ("sum", "count", "avg", "min", "max")
 
     def _plan_windows(self, node: P.PlanNode, window_calls, rewrite_fn):
@@ -878,7 +880,15 @@ class Analyzer:
                     ("UNBOUNDED PRECEDING", "CURRENT ROW"),
                     ("UNBOUNDED PRECEDING", "UNBOUNDED FOLLOWING"),
                 )
-                if not ok:
+                bounded = (
+                    ftype == "ROWS"
+                    and fend == "CURRENT ROW"
+                    and fstart.endswith(" PRECEDING")
+                    and fstart.split()[0].isdigit()
+                )
+                if bounded and int(fstart.split()[0]) > 256:
+                    raise SemanticError("ROWS frame wider than 256 unsupported")
+                if not ok and not bounded:
                     raise SemanticError(f"unsupported window frame: {frame}")
             functions: list[tuple[P.Symbol, P.WindowFunction]] = []
             for fc in fcs:
@@ -889,7 +899,9 @@ class Analyzer:
                 offset = 1
                 default = None
                 if kind in self._RANKING_WINDOW:
-                    result_type: T.SqlType = T.BIGINT
+                    result_type: T.SqlType = (
+                        T.DOUBLE if kind in ("percent_rank", "cume_dist") else T.BIGINT
+                    )
                     if kind == "ntile":
                         if len(fc.args) != 1:
                             raise SemanticError("ntile takes one argument")
@@ -907,6 +919,13 @@ class Analyzer:
                     arg = _fold(rewrite_fn(fc.args[0]))
                     result_type = arg.type
                     arg_expr = variable(proj(arg).name, arg.type)
+                    if kind == "nth_value":
+                        if len(fc.args) != 2:
+                            raise SemanticError("nth_value takes two arguments")
+                        k = _fold(rewrite_fn(fc.args[1]))
+                        if not isinstance(k, Constant) or not k.value or int(k.value) < 1:
+                            raise SemanticError("nth_value offset must be a positive constant")
+                        offset = int(k.value)
                     if kind in ("lead", "lag"):
                         if len(fc.args) >= 2:
                             off = _fold(rewrite_fn(fc.args[1]))
@@ -1452,9 +1471,13 @@ class Analyzer:
             return call("cast", target, operand)
         if isinstance(e, t.Extract):
             operand = rw(e.operand)
-            if e.field not in ("year", "month", "day"):
+            field = {"dow": "day_of_week", "doy": "day_of_year",
+                     "day_of_week": "day_of_week", "day_of_year": "day_of_year",
+                     "week": "week", "quarter": "quarter"}.get(e.field, e.field)
+            if field not in ("year", "month", "day", "day_of_week",
+                             "day_of_year", "week", "quarter"):
                 raise SemanticError(f"EXTRACT({e.field}) unsupported")
-            return call(e.field, T.BIGINT, operand)
+            return call(field, T.BIGINT, operand)
         if isinstance(e, t.Case):
             return self._case(e, rw)
         if isinstance(e, t.FunctionCall):
@@ -1578,6 +1601,139 @@ class Analyzer:
             return call("starts_with", T.BOOLEAN, *args)
         if name == "date":
             return call("cast", T.DATE, args[0])
+        if name == "date_add":
+            unit_c, n_e, d_e = args
+            if not isinstance(unit_c, Constant):
+                raise SemanticError("date_add unit must be a literal")
+            unit = str(unit_c.value).lower().rstrip("s")
+            if isinstance(d_e.type, T.TimestampType):
+                us = {"second": 10**6, "minute": 60 * 10**6, "hour": 3600 * 10**6,
+                      "day": 86_400 * 10**6, "week": 7 * 86_400 * 10**6}
+                if unit in us:
+                    return call(
+                        "add", T.TIMESTAMP, d_e,
+                        call("multiply", T.BIGINT, _coerce_to(n_e, T.BIGINT),
+                             const(us[unit], T.BIGINT)),
+                    )
+                raise SemanticError(f"date_add unit {unit} on timestamp unsupported")
+            if unit == "day":
+                return call("date_add_days", T.DATE, d_e, n_e)
+            if unit == "week":
+                return call(
+                    "date_add_days", T.DATE, d_e,
+                    call("multiply", T.BIGINT, _coerce_to(n_e, T.BIGINT), const(7, T.BIGINT)),
+                )
+            if unit == "month":
+                return call("date_add_months", T.DATE, d_e, n_e)
+            if unit == "quarter":
+                return call(
+                    "date_add_months", T.DATE, d_e,
+                    call("multiply", T.BIGINT, _coerce_to(n_e, T.BIGINT), const(3, T.BIGINT)),
+                )
+            if unit == "year":
+                return call(
+                    "date_add_months", T.DATE, d_e,
+                    call("multiply", T.BIGINT, _coerce_to(n_e, T.BIGINT), const(12, T.BIGINT)),
+                )
+            raise SemanticError(f"date_add unit {unit} unsupported")
+        if name == "date_diff":
+            unit_c, a_e, b_e = args
+            if not isinstance(unit_c, Constant):
+                raise SemanticError("date_diff unit must be a literal")
+            unit = str(unit_c.value).lower().rstrip("s")
+            if isinstance(a_e.type, T.TimestampType) or isinstance(b_e.type, T.TimestampType):
+                us = {"second": 10**6, "minute": 60 * 10**6, "hour": 3600 * 10**6,
+                      "day": 86_400 * 10**6, "week": 7 * 86_400 * 10**6,
+                      "millisecond": 1000}
+                if unit in us:
+                    diff = call("subtract", T.BIGINT, _coerce_to(b_e, T.TIMESTAMP), _coerce_to(a_e, T.TIMESTAMP))
+                    return call(
+                        "divide", T.BIGINT, diff, const(us[unit], T.BIGINT)
+                    )
+                raise SemanticError(f"date_diff unit {unit} on timestamp unsupported")
+            day_diff = call("date_diff_days", T.BIGINT, a_e, b_e)
+            if unit == "day":
+                return day_diff
+            if unit == "week":
+                return call("divide", T.BIGINT, day_diff, const(7, T.BIGINT))
+            if unit in ("month", "quarter", "year"):
+                cal = call(
+                    "subtract", T.BIGINT,
+                    call(
+                        "add", T.BIGINT,
+                        call("multiply", T.BIGINT, call("year", T.BIGINT, b_e), const(12, T.BIGINT)),
+                        call("month", T.BIGINT, b_e),
+                    ),
+                    call(
+                        "add", T.BIGINT,
+                        call("multiply", T.BIGINT, call("year", T.BIGINT, a_e), const(12, T.BIGINT)),
+                        call("month", T.BIGINT, a_e),
+                    ),
+                )
+                da = call("day", T.BIGINT, a_e)
+                db = call("day", T.BIGINT, b_e)
+                # truncate toward zero to FULL months elapsed (reference
+                # semantics): forward diffs lose 1 when day(b) < day(a),
+                # backward diffs gain 1 when day(b) > day(a)
+                months = call(
+                    "add", T.BIGINT, cal,
+                    special(
+                        "if", T.BIGINT,
+                        special(
+                            "and", T.BOOLEAN,
+                            call("gt", T.BOOLEAN, cal, const(0, T.BIGINT)),
+                            call("lt", T.BOOLEAN, db, da),
+                        ),
+                        const(-1, T.BIGINT),
+                        special(
+                            "if", T.BIGINT,
+                            special(
+                                "and", T.BOOLEAN,
+                                call("lt", T.BOOLEAN, cal, const(0, T.BIGINT)),
+                                call("gt", T.BOOLEAN, db, da),
+                            ),
+                            const(1, T.BIGINT),
+                            const(0, T.BIGINT),
+                        ),
+                    ),
+                )
+                if unit == "month":
+                    return months
+                if unit == "quarter":
+                    return call("divide", T.BIGINT, months, const(3, T.BIGINT))
+                return call("divide", T.BIGINT, months, const(12, T.BIGINT))
+            raise SemanticError(f"date_diff unit {unit} unsupported")
+        if name in ("day_of_week", "dow", "day_of_year", "doy", "week",
+                    "week_of_year", "quarter", "last_day_of_month"):
+            canon = {"dow": "day_of_week", "doy": "day_of_year",
+                     "week_of_year": "week"}.get(name, name)
+            rt = T.DATE if canon == "last_day_of_month" else T.BIGINT
+            return call(canon, rt, args[0])
+        if name == "from_unixtime":
+            return call(
+                "multiply", T.TIMESTAMP,
+                _coerce_to(args[0], T.BIGINT), const(1_000_000, T.BIGINT),
+            )
+        if name == "concat_ws":
+            sep = args[0]
+            if not isinstance(sep, Constant):
+                raise SemanticError("concat_ws separator must be a literal")
+            # KNOWN DEVIATION: the reference SKIPS NULL arguments; this
+            # desugar NULL-propagates like concat (see README deviations)
+            parts: list[RowExpr] = []
+            for i, a in enumerate(args[1:]):
+                if i:
+                    parts.append(sep)
+                parts.append(a)
+            return call("concat", T.VARCHAR, *parts)
+        if name == "repeat":
+            if isinstance(args[0], Constant) and isinstance(args[1], Constant):
+                v, k = args[0].value, args[1].value
+                return Constant(
+                    type=T.VARCHAR,
+                    value=None if v is None or k is None else str(v) * int(k),
+                )
+            return call("repeat", T.VARCHAR, *args)
         if name in _MATH_DOUBLE_FNS:
             return call(name, T.DOUBLE, _coerce_to(args[0], T.DOUBLE))
         if name == "log":

@@ -262,7 +262,55 @@ class ExprCompiler:
             return self._string_table(expr)
         if name == "date_trunc":
             return self._date_trunc(expr)
+        if name == "date_diff_days":
+            a, av = self._eval(expr.args[0])
+            b, bv = self._eval(expr.args[1])
+            return (
+                b.astype(jnp.int64) - a.astype(jnp.int64),
+                av & bv,
+            )
+        if name in ("day_of_week", "day_of_year", "week", "quarter",
+                    "last_day_of_month"):
+            return self._date_field(expr)
         raise NotImplementedError(f"scalar function {name}")
+
+    def _date_field(self, expr: Call) -> Pair:
+        d, v = self._eval(expr.args[0])
+        st = expr.args[0].type
+        if isinstance(st, T.TimestampType):
+            days = (d // 86_400_000_000).astype(jnp.int32)
+        else:
+            days = d.astype(jnp.int32)
+        name = expr.name
+        if name == "day_of_week":
+            # ISO: Monday=1..Sunday=7 (1970-01-01 was a Thursday)
+            return ((days + 3) % 7 + 1).astype(jnp.int64), v
+        y, m, dd = _civil_from_days(days)
+        if name == "quarter":
+            return ((m - 1) // 3 + 1).astype(jnp.int64), v
+        if name == "last_day_of_month":
+            return _days_from_civil_vec(y, m, _days_in_month_vec(y, m)).astype(
+                jnp.int32
+            ), v
+        jan1 = _days_from_civil_vec(y, jnp.ones_like(m), jnp.ones_like(dd))
+        doy = (days - jan1 + 1).astype(jnp.int64)
+        if name == "day_of_year":
+            return doy, v
+        # ISO 8601 week number
+        dow = (days + 3) % 7 + 1  # Monday=1
+        w = (doy - dow + 10) // 7
+        # w == 0: belongs to the previous year's last week (52 or 53)
+        prev_y = y - 1
+        prev_len = jnp.where(_is_leap(prev_y), 366, 365)
+        prev_jan1_dow = ((jan1 - prev_len) + 3) % 7 + 1
+        prev_has53 = (prev_jan1_dow == 4) | (_is_leap(prev_y) & (prev_jan1_dow == 3))
+        w0 = jnp.where(prev_has53, 53, 52)
+        # w == 53: only valid when this year has 53 ISO weeks
+        jan1_dow = (jan1 + 3) % 7 + 1
+        has53 = (jan1_dow == 4) | (_is_leap(y) & (jan1_dow == 3))
+        w = jnp.where(w == 0, w0, w)
+        w = jnp.where((w == 53) & ~has53, 1, w)
+        return w.astype(jnp.int64), v
 
     def _date_trunc(self, expr: Call) -> Pair:
         unit_e = expr.args[0]
@@ -656,6 +704,10 @@ def _civil_from_days(days: jnp.ndarray):
     m = mp + jnp.where(mp < 10, 3, -9)
     y = y + (m <= 2)
     return y, m, d
+
+
+def _is_leap(y: jnp.ndarray) -> jnp.ndarray:
+    return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
 
 
 def _days_in_month_vec(y: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:

@@ -487,6 +487,7 @@ class LocalExecutor:
             order_ranks.append(c.dictionary.ranks() if c.dictionary else None)
 
         # frame selection (SQL defaults; ranking fns ignore it)
+        preceding = 0
         if not node.order_by:
             kframe = "partition"
         elif node.frame is None:
@@ -495,6 +496,9 @@ class LocalExecutor:
             ftype, fstart, fend = node.frame
             if fend == "UNBOUNDED FOLLOWING":
                 kframe = "partition"
+            elif ftype == "ROWS" and fstart.endswith(" PRECEDING") and fstart.split()[0].isdigit():
+                kframe = "rows_preceding"
+                preceding = int(fstart.split()[0])
             elif ftype == "ROWS":
                 kframe = "running_rows"
             else:
@@ -556,7 +560,7 @@ class LocalExecutor:
 
         results = compute_windows(
             part_pairs, part_ranks, order_pairs, order_specs, order_ranks,
-            sel, fns, args, defaults, WindowSpecKernel(kframe),
+            sel, fns, args, defaults, WindowSpecKernel(kframe, preceding),
         )
 
         cols = list(b.columns)

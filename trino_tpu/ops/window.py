@@ -43,7 +43,9 @@ class WindowSpecKernel:
     # "running_rows": same in ROWS mode (exactly the rows up to current)
     # "partition": whole partition (the default when there is no ORDER BY,
     #   or an explicit UNBOUNDED PRECEDING..UNBOUNDED FOLLOWING frame)
+    # "rows_preceding": ROWS BETWEEN k PRECEDING AND CURRENT ROW
     frame: str = "running_range"
+    preceding: int = 0  # k for rows_preceding
 
 
 @dataclasses.dataclass(frozen=True)
@@ -158,6 +160,37 @@ def compute_windows(
                 (peer_first - seg_first + 1).astype(jnp.int64),
                 jnp.ones(n, dtype=jnp.bool_),
             )
+        elif fn.kind == "percent_rank":
+            peer_first = _running_max_idx(peer_start, n)
+            rank = (peer_first - seg_first + 1).astype(jnp.float64)
+            sizes = get_seg_sizes().astype(jnp.float64)
+            out = (
+                jnp.where(sizes > 1, (rank - 1) / jnp.maximum(sizes - 1, 1), 0.0),
+                jnp.ones(n, dtype=jnp.bool_),
+            )
+        elif fn.kind == "cume_dist":
+            pl = get_peer_last()
+            sizes = get_seg_sizes().astype(jnp.float64)
+            covered = (pl - seg_first + 1).astype(jnp.float64)
+            out = (covered / jnp.maximum(sizes, 1), jnp.ones(n, dtype=jnp.bool_))
+        elif fn.kind == "nth_value":
+            data, valid = arg
+            sd, sv = data[perm], valid[perm]
+            if frame.frame == "rows_preceding":
+                start = jnp.maximum(seg_first, idx - frame.preceding)
+            else:
+                start = seg_first
+            pos = start + fn.offset - 1
+            if frame.frame == "partition":
+                seg_last = jnp.minimum(_next_flag_idx(seg_start, n) - 1, n - 1)
+                end = seg_last
+            elif frame.frame in ("running_rows", "rows_preceding"):
+                end = idx
+            else:  # running_range: frame extends through the peer group
+                end = get_peer_last()
+            visible = pos <= end
+            posc = jnp.clip(pos, 0, n - 1)
+            out = (sd[posc], sv[posc] & visible)
         elif fn.kind == "dense_rank":
             c = jnp.cumsum(peer_start.astype(jnp.int64))
             c_at_seg = jax.lax.associative_scan(
@@ -188,15 +221,19 @@ def compute_windows(
         elif fn.kind == "first_value":
             data, valid = arg
             sd, sv = data[perm], valid[perm]
-            out = (sd[seg_first], sv[seg_first])
+            if frame.frame == "rows_preceding":
+                start = jnp.maximum(seg_first, idx - frame.preceding)
+                out = (sd[start], sv[start])
+            else:
+                out = (sd[seg_first], sv[seg_first])
         elif fn.kind == "last_value":
             data, valid = arg
             sd, sv = data[perm], valid[perm]
             if frame.frame == "partition":
                 seg_last = jnp.minimum(_next_flag_idx(seg_start, n) - 1, n - 1)
                 out = (sd[seg_last], sv[seg_last])
-            elif frame.frame == "running_rows":
-                out = (sd, sv)
+            elif frame.frame in ("running_rows", "rows_preceding"):
+                out = (sd, sv)  # frame ends at the current row
             else:
                 pl = get_peer_last()
                 out = (sd[pl], sv[pl])
@@ -258,6 +295,10 @@ def compute_windows(
             elif frame.frame == "running_range":
                 pl = get_peer_last()
                 out_d, out_v = out_d[pl], out_v[pl]
+            elif frame.frame == "rows_preceding":
+                out_d, out_v = _rows_preceding_agg(
+                    fn, arg, perm, s_sel, seg_first, idx, frame.preceding, n
+                )
             out = (out_d, out_v)
 
         # scatter back to original positions
@@ -269,3 +310,65 @@ def compute_windows(
             )
         )
     return results
+
+
+def _rows_preceding_agg(fn, arg, perm, s_sel, seg_first, idx, k, n):
+    """ROWS BETWEEN k PRECEDING AND CURRENT ROW for sum/avg/count/min/max:
+    the k+1-row window clipped at the partition start."""
+    if fn.kind == "count_star":
+        sv = s_sel
+        sd = jnp.ones(n, dtype=jnp.int64)
+    else:
+        data, valid = arg
+        sd = data[perm]
+        sv = valid[perm] & s_sel
+    if fn.kind in ("min", "max"):
+        is_min = fn.kind == "min"
+        if jnp.issubdtype(sd.dtype, jnp.floating):
+            ident = jnp.asarray(
+                jnp.finfo(sd.dtype).max if is_min else -jnp.finfo(sd.dtype).max,
+                dtype=sd.dtype,
+            )
+        else:
+            ident = jnp.asarray(
+                jnp.iinfo(sd.dtype).max if is_min else jnp.iinfo(sd.dtype).min,
+                dtype=sd.dtype,
+            )
+        acc = jnp.where(sv, sd, ident)
+        cnt = sv.astype(jnp.int64)
+        op = jnp.minimum if is_min else jnp.maximum
+        for s in range(1, k + 1):
+            j = idx - s
+            ok = j >= seg_first
+            jc = jnp.maximum(j, 0)
+            acc = op(acc, jnp.where(ok & sv[jc], sd[jc], ident))
+            cnt = cnt + jnp.where(ok, sv[jc].astype(jnp.int64), 0)
+        return acc, cnt > 0
+    # additive kinds via running-sum differences
+    acc_dtype = sd.dtype if jnp.issubdtype(sd.dtype, jnp.floating) else jnp.int64
+    vals = jnp.where(sv, sd, 0).astype(acc_dtype)
+    rs = _segmented_scan(vals, _seg_start_from_first(seg_first, idx), jnp.add)
+    rc = _segmented_scan(
+        sv.astype(jnp.int64), _seg_start_from_first(seg_first, idx), jnp.add
+    )
+    j = idx - (k + 1)
+    ok = j >= seg_first
+    jc = jnp.maximum(j, 0)
+    wsum = rs - jnp.where(ok, rs[jc], 0)
+    wcnt = rc - jnp.where(ok, rc[jc], 0)
+    if fn.kind in ("count", "count_star"):
+        return wcnt if fn.kind == "count" else wsum, jnp.ones(n, dtype=jnp.bool_)
+    if fn.kind == "sum":
+        return wsum, wcnt > 0
+    # avg
+    safe = jnp.maximum(wcnt, 1)
+    if jnp.issubdtype(sd.dtype, jnp.floating):
+        return wsum / safe, wcnt > 0
+    out = jnp.where(
+        wsum >= 0, (wsum + safe // 2) // safe, -((-wsum + safe // 2) // safe)
+    )
+    return out, wcnt > 0
+
+
+def _seg_start_from_first(seg_first, idx):
+    return idx == seg_first

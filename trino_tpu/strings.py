@@ -66,6 +66,10 @@ def _split_part(s: str, delim: str, index: int) -> str:
     return parts[index - 1] if 1 <= index <= len(parts) else ""
 
 
+def _repeat(s: str, k: int) -> str:
+    return s * int(k)
+
+
 def _unary_fn(name: str) -> Callable[[str], str]:
     return {
         "upper": str.upper,
@@ -79,7 +83,8 @@ def _unary_fn(name: str) -> Callable[[str], str]:
 
 STRING_TRANSFORMS = {
     "upper", "lower", "trim", "ltrim", "rtrim", "reverse",
-    "substr", "replace", "lpad", "rpad", "split_part", "concat",
+    "substr", "replace", "lpad", "rpad", "split_part", "concat", "repeat",
+    "regexp_replace", "regexp_extract",
 }
 
 
@@ -231,6 +236,25 @@ def lower_string_calls(expr: RowExpr, columns: list[Column]) -> RowExpr:
             return _rpad(v, int(rest[0]), str(rest[1]) if len(rest) > 1 else " ")
         if name == "split_part":
             return _split_part(v, str(rest[0]), int(rest[1]))
+        if name == "repeat":
+            return _repeat(v, int(rest[0]))
+        if name == "regexp_replace":
+            import re as _re
+
+            repl = str(rest[1]) if len(rest) > 1 else ""
+            # Trino replacement uses $N group refs; Python uses \\N.
+            # Escape literal backslashes, convert $N, leave lone $ literal.
+            py_repl = repl.replace("\\", "\\\\")
+            py_repl = _re.sub(r"\$(\d+)", r"\\\1", py_repl)
+            return _re.sub(str(rest[0]), py_repl, v)
+        if name == "regexp_extract":
+            import re as _re
+
+            m = _re.search(str(rest[0]), v)
+            if m is None:
+                return ""
+            group = int(rest[1]) if len(rest) > 1 else 0
+            return m.group(group) or ""
         raise AssertionError(name)
 
     return walk(expr)
