@@ -160,3 +160,24 @@ class CatalogManager:
 
     def names(self) -> list[str]:
         return sorted(self._catalogs)
+
+
+def batch_column_stats(columns, batch) -> dict:
+    """Per-column (min, max, has_null) for a compacted batch — shared by
+    stats-collecting connectors (the stripe-footer computation)."""
+    import numpy as np
+
+    out: dict[str, tuple] = {}
+    for cs, col in zip(columns, batch.columns):
+        if T.is_string(cs.type) or batch.num_rows == 0:
+            continue
+        data, valid = col.to_numpy()
+        data = data[: batch.num_rows]
+        valid = valid[: batch.num_rows]
+        live = data[valid]
+        has_null = bool((~valid).any())
+        if live.size:
+            out[cs.name] = (live.min().item(), live.max().item(), has_null)
+        else:
+            out[cs.name] = (None, None, has_null)
+    return out

@@ -1,0 +1,174 @@
+"""File connector: durable tables in the native pages format.
+
+Reference tier: the storage-connector family (``plugin/trino-hive`` +
+``lib/trino-orc``/``lib/trino-parquet``) — durable columnar files with
+per-file statistics for split pruning. Our format is the engine's own
+compressed pages wire format (:mod:`trino_tpu.serde`, PagesSerde analog):
+one ``<table>/part-N.ttp`` file per inserted batch plus a JSON schema
+sidecar, with min/max column stats collected at write time (the moral
+equivalent of ORC stripe footers driving
+``TupleDomainOrcPredicate``-style pruning).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch
+from trino_tpu.connectors.api import ColumnSchema, Connector, Split, TableSchema
+from trino_tpu.serde import deserialize_batch, serialize_batch
+
+_SCHEMA_FILE = "_schema.json"
+_STATS_FILE = "_stats.json"
+
+
+class FileConnector(Connector):
+    name = "file"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    # --- layout helpers ---------------------------------------------------
+
+    def _table_dir(self, schema: str, table: str) -> str:
+        return os.path.join(self.root, schema, table)
+
+    def _parts(self, schema: str, table: str) -> list[str]:
+        d = self._table_dir(schema, table)
+        if not os.path.isdir(d):
+            return []
+        return sorted(f for f in os.listdir(d) if f.endswith(".ttp"))
+
+    # --- metadata ---------------------------------------------------------
+
+    def list_schemas(self):
+        if not os.path.isdir(self.root):
+            return ["default"]
+        return sorted(
+            {d for d in os.listdir(self.root)
+             if os.path.isdir(os.path.join(self.root, d))} | {"default"}
+        )
+
+    def list_tables(self, schema):
+        d = os.path.join(self.root, schema)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            t for t in os.listdir(d)
+            if os.path.exists(os.path.join(d, t, _SCHEMA_FILE))
+        )
+
+    def get_table(self, schema, table) -> Optional[TableSchema]:
+        path = os.path.join(self._table_dir(schema, table), _SCHEMA_FILE)
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            spec = json.load(f)
+        return TableSchema(
+            table,
+            tuple(ColumnSchema(c["name"], T.parse_type(c["type"])) for c in spec["columns"]),
+        )
+
+    # --- DDL / write path --------------------------------------------------
+
+    def create_table(self, schema, table, schema_def: TableSchema):
+        d = self._table_dir(schema, table)
+        if os.path.exists(os.path.join(d, _SCHEMA_FILE)):
+            raise ValueError(f"table already exists: {schema}.{table}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, _SCHEMA_FILE), "w") as f:
+            json.dump(
+                {"columns": [{"name": c.name, "type": str(c.type)} for c in schema_def.columns]},
+                f,
+            )
+
+    def insert(self, schema, table, batch: Batch) -> int:
+        ts = self.get_table(schema, table)
+        if ts is None:
+            raise KeyError(f"table not found: {schema}.{table}")
+        d = self._table_dir(schema, table)
+        compacted = batch.compact()
+        part = f"part-{len(self._parts(schema, table)):05d}.ttp"
+        with open(os.path.join(d, part), "wb") as f:
+            f.write(serialize_batch(compacted))
+        # per-file column stats (the ORC stripe-footer analog)
+        from trino_tpu.connectors.api import batch_column_stats
+
+        stats = {
+            name: list(vals)
+            for name, vals in batch_column_stats(ts.columns, compacted).items()
+        }
+        stats_path = os.path.join(d, _STATS_FILE)
+        all_stats = {}
+        if os.path.exists(stats_path):
+            with open(stats_path) as f:
+                all_stats = json.load(f)
+        all_stats[part] = {"rows": compacted.num_rows, "columns": stats}
+        tmp = stats_path + ".tmp"
+        with open(tmp, "w") as f:  # atomic swap: a crash never truncates
+            json.dump(all_stats, f)
+        os.replace(tmp, stats_path)
+        return compacted.num_rows
+
+    def truncate(self, schema, table):
+        d = self._table_dir(schema, table)
+        for p in self._parts(schema, table):
+            os.remove(os.path.join(d, p))
+        sp = os.path.join(d, _STATS_FILE)
+        if os.path.exists(sp):
+            os.remove(sp)
+
+    def drop_table(self, schema, table):
+        import shutil
+
+        d = self._table_dir(schema, table)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+
+    # --- splits + scan -----------------------------------------------------
+
+    def _file_stats(self, schema: str, table: str) -> dict:
+        path = os.path.join(self._table_dir(schema, table), _STATS_FILE)
+        if not os.path.exists(path):
+            return {}
+        with open(path) as f:
+            return json.load(f)
+
+    def estimate_rows(self, schema, table):
+        if self.get_table(schema, table) is None:
+            return None
+        return sum(
+            s.get("rows", 0) for s in self._file_stats(schema, table).values()
+        )
+
+    def get_splits(self, schema, table, target_splits, constraint=None):
+        parts = self._parts(schema, table)
+        splits = [
+            Split(table, i, max(len(parts), 1), info=p)
+            for i, p in enumerate(parts)
+        ]
+        return self.prune_splits(schema, table, splits, constraint)
+
+    def split_stats(self, schema, table, split):
+        entry = self._file_stats(schema, table).get(split.info)
+        if entry is None:
+            return None
+        return {
+            col: (mn, mx, bool(hn))
+            for col, (mn, mx, hn) in entry.get("columns", {}).items()
+        }
+
+    def read_split(self, schema, table, columns: Sequence[str], split) -> Batch:
+        ts = self.get_table(schema, table)
+        d = self._table_dir(schema, table)
+        with open(os.path.join(d, split.info), "rb") as f:
+            batch = deserialize_batch(f.read())
+        name_to_idx = {c.name: i for i, c in enumerate(ts.columns)}
+        cols = [batch.columns[name_to_idx[c]] for c in columns]
+        return Batch(cols, batch.num_rows)

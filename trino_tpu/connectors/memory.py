@@ -85,29 +85,10 @@ class MemoryConnector(Connector):
             return None
         cache = self._stats.setdefault((schema, table), {})
         if split.index not in cache:
-            import numpy as np
-
-            from trino_tpu import types as T
+            from trino_tpu.connectors.api import batch_column_stats
 
             ts = self._tables[(schema, table)]
-            b = parts[split.index]
-            stats = {}
-            for cs, col in zip(ts.columns, b.columns):
-                if T.is_string(cs.type) or b.num_rows == 0:
-                    continue
-                data = np.asarray(col.data)[: b.num_rows]
-                vm = col.valid
-                if vm is not None:
-                    vm = np.asarray(vm)[: b.num_rows]
-                    has_null = bool((~vm).any())
-                    data = data[vm]
-                else:
-                    has_null = False
-                if data.size == 0:
-                    stats[cs.name] = (None, None, has_null)
-                else:
-                    stats[cs.name] = (data.min().item(), data.max().item(), has_null)
-            cache[split.index] = stats
+            cache[split.index] = batch_column_stats(ts.columns, parts[split.index])
         return cache[split.index]
 
     def read_split(self, schema, table, columns: Sequence[str], split):

@@ -354,6 +354,7 @@ class Engine:
         catalog, schema, table = self._qualify(stmt.name, session)
         self.access_control.check_can_insert(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
+        self._check_txn_writable(session, conn, catalog)
         ts = conn.get_table(schema, table)
         if ts is None:
             raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
@@ -426,6 +427,7 @@ class Engine:
         catalog, schema, table = self._qualify(stmt.name, session)
         self.access_control.check_can_insert(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
+        self._check_txn_writable(session, conn, catalog)
         ts = conn.get_table(schema, table)
         if ts is None:
             raise SemanticError(f"table not found: {catalog}.{schema}.{table}")
@@ -460,6 +462,16 @@ class Engine:
             update_type="DELETE", update_count=before - batch.num_rows,
         )
 
+
+
+    def _check_txn_writable(self, session: Session, conn, catalog: str) -> None:
+        """Connectors without snapshot/restore cannot participate in
+        explicit transactions (reference: 'Catalog only supports writes
+        using autocommit')."""
+        if session.properties.get("__txn") and not hasattr(conn, "snapshot_state"):
+            raise SemanticError(
+                f"Catalog '{catalog}' only supports writes using autocommit"
+            )
 
     def _write_guard(self, session: Session):
         """Single-writer enforcement for autocommit writes: inside an
