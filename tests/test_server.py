@@ -192,3 +192,41 @@ class TestCli:
 
         rc = main(["--server", server.base_uri, "--execute", "select bogus_col from tpch.tiny.orders"])
         assert rc == 1
+
+
+class TestWebUi:
+    def test_ui_page_served(self, server):
+        with urllib.request.urlopen(f"{server.base_uri}/ui") as r:
+            body = r.read().decode()
+        assert "cluster overview" in body and "/v1/status" in body
+
+
+class TestVerifier:
+    def test_local_vs_distributed(self, tmp_path):
+        from trino_tpu.verifier import verify
+
+        queries = [
+            "select o_orderpriority, count(*) from tpch.tiny.orders group by 1",
+            "select count(*) from tpch.tiny.nation n join tpch.tiny.region r "
+            "on n.n_regionkey = r.r_regionkey",
+        ]
+        assert verify("local", "distributed", queries) == 0
+
+    def test_mismatch_detected(self):
+        from trino_tpu import verifier
+
+        calls = {"n": 0}
+
+        def fake_runner(spec):
+            def run(sql):
+                calls["n"] += 1
+                return [(1,)] if spec == "local" else [(2,)]
+
+            return run
+
+        orig = verifier._runner_for
+        verifier._runner_for = fake_runner
+        try:
+            assert verifier.verify("local", "distributed", ["select 1"]) == 1
+        finally:
+            verifier._runner_for = orig

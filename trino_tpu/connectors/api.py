@@ -165,8 +165,6 @@ class CatalogManager:
 def batch_column_stats(columns, batch) -> dict:
     """Per-column (min, max, has_null) for a compacted batch — shared by
     stats-collecting connectors (the stripe-footer computation)."""
-    import numpy as np
-
     out: dict[str, tuple] = {}
     for cs, col in zip(columns, batch.columns):
         if T.is_string(cs.type) or batch.num_rows == 0:

@@ -33,6 +33,8 @@ class FileConnector(Connector):
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        # stats cache keyed by (schema, table) -> (mtime, parsed)
+        self._stats_cache: dict[tuple[str, str], tuple[float, dict]] = {}
 
     # --- layout helpers ---------------------------------------------------
 
@@ -123,6 +125,39 @@ class FileConnector(Connector):
         sp = os.path.join(d, _STATS_FILE)
         if os.path.exists(sp):
             os.remove(sp)
+        self._stats_cache.pop((schema, table), None)
+
+    def replace_data(self, schema, table, batch: Batch) -> None:
+        """Atomically replace the table's data (DELETE's keep-set swap):
+        stage a full new table directory, then rename into place — a crash
+        leaves either the old or the new data, never neither."""
+        import shutil
+
+        ts = self.get_table(schema, table)
+        if ts is None:
+            raise KeyError(f"table not found: {schema}.{table}")
+        d = self._table_dir(schema, table)
+        staging = d + ".staging"
+        trash = d + ".trash"
+        for tmp in (staging, trash):
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+        os.makedirs(staging)
+        shutil.copy(os.path.join(d, _SCHEMA_FILE), os.path.join(staging, _SCHEMA_FILE))
+        # write the new part + stats directly into the staging dir by
+        # temporarily pointing this table's directory at it
+        old_dir, real = self._table_dir, (schema, table)
+        try:
+            self._table_dir = lambda s, t: staging if (s, t) == real else old_dir(s, t)  # type: ignore
+            self._stats_cache.pop(real, None)
+            if batch.num_rows:
+                self.insert(schema, table, batch)
+        finally:
+            self._table_dir = old_dir  # type: ignore
+        os.rename(d, trash)
+        os.rename(staging, d)
+        shutil.rmtree(trash)
+        self._stats_cache.pop(real, None)
 
     def drop_table(self, schema, table):
         import shutil
@@ -137,8 +172,14 @@ class FileConnector(Connector):
         path = os.path.join(self._table_dir(schema, table), _STATS_FILE)
         if not os.path.exists(path):
             return {}
+        mtime = os.path.getmtime(path)
+        cached = self._stats_cache.get((schema, table))
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
         with open(path) as f:
-            return json.load(f)
+            parsed = json.load(f)
+        self._stats_cache[(schema, table)] = (mtime, parsed)
+        return parsed
 
     def estimate_rows(self, schema, table):
         if self.get_table(schema, table) is None:

@@ -340,6 +340,7 @@ class Engine:
         catalog, schema, table = self._qualify(stmt.name, session)
         self.access_control.check_can_create(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
+        self._check_txn_writable(session, conn, catalog)
         batch, names = self._run_query_rows(stmt.query, session)
         cols = tuple(
             ColumnSchema(n.lower(), c.type) for n, c in zip(names, batch.columns)
@@ -399,6 +400,7 @@ class Engine:
         catalog, schema, table = self._qualify(stmt.name, session)
         self.access_control.check_can_drop(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
+        self._check_txn_writable(session, conn, catalog)
         if conn.get_table(schema, table) is None and stmt.if_exists:
             return StatementResult([], ["result"], [T.BOOLEAN], update_type="DROP TABLE")
         conn.drop_table(schema, table)
@@ -408,6 +410,7 @@ class Engine:
         catalog, schema, table = self._qualify(stmt.name, session)
         self.access_control.check_can_create(session.user, catalog, schema, table)
         conn = self.catalogs.get(catalog)
+        self._check_txn_writable(session, conn, catalog)
         if conn.get_table(schema, table) is not None:
             if stmt.not_exists:
                 return StatementResult(
@@ -454,9 +457,14 @@ class Engine:
             )
         )
         batch, _names = self._run_query_rows(keep_query, session)
-        conn.truncate(schema, table)
-        if batch.num_rows:
-            conn.insert(schema, table, batch)
+        if hasattr(conn, "replace_data"):
+            # durable stores swap data atomically: truncate-then-insert
+            # would lose kept rows on a crash between the two steps
+            conn.replace_data(schema, table, batch)
+        else:
+            conn.truncate(schema, table)
+            if batch.num_rows:
+                conn.insert(schema, table, batch)
         return StatementResult(
             [], ["rows"], [T.BIGINT],
             update_type="DELETE", update_count=before - batch.num_rows,

@@ -294,6 +294,16 @@ def _make_handler(server: TrinoTpuServer):
                         "queries": len(server.query_manager.queries()),
                     }
                 )
+            if path in ("/ui", "/ui/", "/"):
+                from trino_tpu.server.webui import PAGE
+
+                body = PAGE.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
             if path == "/v1/resourceGroup":
                 return self._send_json(server.resource_groups.info())
             if path == "/v1/query":

@@ -1,0 +1,68 @@
+"""Web UI: cluster overview page.
+
+Reference: the coordinator web UI (``core/trino-main/src/main/resources/webapp/``
+React app + ``server/ui/ClusterStatsResource.java``). A single self-refreshing
+page served at ``/ui`` over the existing JSON endpoints — no build step,
+no external assets.
+"""
+
+PAGE = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>trino-tpu</title>
+<style>
+  body { font-family: ui-monospace, Menlo, monospace; margin: 2rem;
+         background: #16161d; color: #e6e6ef; }
+  h1 { font-size: 1.2rem; } h1 span { color: #7aa2f7; }
+  .tiles { display: flex; gap: 1rem; flex-wrap: wrap; margin: 1rem 0; }
+  .tile { background: #1f1f2b; padding: .8rem 1.2rem; border-radius: 8px; }
+  .tile .v { font-size: 1.6rem; color: #9ece6a; }
+  .tile .l { font-size: .75rem; color: #9aa0b0; }
+  table { border-collapse: collapse; width: 100%; margin-top: 1rem; }
+  th, td { text-align: left; padding: .35rem .6rem; font-size: .8rem;
+           border-bottom: 1px solid #2a2a38; }
+  th { color: #9aa0b0; font-weight: normal; }
+  .FINISHED { color: #9ece6a; } .FAILED { color: #f7768e; }
+  .RUNNING, .QUEUED, .PLANNING { color: #e0af68; }
+  td.q { max-width: 40rem; overflow: hidden; text-overflow: ellipsis;
+         white-space: nowrap; }
+</style>
+</head>
+<body>
+<h1><span>trino-tpu</span> cluster overview</h1>
+<div class="tiles">
+  <div class="tile"><div class="v" id="queries">-</div><div class="l">queries tracked</div></div>
+  <div class="tile"><div class="v" id="running">-</div><div class="l">running</div></div>
+  <div class="tile"><div class="v" id="mem">-</div><div class="l">HBM pool reserved</div></div>
+  <div class="tile"><div class="v" id="state">-</div><div class="l">node state</div></div>
+</div>
+<table id="qtable">
+  <tr><th>query id</th><th>state</th><th>user</th><th>elapsed</th><th>query</th></tr>
+</table>
+<script>
+async function refresh() {
+  const st = await (await fetch('/v1/status')).json();
+  const qs = await (await fetch('/v1/query')).json();
+  document.getElementById('queries').textContent = qs.length;
+  document.getElementById('running').textContent =
+      qs.filter(q => !['FINISHED','FAILED','CANCELED'].includes(q.state)).length;
+  const mb = st.memoryInfo.reservedBytes / (1024 * 1024);
+  document.getElementById('mem').textContent = mb.toFixed(1) + ' MB';
+  document.getElementById('state').textContent = st.state;
+  const esc = s => String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;')
+      .replace(/>/g, '&gt;').replace(/"/g, '&quot;');
+  const stateClass = s => ['FINISHED','FAILED','RUNNING','QUEUED','PLANNING']
+      .includes(s) ? s : '';
+  const rows = qs.sort((a, b) => b.createTime - a.createTime).slice(0, 50).map(q =>
+    `<tr><td>${esc(q.queryId)}</td><td class="${stateClass(q.state)}">${esc(q.state)}</td>` +
+    `<td>${esc(q.user)}</td><td>${esc(q.elapsedTimeMillis)} ms</td>` +
+    `<td class="q">${esc(q.query)}</td></tr>`).join('');
+  document.getElementById('qtable').innerHTML =
+    '<tr><th>query id</th><th>state</th><th>user</th><th>elapsed</th><th>query</th></tr>' + rows;
+}
+refresh(); setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""
