@@ -20,4 +20,13 @@ import jax  # noqa: E402
 # after env vars are read; force the test platform explicitly.
 jax.config.update("jax_platforms", _platform)
 
+# Persistent compile cache: shape-bucketed SQL workloads recompile heavily;
+# caching across runs keeps the suite wall time honest.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+except Exception:
+    pass  # older jax without persistent-cache config
+
 import trino_tpu  # noqa: E402,F401  (enables x64)

@@ -93,3 +93,66 @@ def test_values_with_cast(runner):
 def test_cast_null(runner):
     rows, _ = runner.execute("select cast(null as bigint), cast(null as date)")
     assert rows == [(None, None)]
+
+
+# --- round-2 advisor findings ------------------------------------------------
+
+
+def test_regexp_extract_null_on_no_match(runner):
+    rows, _ = runner.execute(
+        "select regexp_extract(x, 'a(b+)c', 1) is null from "
+        "(values 'abbc', 'zzz') v(x) order by 1"
+    )
+    assert rows == [(False,), (True,)]
+
+
+def test_regexp_extract_no_match_not_empty_string(runner):
+    rows, _ = runner.execute(
+        "select count(*) from (values 'abc', 'xyz') v(x) "
+        "where regexp_extract(x, 'q+') = ''"
+    )
+    assert rows == [(0,)]
+
+
+def test_bogus_transaction_header_rejected():
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from trino_tpu.server.http import TrinoTpuServer
+
+    server = TrinoTpuServer(port=0)
+    server.start()
+    try:
+        port = server.port
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/statement",
+            data=b"select 1",
+            headers={"X-Trino-Transaction-Id": "txn_bogus_999"},
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_idle_transaction_expired():
+    from trino_tpu.engine import Engine
+    from trino_tpu.config import Session
+
+    eng = Engine()
+    eng.transaction_manager.idle_timeout = 0.05
+    s1 = Session(user="a", catalog="memory", schema="default")
+    eng.execute_statement("start transaction", s1)
+    assert eng.transaction_manager.active_transactions()
+    import time
+
+    time.sleep(0.1)
+    # another session's autocommit write must succeed (idle txn rolled back)
+    s2 = Session(user="b", catalog="memory", schema="default")
+    eng.execute_statement("create table t_idle (x bigint)", s2)
+    eng.execute_statement("insert into t_idle values 1", s2)
+    assert not eng.transaction_manager.active_transactions()
+    eng.execute_statement("drop table t_idle", s2)

@@ -27,6 +27,21 @@ _SCHEMA_FILE = "_schema.json"
 _STATS_FILE = "_stats.json"
 
 
+def _retry_fnf(fn, attempts: int = 50, delay: float = 0.01):
+    """Retry around replace_data's brief rename window: a concurrent DELETE
+    swaps the table dir with two renames; readers landing in between see
+    FileNotFoundError transiently, not table loss."""
+    import time
+
+    for i in range(attempts):
+        try:
+            return fn()
+        except FileNotFoundError:
+            if i == attempts - 1:
+                raise
+            time.sleep(delay)
+
+
 class FileConnector(Connector):
     name = "file"
 
@@ -41,11 +56,31 @@ class FileConnector(Connector):
     def _table_dir(self, schema: str, table: str) -> str:
         return os.path.join(self.root, schema, table)
 
-    def _parts(self, schema: str, table: str) -> list[str]:
-        d = self._table_dir(schema, table)
+    def _in_swap_window(self, d: str) -> bool:
+        """True while replace_data is between its two renames (the table dir
+        is transiently absent but its staging/trash twin exists)."""
+        return os.path.isdir(d + ".staging") or os.path.isdir(d + ".trash")
+
+    def _await_swap(self, d: str, attempts: int = 200, delay: float = 0.01) -> None:
+        import time
+
+        for _ in range(attempts):
+            if os.path.isdir(d) or not self._in_swap_window(d):
+                return
+            time.sleep(delay)
+
+    @staticmethod
+    def _parts_in(d: str) -> list[str]:
         if not os.path.isdir(d):
             return []
         return sorted(f for f in os.listdir(d) if f.endswith(".ttp"))
+
+    def _parts(self, schema: str, table: str) -> list[str]:
+        d = self._table_dir(schema, table)
+        if not os.path.isdir(d):
+            # a query planning mid-swap must not silently see an empty table
+            self._await_swap(d)
+        return self._parts_in(d)
 
     # --- metadata ---------------------------------------------------------
 
@@ -67,7 +102,10 @@ class FileConnector(Connector):
         )
 
     def get_table(self, schema, table) -> Optional[TableSchema]:
-        path = os.path.join(self._table_dir(schema, table), _SCHEMA_FILE)
+        d = self._table_dir(schema, table)
+        path = os.path.join(d, _SCHEMA_FILE)
+        if not os.path.exists(path):
+            self._await_swap(d)
         if not os.path.exists(path):
             return None
         with open(path) as f:
@@ -94,9 +132,13 @@ class FileConnector(Connector):
         ts = self.get_table(schema, table)
         if ts is None:
             raise KeyError(f"table not found: {schema}.{table}")
-        d = self._table_dir(schema, table)
+        return self._write_part_into(self._table_dir(schema, table), ts, batch)
+
+    def _write_part_into(self, d: str, ts: TableSchema, batch: Batch) -> int:
+        """Write one part file + stats into an explicit directory (used by
+        both the live-table insert path and replace_data staging)."""
         compacted = batch.compact()
-        part = f"part-{len(self._parts(schema, table)):05d}.ttp"
+        part = f"part-{len(self._parts_in(d)):05d}.ttp"
         with open(os.path.join(d, part), "wb") as f:
             f.write(serialize_batch(compacted))
         # per-file column stats (the ORC stripe-footer analog)
@@ -144,20 +186,12 @@ class FileConnector(Connector):
                 shutil.rmtree(tmp)
         os.makedirs(staging)
         shutil.copy(os.path.join(d, _SCHEMA_FILE), os.path.join(staging, _SCHEMA_FILE))
-        # write the new part + stats directly into the staging dir by
-        # temporarily pointing this table's directory at it
-        old_dir, real = self._table_dir, (schema, table)
-        try:
-            self._table_dir = lambda s, t: staging if (s, t) == real else old_dir(s, t)  # type: ignore
-            self._stats_cache.pop(real, None)
-            if batch.num_rows:
-                self.insert(schema, table, batch)
-        finally:
-            self._table_dir = old_dir  # type: ignore
+        if batch.num_rows:
+            self._write_part_into(staging, ts, batch)
         os.rename(d, trash)
         os.rename(staging, d)
         shutil.rmtree(trash)
-        self._stats_cache.pop(real, None)
+        self._stats_cache.pop((schema, table), None)
 
     def drop_table(self, schema, table):
         import shutil
@@ -208,8 +242,12 @@ class FileConnector(Connector):
     def read_split(self, schema, table, columns: Sequence[str], split) -> Batch:
         ts = self.get_table(schema, table)
         d = self._table_dir(schema, table)
-        with open(os.path.join(d, split.info), "rb") as f:
-            batch = deserialize_batch(f.read())
+
+        def _read() -> bytes:
+            with open(os.path.join(d, split.info), "rb") as f:
+                return f.read()
+
+        batch = deserialize_batch(_retry_fnf(_read))
         name_to_idx = {c.name: i for i, c in enumerate(ts.columns)}
         cols = [batch.columns[name_to_idx[c]] for c in columns]
         return Batch(cols, batch.num_rows)

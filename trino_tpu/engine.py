@@ -139,13 +139,29 @@ class Engine:
         self._recent_queries.append(record)
         error: Optional[str] = None
         res: Optional[StatementResult] = None
+        # Validate + pin the session's explicit transaction for the duration
+        # of this statement: a stale/expired __txn must error (reference
+        # errors on unknown transaction ids), and a live one must not be
+        # idle-expired mid-statement.
+        txn_info = None
         try:
+            txn_id = session.properties.get("__txn")
+            if txn_id:
+                try:
+                    txn_info = self.transaction_manager.get(txn_id)  # touches
+                    txn_info.busy += 1
+                except Exception:
+                    session.properties.pop("__txn", None)
+                    raise
             res = self._execute_statement_inner(sql, session, qid)
             return res
         except Exception as e:  # noqa: BLE001
             error = str(e)
             raise
         finally:
+            if txn_info is not None:
+                txn_info.busy -= 1
+                txn_info.last_access = _time.time()
             end = _time.time()
             record["state"] = "FINISHED" if error is None else "FAILED"
             record["elapsedTimeMillis"] = int((end - t0) * 1000)
@@ -345,8 +361,9 @@ class Engine:
         cols = tuple(
             ColumnSchema(n.lower(), c.type) for n, c in zip(names, batch.columns)
         )
-        conn.create_table(schema, table, TableSchema(table, cols))
-        n = conn.insert(schema, table, batch)
+        with self._write_guard(session):
+            conn.create_table(schema, table, TableSchema(table, cols))
+            n = conn.insert(schema, table, batch)
         return StatementResult(
             [], ["rows"], [T.BIGINT], update_type="CREATE TABLE", update_count=n
         )
@@ -403,7 +420,8 @@ class Engine:
         self._check_txn_writable(session, conn, catalog)
         if conn.get_table(schema, table) is None and stmt.if_exists:
             return StatementResult([], ["result"], [T.BOOLEAN], update_type="DROP TABLE")
-        conn.drop_table(schema, table)
+        with self._write_guard(session):
+            conn.drop_table(schema, table)
         return StatementResult([], ["result"], [T.BOOLEAN], update_type="DROP TABLE")
 
     def _do_createtable(self, stmt: t.CreateTable, session: Session) -> StatementResult:
@@ -420,7 +438,8 @@ class Engine:
         cols = tuple(
             ColumnSchema(n.lower(), T.parse_type(ty)) for n, ty in stmt.columns
         )
-        conn.create_table(schema, table, TableSchema(table, cols))
+        with self._write_guard(session):
+            conn.create_table(schema, table, TableSchema(table, cols))
         return StatementResult([], ["result"], [T.BOOLEAN], update_type="CREATE TABLE")
 
     def _do_delete(self, stmt: t.Delete, session: Session) -> StatementResult:
@@ -489,6 +508,7 @@ class Engine:
 
         if session.properties.get("__txn"):
             return contextlib.nullcontext()
+        self.transaction_manager.expire_idle()
         lock = self.transaction_manager.write_lock
 
         @contextlib.contextmanager

@@ -235,6 +235,10 @@ def _make_handler(server: TrinoTpuServer):
                 s.set(k.strip(), _decode_session_value(urllib.parse.unquote(v.strip())))
             txn = h.get(f"{PROTOCOL_HEADER}-Transaction-Id", "")
             if txn and txn.upper() != "NONE":
+                # Validate against the TransactionManager: a bogus id would
+                # make write paths skip the single-writer lock (reference
+                # errors on unknown transaction ids).
+                server.engine.transaction_manager.get(txn)  # raises if unknown
                 s.properties["__txn"] = txn
             # prepared statements ride headers (the protocol is stateless):
             # X-Trino-Prepared-Statement: name=<urlencoded sql>[,name=...]
@@ -258,7 +262,12 @@ def _make_handler(server: TrinoTpuServer):
                 sql = self.rfile.read(length).decode()
                 if not sql.strip():
                     return self._error(400, "SQL statement is empty")
-                session = self._session_from_headers()
+                from trino_tpu.transaction import TransactionError
+
+                try:
+                    session = self._session_from_headers()
+                except TransactionError as e:
+                    return self._error(400, str(e))
                 q = server.query_manager.create_query(sql, session)
                 return self._send_json(server.query_results(q, "queued", 0))
             return self._error(404, f"unknown path: {path}")

@@ -102,16 +102,22 @@ def _const_args(args) -> list:
     return out
 
 
-def transformed_column(base: Column, new_values: list[str]) -> Column:
+def transformed_column(base: Column, new_values: list[Optional[str]]) -> Column:
     """Column with same rows but transformed dictionary values. Duplicate
     values after transformation (upper('a')==upper('A')) are deduplicated
-    with a device-side code remap so group-by/join-by-code stays correct."""
-    if len(set(new_values)) == len(new_values):
+    with a device-side code remap so group-by/join-by-code stays correct.
+    A ``None`` dictionary value (e.g. regexp_extract no-match) maps its
+    rows to NULL (code -1, valid cleared)."""
+    has_null = any(v is None for v in new_values)
+    if not has_null and len(set(new_values)) == len(new_values):
         return Column(T.VARCHAR, base.data, base.valid, Dictionary(new_values))
     uniq: list[str] = []
     index: dict[str, int] = {}
     remap = np.empty(len(new_values), dtype=np.int32)
     for i, v in enumerate(new_values):
+        if v is None:
+            remap[i] = -1
+            continue
         code = index.get(v)
         if code is None:
             code = len(uniq)
@@ -122,9 +128,10 @@ def transformed_column(base: Column, new_values: list[str]) -> Column:
     codes = jnp.where(base.data >= 0, r[jnp.maximum(base.data, 0)], -1).astype(
         jnp.int32
     )
+    valid = base.valid_mask() & (codes >= 0) if has_null else base.valid
     d = Dictionary(uniq)
     d._index = index
-    return Column(T.VARCHAR, codes, base.valid, d)
+    return Column(T.VARCHAR, codes, valid, d)
 
 
 def lower_string_calls(expr: RowExpr, columns: list[Column]) -> RowExpr:
@@ -250,11 +257,13 @@ def lower_string_calls(expr: RowExpr, columns: list[Column]) -> RowExpr:
         if name == "regexp_extract":
             import re as _re
 
+            # Reference semantics: NULL on no match and for a
+            # non-participating group (not empty string).
             m = _re.search(str(rest[0]), v)
             if m is None:
-                return ""
+                return None
             group = int(rest[1]) if len(rest) > 1 else 0
-            return m.group(group) or ""
+            return m.group(group)
         raise AssertionError(name)
 
     return walk(expr)

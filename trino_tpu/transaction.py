@@ -34,13 +34,19 @@ class TransactionInfo:
     autocommit: bool
     snapshots: dict[str, Any] = dataclasses.field(default_factory=dict)
     state: str = "ACTIVE"  # ACTIVE | COMMITTED | ABORTED
+    last_access: float = 0.0
+    busy: int = 0  # statements currently executing in this transaction
 
 
 class TransactionManager:
     """Registry + 2-phase-ish commit over snapshot-capable connectors."""
 
-    def __init__(self, catalogs):
+    def __init__(self, catalogs, idle_timeout: float = 300.0):
         self.catalogs = catalogs
+        # reference expires idle transactions (transaction.idle-timeout);
+        # without this a client that BEGINs and disconnects holds the
+        # write lock forever
+        self.idle_timeout = idle_timeout
         self._lock = threading.Lock()
         self._transactions: dict[str, TransactionInfo] = {}
         # single-writer enforcement: an explicit transaction holds this for
@@ -52,10 +58,12 @@ class TransactionManager:
         self.write_lock = threading.Lock()
 
     def begin(self, autocommit: bool = False) -> str:
+        self.expire_idle()
         if not self.write_lock.acquire(timeout=60):
             raise TransactionError("timed out waiting for the write lock")
+        now = time.time()
         txn = TransactionInfo(
-            f"txn_{next(_txn_counter)}", time.time(), autocommit
+            f"txn_{next(_txn_counter)}", now, autocommit, last_access=now
         )
         with self._lock:
             self._transactions[txn.transaction_id] = txn
@@ -72,30 +80,52 @@ class TransactionManager:
             txn = self._transactions.get(txn_id)
         if txn is None:
             raise TransactionError(f"unknown transaction: {txn_id}")
+        txn.last_access = time.time()
+        return txn
+
+    def expire_idle(self) -> None:
+        """Roll back ACTIVE transactions idle beyond ``idle_timeout`` so an
+        abandoned BEGIN eventually releases the write lock. Transactions
+        with a statement mid-flight (busy > 0) are never expired."""
+        now = time.time()
+        for t in self.active_transactions():
+            if t.busy == 0 and now - max(t.last_access, t.create_time) > self.idle_timeout:
+                try:
+                    self.rollback(t.transaction_id)
+                except TransactionError:
+                    pass  # raced with a concurrent commit/rollback
+
+    def _transition(self, txn_id: str, new_state: str) -> TransactionInfo:
+        """Atomically move an ACTIVE transaction to a terminal state. Exactly
+        one caller wins (commit vs concurrent expire-rollback race); losers
+        get TransactionError and must NOT touch snapshots or the lock."""
+        with self._lock:
+            txn = self._transactions.get(txn_id)
+            if txn is None:
+                raise TransactionError(f"unknown transaction: {txn_id}")
+            if txn.state != "ACTIVE":
+                raise TransactionError(f"transaction {txn_id} is {txn.state}")
+            txn.state = new_state
         return txn
 
     def commit(self, txn_id: str) -> None:
-        txn = self.get(txn_id)
-        if txn.state != "ACTIVE":
-            raise TransactionError(f"transaction {txn_id} is {txn.state}")
-        txn.state = "COMMITTED"
+        txn = self._transition(txn_id, "COMMITTED")
         txn.snapshots.clear()
         self._finish(txn_id)
 
     def rollback(self, txn_id: str) -> None:
-        txn = self.get(txn_id)
-        if txn.state != "ACTIVE":
-            raise TransactionError(f"transaction {txn_id} is {txn.state}")
+        txn = self._transition(txn_id, "ABORTED")
         for name, snap in txn.snapshots.items():
             conn = self.catalogs.get(name)
             restore = getattr(conn, "restore_state", None)
             if restore is not None:
                 restore(snap)
-        txn.state = "ABORTED"
         txn.snapshots.clear()
         self._finish(txn_id)
 
     def _finish(self, txn_id: str) -> None:
+        # only ever reached by the thread that won _transition, so the
+        # write_lock is released exactly once per transaction
         with self._lock:
             self._transactions.pop(txn_id, None)  # no unbounded history
         try:
