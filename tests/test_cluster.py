@@ -1,0 +1,141 @@
+"""Multi-process cluster tests: N real server processes, HTTP task
+dispatch, page exchange, discovery, failure detection.
+
+Reference tier: ``testing/trino-testing/.../DistributedQueryRunner.java:72``
+and ``testing/trino-tests/.../TestGracefulShutdown.java`` — here with real
+OS processes, which is stricter than N servers in one JVM."""
+
+import time
+
+import pytest
+
+from trino_tpu.testing import LocalQueryRunner, MultiProcessQueryRunner
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with MultiProcessQueryRunner(n_workers=2) as runner:
+        yield runner
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner()
+
+
+def check(cluster, local, sql):
+    crows, _ = cluster.execute(sql)
+    lrows, _ = local.execute(sql)
+    assert crows == lrows, (
+        f"cluster != local for {sql}\ncluster: {crows[:5]}\nlocal: {lrows[:5]}"
+    )
+
+
+class TestClusterQueries:
+    def test_scan_count(self, cluster, local):
+        check(cluster, local, "select count(*) from lineitem")
+
+    def test_grouped_agg(self, cluster, local):
+        check(
+            cluster,
+            local,
+            """select l_returnflag, l_linestatus, sum(l_quantity), count(*),
+               avg(l_extendedprice) from lineitem
+               where l_shipdate <= date '1998-09-02'
+               group by l_returnflag, l_linestatus
+               order by l_returnflag, l_linestatus""",
+        )
+
+    def test_broadcast_join(self, cluster, local):
+        check(
+            cluster,
+            local,
+            """select o_orderpriority, count(*) from orders
+               join lineitem on l_orderkey = o_orderkey
+               where o_orderdate < date '1995-06-01'
+               group by o_orderpriority order by o_orderpriority""",
+        )
+
+    def test_topn(self, cluster, local):
+        check(
+            cluster,
+            local,
+            "select o_orderkey, o_totalprice from orders"
+            " order by o_totalprice desc, o_orderkey limit 10",
+        )
+
+    def test_global_agg_min_max(self, cluster, local):
+        check(
+            cluster,
+            local,
+            "select count(*), min(l_shipdate), max(l_shipdate), sum(l_quantity)"
+            " from lineitem",
+        )
+
+    def test_tpch_q6(self, cluster, local):
+        check(
+            cluster,
+            local,
+            """select sum(l_extendedprice * l_discount) as revenue
+               from lineitem
+               where l_shipdate >= date '1994-01-01'
+                 and l_shipdate < date '1995-01-01'
+                 and l_discount between decimal '0.05' and decimal '0.07'
+                 and l_quantity < 24""",
+        )
+
+    def test_tpch_q3_shape(self, cluster, local):
+        check(
+            cluster,
+            local,
+            """select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+                      o_orderdate, o_shippriority
+               from customer, orders, lineitem
+               where c_mktsegment = 'BUILDING'
+                 and c_custkey = o_custkey and l_orderkey = o_orderkey
+                 and o_orderdate < date '1995-03-15'
+                 and l_shipdate > date '1995-03-15'
+               group by l_orderkey, o_orderdate, o_shippriority
+               order by revenue desc, o_orderdate limit 10""",
+        )
+
+    def test_string_functions_cross_wire(self, cluster, local):
+        # dictionary-encoded strings survive page serialization
+        check(
+            cluster,
+            local,
+            """select o_orderstatus, min(o_orderpriority), max(o_orderpriority)
+               from orders group by o_orderstatus order by o_orderstatus""",
+        )
+
+
+class TestClusterMembership:
+    def test_nodes_announced(self, cluster):
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(f"{cluster.coordinator_uri}/v1/node") as r:
+            info = json.loads(r.read().decode())
+        assert len(info["nodes"]) == 2
+        assert all(not n["failed"] for n in info["failureInfo"])
+
+    def test_worker_failure_excluded_and_query_survives(self, cluster, local):
+        # kill one worker; the failure detector must flag it and the next
+        # query must succeed on the remaining worker (v356 semantics: only
+        # in-flight queries on the lost node fail)
+        victim = cluster._procs[-1]
+        victim.terminate()
+        victim.wait(timeout=10)
+        import json
+        import urllib.request
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with urllib.request.urlopen(f"{cluster.coordinator_uri}/v1/node") as r:
+                info = json.loads(r.read().decode())
+            if any(n["failed"] for n in info["failureInfo"]):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("failure detector never flagged the killed worker")
+        check(cluster, local, "select count(*), sum(o_totalprice) from orders")

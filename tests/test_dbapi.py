@@ -1,0 +1,108 @@
+"""DB-API 2.0 driver tests (reference tier: client/trino-jdbc)."""
+
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu import dbapi
+from trino_tpu.server.http import TrinoTpuServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = TrinoTpuServer(port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def conn(server):
+    c = dbapi.connect(base_uri=f"http://127.0.0.1:{server.port}", catalog="tpch", schema="tiny")
+    yield c
+    c.close()
+
+
+def test_module_globals():
+    assert dbapi.apilevel == "2.0"
+    assert dbapi.paramstyle == "qmark"
+    assert issubclass(dbapi.ProgrammingError, dbapi.DatabaseError)
+    assert issubclass(dbapi.DatabaseError, dbapi.Error)
+
+
+def test_basic_select(conn):
+    cur = conn.cursor()
+    cur.execute("select 1 + 1")
+    assert cur.fetchall() == [(2,)]
+    assert cur.description[0][1] in ("bigint", "integer")
+
+
+def test_fetch_variants(conn):
+    cur = conn.cursor()
+    cur.execute("select x from (values 1, 2, 3, 4, 5) v(x) order by x")
+    assert cur.fetchone() == (1,)
+    assert cur.fetchmany(2) == [(2,), (3,)]
+    assert cur.fetchall() == [(4,), (5,)]
+    assert cur.fetchone() is None
+
+
+def test_qmark_binding(conn):
+    cur = conn.cursor()
+    cur.execute(
+        "select ? + x, ? from (values 1) v(x)", (41, "it''s?")
+    )
+    row = cur.fetchone()
+    assert row[0] == 42
+    assert row[1] == "it''s?"
+
+
+def test_binding_inside_literal_untouched(conn):
+    cur = conn.cursor()
+    cur.execute("select 'a?b', ? from (values 1) v(x)", (7,))
+    assert cur.fetchone() == ("a?b", 7)
+
+
+def test_param_count_mismatch(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select ?", (1, 2))
+
+
+def test_decimal_roundtrip(conn):
+    cur = conn.cursor()
+    cur.execute("select ?", (Decimal("12.34"),))
+    assert cur.fetchone() == (Decimal("12.34"),)
+
+
+def test_error_maps_to_database_error(conn):
+    cur = conn.cursor()
+    with pytest.raises(dbapi.DatabaseError):
+        cur.execute("select definitely_not_a_column from lineitem")
+        cur.fetchall()
+
+
+def test_ddl_rowcount_and_txn(server):
+    with dbapi.connect(
+        base_uri=f"http://127.0.0.1:{server.port}", catalog="memory", schema="default"
+    ) as conn:
+        cur = conn.cursor()
+        cur.execute("create table dbapi_t (x bigint)")
+        cur.execute("insert into dbapi_t values 1")
+        assert cur.rowcount == 1
+        cur.execute("insert into dbapi_t values 2")
+        cur.execute("select count(*) from dbapi_t")
+        assert cur.fetchone() == (2,)
+        cur.execute("drop table dbapi_t")
+
+
+def test_cursor_iteration(conn):
+    cur = conn.cursor()
+    cur.execute("select x from (values 10, 20) v(x) order by x")
+    assert [r for r in cur] == [(10,), (20,)]
+
+
+def test_closed_cursor_raises(conn):
+    cur = conn.cursor()
+    cur.close()
+    with pytest.raises(dbapi.InterfaceError):
+        cur.execute("select 1")

@@ -1,0 +1,276 @@
+"""Int128 kernel tests vs Python big-int oracle (reference:
+spi/type/UnscaledDecimal128Arithmetic semantics)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from trino_tpu.ops import decimal128 as D
+
+
+RNG = np.random.default_rng(7)
+
+
+def rand_i64(n, lo=-(2**62), hi=2**62):
+    return RNG.integers(lo, hi, n, dtype=np.int64)
+
+
+class TestScalarConversions:
+    def test_roundtrip(self):
+        for v in [0, 1, -1, 2**64, -(2**64), 2**126, -(2**126), 12345678901234567890]:
+            hi, lo = D.int_to_pair(v)
+            assert D.pair_to_int(hi, lo) == v
+
+    def test_wide_from_to_ints(self):
+        vals = [0, -5, 10**30, -(10**37), 2**100]
+        arr = D.wide_from_ints(vals)
+        assert D.wide_to_ints(arr) == vals
+
+
+class TestMul:
+    def test_mul_i64_to_i128_random(self):
+        a = rand_i64(512)
+        b = rand_i64(512)
+        hi, lo = D.mul_i64_to_i128(jnp.asarray(a), jnp.asarray(b))
+        hi, lo = np.asarray(hi), np.asarray(lo)
+        for i in range(512):
+            assert D.pair_to_int(hi[i], lo[i]) == int(a[i]) * int(b[i])
+
+    def test_mul_overflow_flag(self):
+        a = np.asarray([2, 2**40, -(2**40), 3, 2**31], dtype=np.int64)
+        b = np.asarray([3, 2**40, 2**40, -4, 2**31], dtype=np.int64)
+        ovf = np.asarray(D.mul_i64_overflows(jnp.asarray(a), jnp.asarray(b)))
+        expect = [abs(int(x) * int(y)) > 2**63 - 1 for x, y in zip(a, b)]
+        assert list(ovf) == expect
+
+    def test_mul128_by_i64_random(self):
+        base = [10**20, -(10**22), 123456789012345678901234567, -1, 0, 2**90]
+        m = [123, -456, 10**6, 10**18 - 1, -(10**9), 7]
+        arr = D.wide_from_ints(base)
+        hi = jnp.asarray(arr[:, 0])
+        lo = jnp.asarray(arr[:, 1])
+        mm = jnp.asarray(np.asarray(m, dtype=np.int64))
+        rhi, rlo = D.mul128_by_i64(hi, lo, mm)
+        rhi, rlo = np.asarray(rhi), np.asarray(rlo)
+        for i in range(len(base)):
+            expect = (base[i] * m[i]) % (1 << 128)
+            if expect >= 1 << 127:
+                expect -= 1 << 128
+            assert D.pair_to_int(rhi[i], rlo[i]) == expect, (base[i], m[i])
+
+
+class TestAddCompare:
+    def test_add128_random(self):
+        vals1 = [int(RNG.integers(-(2**62), 2**62)) * (1 << s) for s in range(0, 60, 5)]
+        vals2 = [int(RNG.integers(-(2**62), 2**62)) * (1 << s) for s in range(0, 60, 5)]
+        a = D.wide_from_ints([int(v) for v in vals1])
+        b = D.wide_from_ints([int(v) for v in vals2])
+        hi, lo = D.add128(
+            jnp.asarray(a[:, 0]), jnp.asarray(a[:, 1]),
+            jnp.asarray(b[:, 0]), jnp.asarray(b[:, 1]),
+        )
+        got = D.wide_to_ints(np.stack([np.asarray(hi), np.asarray(lo)], axis=1))
+        assert got == [int(x) + int(y) for x, y in zip(vals1, vals2)]
+
+    def test_compare128(self):
+        vals = [0, 1, -1, 10**25, -(10**25), 2**100, -(2**100)]
+        a = D.wide_from_ints(vals)
+        for j, w in enumerate(vals):
+            b = D.wide_from_ints([w] * len(vals))
+            cmp = np.asarray(
+                D.compare128(
+                    jnp.asarray(a[:, 0]), jnp.asarray(a[:, 1]),
+                    jnp.asarray(b[:, 0]), jnp.asarray(b[:, 1]),
+                )
+            )
+            expect = [(-1 if v < w else (1 if v > w else 0)) for v in vals]
+            assert list(cmp) == expect
+
+    def test_neg128(self):
+        vals = [0, 5, -7, 2**64, -(2**100), 10**37]
+        a = D.wide_from_ints(vals)
+        hi, lo = D.neg128(jnp.asarray(a[:, 0]), jnp.asarray(a[:, 1]))
+        got = D.wide_to_ints(np.stack([np.asarray(hi), np.asarray(lo)], axis=1))
+        assert got == [-v for v in vals]
+
+
+class TestLimbSums:
+    def test_narrow_limb_sums_exact_beyond_int64(self):
+        n = 4096
+        data = RNG.integers(2**60, 2**62, n, dtype=np.int64)
+        gid = RNG.integers(0, 4, n).astype(np.int32)
+        valid = np.ones(n, dtype=bool)
+        sums = D.narrow_limb_sums(
+            jnp.asarray(data), jnp.asarray(valid), jnp.asarray(gid), 4
+        )
+        got = D.narrow_sums_to_ints(np.asarray(sums))
+        for g in range(4):
+            expect = sum(int(v) for v, k in zip(data, gid) if k == g)
+            assert got[g] == expect
+            assert expect > 2**63  # the whole point: sum exceeds int64
+
+    def test_narrow_limb_sums_negative(self):
+        data = np.asarray([-(2**62), -(2**62), 5, -1], dtype=np.int64)
+        gid = np.asarray([0, 0, 1, 1], dtype=np.int32)
+        sums = D.narrow_limb_sums(
+            jnp.asarray(data), jnp.asarray(np.ones(4, bool)), jnp.asarray(gid), 2
+        )
+        got = D.narrow_sums_to_ints(np.asarray(sums))
+        assert got == [-(2**63), 4]
+
+    def test_wide_limb_sums(self):
+        vals = [10**30, -(10**29), 10**30, 7, -(10**36), 10**36]
+        gid = np.asarray([0, 0, 0, 1, 1, 1], dtype=np.int32)
+        arr = D.wide_from_ints(vals)
+        sums = D.wide_limb_sums(
+            jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]),
+            jnp.asarray(np.ones(6, bool)), jnp.asarray(gid), 2,
+        )
+        got = D.wide_sums_to_ints(np.asarray(sums))
+        assert got == [sum(vals[:3]), sum(vals[3:])]
+
+    def test_sort_operands_wide(self):
+        import jax
+
+        vals = [5, -3, 10**25, -(10**25), 0, 2**64, -(2**64)]
+        arr = D.wide_from_ints(vals)
+        ops = D.sort_operands_wide(jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]))
+        idx = jnp.arange(len(vals))
+        out = jax.lax.sort(tuple(ops) + (idx,), num_keys=2)
+        order = [vals[int(i)] for i in np.asarray(out[-1])]
+        assert order == sorted(vals)
+
+
+class TestDeviceReconstruction:
+    def test_limb_sums_to_pair_narrow(self):
+        import jax.numpy as jnp
+
+        data = np.asarray([2**62, 2**62, 2**62, -(2**62), -5], dtype=np.int64)
+        gid = np.asarray([0, 0, 0, 1, 1], dtype=np.int32)
+        sums = D.narrow_limb_sums(
+            jnp.asarray(data), jnp.asarray(np.ones(5, bool)), jnp.asarray(gid), 2
+        )
+        hi, lo = D.limb_sums_to_pair(sums)
+        got = [D.pair_to_int(int(h), int(l)) for h, l in zip(np.asarray(hi), np.asarray(lo))]
+        assert got == [3 * 2**62, -(2**62) - 5]
+
+    def test_limb_sums_to_pair_wide(self):
+        import jax.numpy as jnp
+
+        vals = [10**36, 10**36, -(10**35), 5, -9]
+        gid = np.asarray([0, 0, 0, 1, 1], dtype=np.int32)
+        arr = D.wide_from_ints(vals)
+        sums = D.wide_limb_sums(
+            jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]),
+            jnp.asarray(np.ones(5, bool)), jnp.asarray(gid), 2,
+        )
+        hi, lo = D.limb_sums_to_pair(sums)
+        got = [D.pair_to_int(int(h), int(l)) for h, l in zip(np.asarray(hi), np.asarray(lo))]
+        assert got == [2 * 10**36 - 10**35, -4]
+
+    def test_rescale_up_wide(self):
+        import jax.numpy as jnp
+
+        vals = [123, -(10**18), 10**19]
+        arr = D.wide_from_ints(vals)
+        hi, lo = D.rescale_up_wide(jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]), 19)
+        got = [D.pair_to_int(int(h), int(l)) for h, l in zip(np.asarray(hi), np.asarray(lo))]
+        assert got == [v * 10**19 for v in vals]
+
+
+class TestWideDecimalSql:
+    """SQL-level DECIMAL(38) behavior (reference: DecimalSumAggregation +
+    UnscaledDecimal128Arithmetic), local interpreter path."""
+
+    @pytest.fixture(scope="class")
+    def runner(self):
+        from trino_tpu.testing import LocalQueryRunner
+
+        return LocalQueryRunner()
+
+    def test_sum_type_is_decimal38(self, runner):
+        rows, _ = runner.execute(
+            "select sum(l_quantity) from lineitem"
+        )
+        from trino_tpu.sql.parser import parse_statement
+
+        plan = runner.engine.plan(
+            parse_statement("select sum(l_quantity) from lineitem"), runner.session
+        )
+        from trino_tpu.planner import plan as P
+
+        out = plan.output_symbols[0]
+        assert str(out.type) == "decimal(38,2)"
+
+    def test_sum_beyond_int64_exact(self, runner):
+        from decimal import Decimal
+
+        rows, _ = runner.execute(
+            "select sum(cast(x as decimal(18,0))) from (values "
+            "9000000000000000000, 9000000000000000000, -1) t(x)"
+        )
+        assert rows == [(Decimal(17999999999999999999),)]
+
+    def test_grouped_sum_beyond_int64(self, runner):
+        from decimal import Decimal
+
+        rows, _ = runner.execute(
+            "select k, sum(cast(x as decimal(18,0))) from (values "
+            "(1, 9000000000000000000), (1, 9000000000000000000),"
+            "(2, 5), (2, -8)) t(k, x) group by k order by k"
+        )
+        assert rows == [(1, Decimal(18000000000000000000)), (2, Decimal(-3))]
+
+    def test_order_by_wide_sum(self, runner):
+        rows, _ = runner.execute(
+            "select k, sum(cast(x as decimal(18,0))) s from (values "
+            "(1, 9000000000000000000), (1, 9000000000000000000),"
+            "(2, 8999999999999999999), (2, 8999999999999999999),"
+            "(3, 7)) t(k, x) group by k order by s desc"
+        )
+        assert [r[0] for r in rows] == [1, 2, 3]
+
+    def test_compare_wide_sum(self, runner):
+        rows, _ = runner.execute(
+            "select k from (values (1, 9000000000000000000),"
+            "(1, 9000000000000000000), (2, 5)) t(k, x) group by k "
+            "having sum(cast(x as decimal(18,0))) > 9223372036854775807 "
+        )
+        assert rows == [(1,)]
+
+    def test_wide_multiply_matches_decimal(self, runner):
+        from decimal import Decimal
+
+        rows, _ = runner.execute(
+            "select cast(123456789012.12 as decimal(14,2)) * "
+            "cast(987654321098.76 as decimal(14,2))"
+        )
+        assert rows == [
+            (Decimal("123456789012.12") * Decimal("987654321098.76"),)
+        ]
+
+    def test_avg_of_wide_product(self, runner):
+        from decimal import Decimal
+
+        rows, _ = runner.execute(
+            "select avg(a * b) from (values "
+            "(cast(123456789012.12 as decimal(14,2)), cast(2 as decimal(10,0))),"
+            "(cast(3.33 as decimal(14,2)), cast(3 as decimal(10,0)))) t(a, b)"
+        )
+        expect = (
+            Decimal("123456789012.12") * 2 + Decimal("3.33") * 3
+        ) / 2
+        assert rows == [(expect.quantize(Decimal("0.01")),)]
+
+    def test_wide_sum_distributed_matches_local(self, runner):
+        from trino_tpu.testing import LocalQueryRunner
+
+        dist = LocalQueryRunner(engine=runner.engine)
+        dist.session.set("execution_mode", "distributed")
+        sql = (
+            "select l_returnflag, sum(l_extendedprice * (1 - l_discount)) "
+            "from lineitem group by l_returnflag order by 1"
+        )
+        lrows, _ = runner.execute(sql)
+        drows, _ = dist.execute(sql)
+        assert lrows == drows

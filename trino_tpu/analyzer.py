@@ -553,7 +553,10 @@ class Analyzer:
                     result_type = T.BIGINT
                 elif kind == "sum":
                     if isinstance(arg.type, T.DecimalType):
-                        result_type = T.decimal(18, arg.type.scale)
+                        # reference: sum(decimal(p,s)) -> decimal(38,s)
+                        # (DecimalSumAggregation); values beyond int64 use
+                        # 128-bit limb accumulation (ops/decimal128.py)
+                        result_type = T.decimal(38, arg.type.scale)
                     elif T.is_integer(arg.type):
                         result_type = T.BIGINT
                     else:
@@ -1860,7 +1863,10 @@ def _literal(e: t.Literal) -> Constant:
     if e.kind == "boolean":
         return const(bool(e.value), T.BOOLEAN)
     if e.kind == "integer":
-        return const(int(e.value), T.BIGINT)
+        # reference: integer literals are INTEGER when they fit 32 bits
+        # (keeps decimal precision derivation narrow: INTEGER -> decimal(10,0))
+        v = int(e.value)
+        return const(v, T.INTEGER if -(2**31) <= v < 2**31 else T.BIGINT)
     if e.kind == "decimal":
         text = str(e.value)
         neg = text.startswith("-")
@@ -1920,20 +1926,28 @@ def _arith_type(name: str, a: T.SqlType, b: T.SqlType) -> T.SqlType:
     da = a if isinstance(a, T.DecimalType) else None
     db = b if isinstance(b, T.DecimalType) else None
     if da or db:
+        # integers join decimal arithmetic at their reference precision
+        # (TypeCoercion: tinyint->3, smallint->5, integer->10, bigint->19)
+        int_prec = {8: 3, 16: 5, 32: 10, 64: 19}
         if da is None:
-            da = T.decimal(18, 0)
+            da = T.decimal(int_prec.get(getattr(a, "bits", 64), 19), 0)
         if db is None:
-            db = T.decimal(18, 0)
+            db = T.decimal(int_prec.get(getattr(b, "bits", 64), 19), 0)
+        # reference precision derivation (DecimalOperators), capped at 38
         if name in ("add", "subtract"):
             s = max(da.scale, db.scale)
-            return T.decimal(18, s)
+            p = min(38, max(da.precision - da.scale, db.precision - db.scale) + s + 1)
+            return T.decimal(p, s)
         if name == "multiply":
             s = da.scale + db.scale
-            if s > 18:
-                raise SemanticError("decimal multiply scale overflow (>18)")
-            return T.decimal(18, s)
+            if s > 38:
+                raise SemanticError("decimal multiply scale overflow (>38)")
+            p = min(38, da.precision + db.precision)
+            return T.decimal(p, s)
         if name in ("divide", "modulus"):
-            return T.decimal(18, max(da.scale, db.scale))
+            s = max(da.scale, db.scale)
+            p = min(38, da.precision + db.scale + max(0, db.scale - da.scale))
+            return T.decimal(p, s)
     if T.is_integer(a) and T.is_integer(b):
         return T.common_super_type(a, b) or T.BIGINT
     if isinstance(a, T.DateType) and isinstance(b, T.DateType) and name == "subtract":
@@ -2114,6 +2128,12 @@ def _fold_call(node: Call) -> RowExpr:
             if T.is_integer(node.type):
                 a, b = int(vals[0]), int(vals[1])
                 r = {"add": a + b, "subtract": a - b, "multiply": a * b}[node.name]
+                import numpy as np
+
+                info = np.iinfo(node.type.storage_dtype)
+                if not (info.min <= r <= info.max):
+                    # reference raises instead of wrapping
+                    raise SemanticError(f"{node.type.name} overflow: {r}")
                 return const(r, node.type)
             if isinstance(node.type, T.DoubleType):
                 fa = _as_float(args[0])

@@ -280,7 +280,8 @@ def pad_batch(batch: Batch, capacity: int) -> Batch:
     cols = []
     for c in b.columns:
         data = np.asarray(c.data)
-        data = np.concatenate([data, np.zeros(pad, dtype=data.dtype)])
+        pad_shape = (pad,) + data.shape[1:]  # wide decimals are (n, 2)
+        data = np.concatenate([data, np.zeros(pad_shape, dtype=data.dtype)])
         if c.valid is not None:
             valid = np.concatenate([np.asarray(c.valid), np.zeros(pad, dtype=np.bool_)])
         else:

@@ -43,6 +43,16 @@ def _storage_constant(expr: Constant, dictionary: Dictionary | None, n: int) -> 
         assert dictionary is not None  # guarded by _eval
         code = dictionary.encode(v)
         return jnp.full(n, code, dtype=jnp.int32), jnp.ones(n, dtype=jnp.bool_)
+    if isinstance(t, T.DecimalType) and isinstance(v, int) and abs(v) >= 1 << 63:
+        # literal beyond int64: wide (n, 2) lanes
+        from trino_tpu.ops.decimal128 import int_to_pair
+
+        hi, lo = int_to_pair(v)
+        data = jnp.stack(
+            [jnp.full(n, hi, dtype=jnp.int64), jnp.full(n, lo, dtype=jnp.int64)],
+            axis=1,
+        )
+        return data, jnp.ones(n, dtype=jnp.bool_)
     return (
         jnp.full(n, v, dtype=t.storage_dtype),
         jnp.ones(n, dtype=jnp.bool_),
@@ -51,6 +61,64 @@ def _storage_constant(expr: Constant, dictionary: Dictionary | None, n: int) -> 
 
 def _all_valid(a: Pair, b: Pair) -> jnp.ndarray:
     return a[1] & b[1]
+
+
+def _is_wide(data) -> bool:
+    """Wide DECIMAL storage: (n, 2) int64 (hi, lo) lanes."""
+    return getattr(data, "ndim", 1) == 2
+
+
+def _as_pair128(data, scale: int, target_scale: int):
+    """Any decimal storage -> (hi, lo) lanes rescaled up to target_scale."""
+    from trino_tpu.ops import decimal128 as D128
+
+    if _is_wide(data):
+        hi, lo = data[:, 0], data[:, 1]
+    else:
+        hi, lo = D128.widen_i64(data.astype(jnp.int64))
+    if target_scale > scale:
+        hi, lo = D128.rescale_up_wide(hi, lo, target_scale - scale)
+    elif target_scale < scale:
+        raise NotImplementedError("DECIMAL(38) downscale")
+    return hi, lo
+
+
+def _check_int_overflow(name, rt, a64, b64, r64, valid):
+    """Raise on integer overflow like the reference (eager paths only —
+    under tracing the check is skipped and int64 semantics apply)."""
+    try:
+        if rt.bits < 64:
+            info = np.iinfo(rt.storage_dtype)
+            bad = valid & ((r64 < info.min) | (r64 > info.max))
+        elif name == "multiply":
+            from trino_tpu.ops.decimal128 import mul_i64_overflows
+
+            bad = valid & mul_i64_overflows(a64, b64)
+        else:
+            same_sign = (a64 >= 0) == (
+                (b64 >= 0) if name == "add" else (b64 < 0)
+            )
+            bad = valid & same_sign & ((r64 >= 0) != (a64 >= 0))
+        any_bad = bool(jnp.any(bad))
+    except Exception:  # noqa: BLE001 — traced values can't concretize
+        return
+    if any_bad:
+        raise ArithmeticError(f"{rt.name} overflow")
+
+
+def _narrow_checked(data, what: str):
+    """Wide storage -> int64, erroring if any value does not fit."""
+    if not _is_wide(data):
+        return data.astype(jnp.int64)
+    hi, lo = data[:, 0], data[:, 1]
+    fits = hi == (lo >> jnp.int64(63))
+    try:
+        ok = bool(jnp.all(fits))  # eager: concrete check
+    except Exception:  # traced: fused path excludes these shapes upstream
+        ok = True
+    if not ok:
+        raise ArithmeticError(f"{what}: DECIMAL value exceeds 18 digits")
+    return lo
 
 
 def _rescale(data: jnp.ndarray, from_scale: int, to_scale: int) -> jnp.ndarray:
@@ -186,9 +254,29 @@ class ExprCompiler:
             return self._compare(expr)
         if name == "negate":
             d, v = self._eval(expr.args[0])
+            if _is_wide(d):
+                from trino_tpu.ops.decimal128 import neg128
+
+                hi, lo = neg128(d[:, 0], d[:, 1])
+                return jnp.stack([hi, lo], axis=1), v
             return -d, v
         if name == "abs":
             d, v = self._eval(expr.args[0])
+            if _is_wide(d):
+                from trino_tpu.ops.decimal128 import neg128
+
+                hi, lo = neg128(d[:, 0], d[:, 1])
+                neg = d[:, 0] < 0
+                return (
+                    jnp.stack(
+                        [
+                            jnp.where(neg, hi, d[:, 0]),
+                            jnp.where(neg, lo, d[:, 1]),
+                        ],
+                        axis=1,
+                    ),
+                    v,
+                )
             return jnp.abs(d), v
         if name == "cast":
             return self._cast(expr)
@@ -356,6 +444,10 @@ class ExprCompiler:
         valid = _all_valid(a, b)
         rt = expr.type
         name = expr.name
+        if isinstance(rt, T.DecimalType) and (
+            rt.wide or _is_wide(a[0]) or _is_wide(b[0])
+        ):
+            return self._arith_wide(expr, a, b, valid)
         if isinstance(rt, T.DecimalType):
             rs = rt.scale
             sa, sb = _dec_scale(a_t), _dec_scale(b_t)
@@ -368,28 +460,28 @@ class ExprCompiler:
             if name == "multiply":
                 raw = ad * bd  # scale sa+sb
                 return _rescale(raw, sa + sb, rs), valid
-            if name == "divide":
-                # result scale rs: q = round(a * 10^(rs - sa + sb) / b)
-                shift = rs - sa + sb
-                num = ad * (10 ** max(shift, 0))
-                den = jnp.where(bd == 0, 1, bd)
-                if shift < 0:
-                    den = den * (10 ** (-shift))
-                half = jnp.abs(den) // 2
-                q = jnp.where(
-                    (num >= 0) == (den > 0),
-                    (jnp.abs(num) + half) // jnp.abs(den),
-                    -((jnp.abs(num) + half) // jnp.abs(den)),
+            if name in ("divide", "modulus"):
+                return self._arith_narrow_decimal(
+                    expr, (ad, a[1]), (bd, b[1]), valid, sa, sb, rs
                 )
-                return q, valid & (bd != 0)
-            if name == "modulus":
-                bz = jnp.where(bd == 0, 1, bd)
-                r = ad - (ad // bz) * bz
-                return _rescale(r, max(sa, sb), rs), valid & (bd != 0)
         # float/int paths: cast both to result dtype
         dt = rt.storage_dtype
         ad = _cast_numeric(a[0], a_t, rt)
         bd = _cast_numeric(b[0], b_t, rt)
+        if T.is_integer(rt) and name in ("add", "subtract", "multiply"):
+            # compute in int64 and range-check: the reference raises
+            # "integer overflow" instead of wrapping (eager paths only;
+            # traced fragments inherit int64 behavior)
+            a64 = ad.astype(jnp.int64)
+            b64 = bd.astype(jnp.int64)
+            if name == "add":
+                r64 = a64 + b64
+            elif name == "subtract":
+                r64 = a64 - b64
+            else:
+                r64 = a64 * b64
+            _check_int_overflow(name, rt, a64, b64, r64, valid)
+            return r64.astype(dt), valid
         if name == "add":
             return ad + bd, valid
         if name == "subtract":
@@ -410,6 +502,92 @@ class ExprCompiler:
             return jnp.fmod(ad, bz), valid & (bd != 0)
         raise AssertionError(name)
 
+    def _arith_wide(self, expr: Call, a: Pair, b: Pair, valid) -> Pair:
+        """DECIMAL arithmetic in 128-bit (hi, lo) lanes (reference:
+        UnscaledDecimal128Arithmetic add/multiply). Division/modulus of
+        wide values narrows at runtime when the operands fit int64 and
+        errors otherwise (the fused path excludes these shapes)."""
+        from trino_tpu.ops import decimal128 as D128
+
+        rt = expr.type
+        a_t, b_t = expr.args[0].type, expr.args[1].type
+        sa, sb = _dec_scale(a_t), _dec_scale(b_t)
+        name = expr.name
+        if name == "multiply":
+            # result scale == sa + sb: no rescale needed
+            aw, bw = _is_wide(a[0]), _is_wide(b[0])
+            if not aw and not bw:
+                hi, lo = D128.mul_i64_to_i128(
+                    a[0].astype(jnp.int64), b[0].astype(jnp.int64)
+                )
+            elif aw and not bw:
+                hi, lo = D128.mul128_by_i64(
+                    a[0][:, 0], a[0][:, 1], b[0].astype(jnp.int64)
+                )
+            elif bw and not aw:
+                hi, lo = D128.mul128_by_i64(
+                    b[0][:, 0], b[0][:, 1], a[0].astype(jnp.int64)
+                )
+            else:
+                raise NotImplementedError("DECIMAL(38) * DECIMAL(38)")
+            return jnp.stack([hi, lo], axis=1), valid
+        if name in ("add", "subtract"):
+            ahi, alo = _as_pair128(a[0], sa, rt.scale)
+            bhi, blo = _as_pair128(b[0], sb, rt.scale)
+            if name == "subtract":
+                bhi, blo = D128.neg128(bhi, blo)
+            hi, lo = D128.add128(ahi, alo, bhi, blo)
+            return jnp.stack([hi, lo], axis=1), valid
+        if name in ("divide", "modulus"):
+            # narrow at runtime (exact when operands fit int64); queries
+            # whose operands genuinely exceed int64 error rather than
+            # silently truncate
+            ad = _narrow_checked(a[0], "decimal division")
+            bd = _narrow_checked(b[0], "decimal division")
+            narrowed = Call(
+                type=T.decimal(18, rt.scale), name=name, args=expr.args
+            )
+            return self._arith_narrow_decimal(
+                narrowed, (ad, a[1]), (bd, b[1]), valid, sa, sb, rt.scale
+            )
+        raise AssertionError(name)
+
+    def _arith_narrow_decimal(self, expr, a, b, valid, sa, sb, rs):
+        """int64 decimal divide/modulus, shared by the narrow type path and
+        the runtime-narrowed wide path."""
+        name = expr.name
+        ad = a[0].astype(jnp.int64)
+        bd = b[0].astype(jnp.int64)
+        if name == "divide":
+            # result scale rs: q = round(a * 10^(rs - sa + sb) / b)
+            shift = rs - sa + sb
+            num = ad * (10 ** max(shift, 0))
+            den = jnp.where(bd == 0, 1, bd)
+            if shift < 0:
+                den = den * (10 ** (-shift))
+            half = jnp.abs(den) // 2
+            q = jnp.where(
+                (num >= 0) == (den > 0),
+                (jnp.abs(num) + half) // jnp.abs(den),
+                -((jnp.abs(num) + half) // jnp.abs(den)),
+            )
+            return q, valid & (bd != 0)
+        if name == "modulus":
+            # Trino MOD: operands aligned to a common scale, truncating
+            # division (result keeps the dividend's sign)
+            s = max(sa, sb)
+            an = _rescale(ad, sa, s)
+            bn = _rescale(bd, sb, s)
+            bz = jnp.where(bn == 0, 1, bn)
+            q = jnp.where(
+                (an >= 0) == (bz > 0),
+                jnp.abs(an) // jnp.abs(bz),
+                -(jnp.abs(an) // jnp.abs(bz)),
+            )
+            r = an - q * bz
+            return _rescale(r, s, rs), valid & (bn != 0)
+        raise AssertionError(name)
+
     def _compare(self, expr: Call) -> Pair:
         a_e, b_e = expr.args
         a_t, b_t = a_e.type, b_e.type
@@ -419,6 +597,14 @@ class ExprCompiler:
         a, b = self._eval(a_e), self._eval(b_e)
         valid = _all_valid(a, b)
         sa, sb = _dec_scale(a_t), _dec_scale(b_t)
+        if _is_wide(a[0]) or _is_wide(b[0]):
+            from trino_tpu.ops.decimal128 import compare128
+
+            s = max(sa, sb)
+            ahi, alo = _as_pair128(a[0], sa, s)
+            bhi, blo = _as_pair128(b[0], sb, s)
+            sign = compare128(ahi, alo, bhi, blo)
+            return _cmp_op(expr.name, sign, jnp.zeros_like(sign)), valid
         if isinstance(a_t, T.DecimalType) or isinstance(b_t, T.DecimalType):
             s = max(sa, sb)
             ad = _rescale(a[0].astype(jnp.int64), sa, s)
@@ -572,8 +758,25 @@ class ExprCompiler:
         st, rt = src.type, expr.type
         if st == rt:
             return d, v
+        if _is_wide(d):
+            if isinstance(rt, (T.DoubleType, T.RealType)) and isinstance(
+                st, T.DecimalType
+            ):
+                # (hi, lo) -> float: hi*2^64 + unsigned(lo), then unscale
+                lo_u = d[:, 1].astype(jnp.float64) + jnp.where(
+                    d[:, 1] < 0, jnp.float64(2**64), jnp.float64(0)
+                )
+                f = d[:, 0].astype(jnp.float64) * jnp.float64(2**64) + lo_u
+                return (f / st.unscale).astype(rt.storage_dtype), v
+            # other casts narrow at runtime (exact when values fit int64)
+            d = _narrow_checked(d, f"cast {st} -> {rt}")
         if isinstance(rt, T.DecimalType):
             if isinstance(st, T.DecimalType):
+                if rt.wide and rt.scale >= st.scale:
+                    from trino_tpu.ops import decimal128 as D128
+
+                    hi, lo = _as_pair128(d, st.scale, rt.scale)
+                    return jnp.stack([hi, lo], axis=1), v
                 return _rescale(d.astype(jnp.int64), st.scale, rt.scale), v
             if T.is_integer(st):
                 return d.astype(jnp.int64) * rt.unscale, v

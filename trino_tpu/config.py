@@ -61,6 +61,9 @@ class Session:
         ("spill_threshold_rows", 1 << 23),
         ("tpu_enabled", True),
         ("execution_mode", "local"),  # local | distributed (mesh SPMD)
+        # distributed mode: compile each plan fragment into one SPMD
+        # program (exec/fragments.py); off -> materialized interpreter
+        ("fragment_execution", True),
     )
 
     def get(self, name: str) -> Any:

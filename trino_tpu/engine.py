@@ -86,6 +86,9 @@ class Engine:
 
         self.transaction_manager = TransactionManager(self.catalogs)
         self.access_control = AccessControlManager()
+        # multi-host scheduling (server/cluster.py installs this on
+        # coordinator servers; execution_mode=cluster routes through it)
+        self.cluster_scheduler = None
         try:
             from trino_tpu.connectors.system import SystemConnector
 
@@ -227,6 +230,14 @@ class Engine:
     ) -> StatementResult:
         from trino_tpu.memory import QueryMemoryContext
 
+        if (
+            session.get("execution_mode") == "cluster"
+            and self.cluster_scheduler is not None
+        ):
+            batch, names = self.cluster_scheduler.execute(plan, session)
+            return StatementResult(
+                batch.to_pylist(), names, [c.type for c in batch.columns]
+            )
         ctx = QueryMemoryContext(
             self.memory_pool,
             query_id or self._next_query_id(),
@@ -249,6 +260,12 @@ class Engine:
     def _executor(self, session: Session, ctx) -> LocalExecutor:
         mode = session.get("execution_mode")
         if mode == "distributed":
+            if session.get("fragment_execution"):
+                from trino_tpu.exec.fragments import FragmentedExecutor
+
+                return FragmentedExecutor(
+                    self.catalogs, session, self.mesh, memory_ctx=ctx
+                )
             from trino_tpu.parallel.distributed import DistributedExecutor
 
             return DistributedExecutor(
@@ -343,7 +360,11 @@ class Engine:
                 [(line,) for line in text.splitlines()], ["Query Plan"], [T.VARCHAR]
             )
         plan = self.plan(stmt.statement, session)
-        text = P.plan_text(plan)
+        from trino_tpu.planner.fragmenter import fragment_plan, subplan_text
+
+        # EXPLAIN shows the distributed (fragmented) plan, like the
+        # reference's default EXPLAIN output
+        text = subplan_text(fragment_plan(plan))
         return StatementResult(
             [(line,) for line in text.splitlines()], ["Query Plan"], [T.VARCHAR]
         )

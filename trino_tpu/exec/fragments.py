@@ -1,0 +1,973 @@
+"""Fragment-fused execution: one compiled SPMD program per plan fragment.
+
+Reference: Trino executes each PlanFragment as a pipeline of operators with
+per-operator scheduling (``operator/Driver.java:355-392``); its "native
+tier" compiles the hot expression chains (``sql/gen/ExpressionCompiler.java``).
+The TPU translation goes further (SURVEY §7 "Stage = pjit program"): the
+ENTIRE fragment — scan filters, projections, joins, partial/final
+aggregation, and the exchange collectives that feed the next fragment —
+traces into a single ``jax.jit`` program over the device mesh. No per-node
+materialization, no host syncs between operators; XLA fuses the chain and
+schedules the collectives (``lax.all_to_all`` / ``all_gather``) inline.
+
+Execution model:
+- :func:`fragment_plan` (planner/fragmenter.py) splits the optimized plan
+  at remote exchanges.
+- :class:`FragmentedExecutor` runs the fragment tree bottom-up. Every
+  fragment whose nodes are in the fusable set runs as ONE jitted program;
+  queries containing non-fusable shapes (windows, set ops, grouping sets,
+  semi/anti joins, DISTINCT aggregates, VALUES) fall back to the
+  materialized interpreter (``DistributedExecutor``), which remains the
+  semantics reference.
+- Capacities (group budgets, join output sizes, exchange buckets) are
+  static per compile; kernels report overflow flags and the host retries
+  with doubled capacities (shape-bucketed, so retries hit the persistent
+  compile cache).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as PS
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column, Dictionary, bucket_capacity
+from trino_tpu.exec.local import ExecutionError, Result, rank_codes, sum_spec_for
+from trino_tpu.ops import join as J
+from trino_tpu.ops.aggregation import AggSpec, global_aggregate, group_aggregate
+from trino_tpu.ops.sort import sort_indices
+from trino_tpu.parallel import exchange as X
+from trino_tpu.parallel.distributed import DistributedExecutor, _sharded_probe
+from trino_tpu.parallel.mesh import AXIS, shard_batch, smap
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.fragmenter import PlanFragment, SubPlan, fragment_plan
+
+
+class FusedUnsupported(Exception):
+    """Raised during tracing when a shape turns out not to be fusable."""
+
+
+# --- fusability -------------------------------------------------------------
+
+_FUSABLE_NODES = (
+    P.TableScan,
+    P.RemoteSource,
+    P.Filter,
+    P.Project,
+    P.Aggregate,
+    P.Join,
+    P.TopN,
+    P.Limit,
+    P.Sort,
+    P.Output,
+)
+
+
+def _is_wide_type(t) -> bool:
+    return isinstance(t, T.DecimalType) and t.wide
+
+
+def _expr_blocks_fusion(e) -> bool:
+    """Division/modulus/cast touching wide DECIMAL narrows at runtime with
+    a data-dependent check — not traceable; those queries interpret."""
+    from trino_tpu.ir import Call, SpecialForm
+
+    if isinstance(e, Call):
+        if e.name in ("divide", "modulus", "cast") and (
+            _is_wide_type(e.type) or any(_is_wide_type(a.type) for a in e.args)
+        ):
+            return True
+        return any(_expr_blocks_fusion(a) for a in e.args)
+    if isinstance(e, SpecialForm):
+        return any(_expr_blocks_fusion(a) for a in e.args)
+    return False
+
+
+def query_fusable(sub: SubPlan) -> bool:
+    for frag in sub.all_fragments():
+        for n in P.walk_plan(frag.root):
+            if not isinstance(n, _FUSABLE_NODES):
+                return False
+            if isinstance(n, P.Join):
+                if (
+                    n.join_type not in ("INNER", "LEFT")
+                    or not n.criteria
+                    or n.single_row
+                    or (n.join_type == "LEFT" and n.filter is not None)
+                    or any(
+                        _is_wide_type(a.type) or _is_wide_type(b.type)
+                        for a, b in n.criteria
+                    )
+                ):
+                    return False
+                if n.filter is not None and _expr_blocks_fusion(n.filter):
+                    return False
+            if isinstance(n, P.Aggregate):
+                if any(fn.distinct for _, fn in n.aggregates):
+                    return False
+                if any(_is_wide_type(k.type) for k in n.group_keys):
+                    return False  # wide group keys: interpreter path
+                for _, fn in n.aggregates:
+                    if fn.kind not in (
+                        "sum", "count", "count_star", "min", "max", "avg"
+                    ):
+                        return False
+                    arg_wide = fn.argument is not None and _is_wide_type(
+                        fn.argument.type
+                    )
+                    # wide sums/min/max fuse (limb accumulators, two-lane
+                    # extrema); wide avg needs exact 128/64 division,
+                    # which is host-only — interpret those
+                    if fn.kind == "avg" and (
+                        arg_wide or _is_wide_type(fn.result_type)
+                    ):
+                        return False
+            if isinstance(n, P.Filter) and _expr_blocks_fusion(n.predicate):
+                return False
+            if isinstance(n, P.Project) and any(
+                _expr_blocks_fusion(e) for _, e in n.assignments
+            ):
+                return False
+    return True
+
+
+class _Caps:
+    """Capacity knobs, grown on overflow (shape-bucketed)."""
+
+    def __init__(self):
+        self.vals: dict[str, int] = {}
+
+    def get(self, name: str, default: int) -> int:
+        return self.vals.setdefault(name, default)
+
+    def grow(self, name: str, factor: int = 2) -> None:
+        self.vals[name] = self.vals[name] * factor
+
+
+@dataclasses.dataclass
+class _Meta:
+    """Static metadata captured while tracing a fragment program."""
+
+    layout: Optional[dict[str, int]] = None
+    column_meta: Optional[list[tuple[T.SqlType, Optional[Dictionary]]]] = None
+    overflow_names: Optional[list[str]] = None
+    output_names: Optional[list[str]] = None
+
+
+class FragmentedExecutor(DistributedExecutor):
+    """Distributed executor that compiles each fragment into one program."""
+
+    def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
+        if self.stats_collector is not None:
+            # per-operator stats need the materialized interpreter
+            return super().execute(node)
+        sub = fragment_plan(node)
+        if not query_fusable(sub):
+            return super().execute(node)
+        try:
+            return self._execute_fragments(sub)
+        except FusedUnsupported:
+            return super().execute(node)
+
+    # === fragment scheduling ============================================
+
+    def _execute_fragments(self, sub: SubPlan) -> tuple[Batch, list[str]]:
+        results: dict[int, Result] = {}
+        names_holder: dict[int, list[str]] = {}
+
+        def run(sp: SubPlan):
+            for child in sp.children:
+                run(child)
+            results[sp.fragment.id] = self._run_fragment(
+                sp.fragment, results, names_holder
+            )
+
+        run(sub)
+        root = results[sub.fragment.id]
+        out = root.batch.compact()
+        names = names_holder.get(sub.fragment.id) or [
+            s.name for s in sub.fragment.root.output_symbols
+        ]
+        return out, names
+
+    def _run_fragment(
+        self,
+        frag: PlanFragment,
+        results: dict[int, Result],
+        names_holder: dict[int, list[str]],
+    ) -> Result:
+        inputs: dict[str, Batch] = {}
+        input_layouts: dict[str, dict[str, int]] = {}
+        spill_threshold = (
+            int(self.session.get("spill_threshold_rows"))
+            if self.session.get("spill_enabled")
+            else None
+        )
+        for n in P.walk_plan(frag.root):
+            if isinstance(n, P.TableScan):
+                res = self._exec_tablescan(n)  # sharded host->device read
+                if spill_threshold is not None and res.batch.capacity > spill_threshold:
+                    # working set beyond the spill threshold: defer to the
+                    # interpreter, which has the partitioned-spill path
+                    raise FusedUnsupported("spill-sized input")
+                inputs[f"scan{id(n)}"] = res.batch
+                input_layouts[f"scan{id(n)}"] = res.layout
+            elif isinstance(n, P.RemoteSource):
+                res = results[n.fragment_id]
+                inputs[f"remote{n.fragment_id}"] = res.batch
+                input_layouts[f"remote{n.fragment_id}"] = res.layout
+            elif isinstance(n, P.Output):
+                names_holder[frag.id] = list(n.column_names)
+
+        caps = _Caps()
+        attempts = 0
+        while True:
+            attempts += 1
+            if attempts > 12:
+                raise ExecutionError("fragment capacity retry limit exceeded")
+            meta = _Meta()
+
+            def fn(inp: dict[str, Batch]):
+                tracer = _FragmentTracer(self, inp, input_layouts, caps)
+                res = tracer._exec(frag.root)
+                res = tracer.apply_output_exchange(frag, res)
+                meta.layout = dict(res.layout)
+                meta.column_meta = [
+                    (c.type, c.dictionary) for c in res.batch.columns
+                ]
+                meta.overflow_names = [nm for nm, _ in tracer.overflows]
+                flags = tuple(f for _, f in tracer.overflows)
+                data = tuple(
+                    (c.data, c.valid) for c in res.batch.columns
+                )
+                return data, res.batch.selection_mask(), flags
+
+            jitted = jax.jit(fn)
+            data, sel, flags = jitted(inputs)
+            flags_np = [bool(np.asarray(f)) for f in flags]
+            if not any(flags_np):
+                break
+            for nm, f in zip(meta.overflow_names, flags_np):
+                if f:
+                    caps.grow(nm, 4 if nm.startswith("agg") else 2)
+        cols = [
+            Column(t, d, v, dictionary)
+            for (d, v), (t, dictionary) in zip(data, meta.column_meta)
+        ]
+        # zero-column fragments (count(*) over pruned scans) still carry
+        # row liveness in sel
+        cap = cols[0].data.shape[0] if cols else int(sel.shape[0])
+        return Result(Batch(cols, cap, sel), meta.layout)
+
+
+class _FragmentTracer(DistributedExecutor):
+    """Pure-traceable execution of one fragment's node chain. Instances are
+    created inside ``jax.jit``; every method avoids host synchronization —
+    capacities come from the shared :class:`_Caps`, and data-dependent
+    overflow is reported via traced flags instead of host retries."""
+
+    def __init__(self, base: DistributedExecutor, inputs, input_layouts, caps):
+        super().__init__(base.catalogs, base.session, base.mesh, memory_ctx=None)
+        self._inputs = inputs
+        self._input_layouts = input_layouts
+        self.caps = caps
+        self.overflows: list[tuple[str, jax.Array]] = []
+        self._memo: dict[int, Result] = {}
+
+    @property
+    def n(self) -> int:
+        return self.mesh.devices.size
+
+    def _exec(self, node: P.PlanNode) -> Result:
+        key = id(node)
+        if key not in self._memo:
+            self._memo[key] = self._dispatch(node)
+        return self._memo[key]
+
+    def _dispatch(self, node: P.PlanNode) -> Result:
+        method = getattr(self, f"_exec_{type(node).__name__.lower()}", None)
+        if method is None:
+            raise FusedUnsupported(type(node).__name__)
+        return method(node)
+
+    # --- leaves ---------------------------------------------------------
+
+    def _exec_tablescan(self, node: P.TableScan) -> Result:
+        batch = self._inputs[f"scan{id(node)}"]
+        return Result(batch, dict(self._input_layouts[f"scan{id(node)}"]))
+
+    def _exec_remotesource(self, node: P.RemoteSource) -> Result:
+        batch = self._inputs[f"remote{node.fragment_id}"]
+        layout = dict(self._input_layouts[f"remote{node.fragment_id}"])
+        # rename producer symbols -> this node's symbols (same order)
+        producer_order = sorted(layout, key=layout.get)
+        if len(producer_order) != len(node.symbols):
+            raise FusedUnsupported("remote source arity mismatch")
+        new_layout = {
+            s.name: layout[p] for s, p in zip(node.symbols, producer_order)
+        }
+        return Result(batch, new_layout)
+
+    # --- output / row-preserving ---------------------------------------
+
+    def _exec_output(self, node: P.Output) -> Result:
+        res = self._exec(node.source)
+        cols = [res.column(s) for s in node.symbols]
+        layout = {s.name: i for i, s in enumerate(node.symbols)}
+        return Result(Batch(cols, res.batch.num_rows, res.batch.sel), layout)
+
+    # _exec_filter / _exec_project inherited (already traceable)
+
+    def _exec_limit(self, node: P.Limit) -> Result:
+        res = self._exec(node.source)
+        sel = res.batch.selection_mask()
+        rank = jnp.cumsum(sel.astype(jnp.int64))
+        keep = sel
+        if node.offset:
+            keep = keep & (rank > node.offset)
+        if node.count is not None:
+            keep = keep & (rank <= node.offset + node.count)
+        return Result(
+            Batch(res.batch.columns, res.batch.num_rows, keep), res.layout
+        )
+
+    def _exec_sort(self, node: P.Sort) -> Result:
+        return self._traced_sort(self._exec(node.source), node.order_by, None)
+
+    def _exec_topn(self, node: P.TopN) -> Result:
+        res = self._exec(node.source)
+        if node.step == "partial":
+            return self._partial_topn(res, node)
+        return self._traced_sort(res, node.order_by, node.count)
+
+    def _sort_operands(self, res: Result, order_by):
+        key_pairs, keys, ranks = [], [], []
+        for o in order_by:
+            c = res.column(o.symbol)
+            key_pairs.append((c.data, c.valid_mask()))
+            keys.append(o.sort_key())
+            ranks.append(c.dictionary.ranks() if c.dictionary is not None else None)
+        return key_pairs, keys, ranks
+
+    def _traced_sort(
+        self, res: Result, order_by, keep: Optional[int]
+    ) -> Result:
+        b = res.batch
+        key_pairs, keys, ranks = self._sort_operands(res, order_by)
+        sel = b.selection_mask()
+        perm = sort_indices(key_pairs, keys, sel, ranks)
+        if keep is not None:
+            perm = perm[: min(keep, b.capacity)]
+        cols = []
+        for c in b.columns:
+            cols.append(
+                Column(c.type, c.data[perm], c.valid_mask()[perm], c.dictionary)
+            )
+        out_sel = sel[perm]
+        return Result(Batch(cols, perm.shape[0], out_sel), res.layout)
+
+    def _partial_topn(self, res: Result, node: P.TopN) -> Result:
+        """Per-shard topN: each shard keeps its own best `count` rows
+        (reference: TopNNode PARTIAL)."""
+        b = res.batch
+        key_pairs, keys, ranks = self._sort_operands(res, node.order_by)
+        sel = b.selection_mask()
+        keep = min(node.count, max(1, b.capacity // self.n))
+        flat = []
+        for c in b.columns:
+            flat.append(c.data)
+            flat.append(c.valid_mask())
+        for kd, kv in key_pairs:
+            flat.append(kd)
+            flat.append(kv)
+        flat.append(sel)
+        ncols = len(b.columns)
+        nkeys = len(key_pairs)
+
+        def shard_topn(*ops):
+            cols_ = ops[: 2 * ncols]
+            kp = [
+                (ops[2 * ncols + 2 * i], ops[2 * ncols + 2 * i + 1])
+                for i in range(nkeys)
+            ]
+            s = ops[-1]
+            perm = sort_indices(kp, keys, s, ranks)[:keep]
+            outs = [c[perm] for c in cols_]
+            return tuple(outs), s[perm]
+
+        mapped = smap(
+            shard_topn,
+            mesh=self.mesh,
+            in_specs=(PS(AXIS),) * len(flat),
+            out_specs=(tuple(PS(AXIS) for _ in range(2 * ncols)), PS(AXIS)),
+        )
+        outs, out_sel = mapped(*flat)
+        cols = []
+        for i, c in enumerate(b.columns):
+            cols.append(Column(c.type, outs[2 * i], outs[2 * i + 1], c.dictionary))
+        return Result(Batch(cols, self.n * keep, out_sel), res.layout)
+
+    # --- aggregation -----------------------------------------------------
+
+    def _exec_aggregate(self, node: P.Aggregate) -> Result:
+        res = self._exec(node.source)
+        if node.step == "partial":
+            return self._agg_partial(node, res)
+        if node.step == "final":
+            return self._agg_final(node, res)
+        return self._agg_single(node, res)
+
+    def _agg_inputs(self, node: P.Aggregate, res: Result):
+        """Traceable version of the interpreter's aggregate input prep."""
+        agg_inputs, specs, string_dicts = [], [], []
+        for _, fn in node.aggregates:
+            if fn.kind == "count_star":
+                if fn.filter is not None:
+                    fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
+                    ones = jnp.ones(res.batch.capacity, dtype=jnp.int64)
+                    agg_inputs.append((ones, fc.data & fc.valid_mask()))
+                    specs.append(AggSpec("count"))
+                    string_dicts.append(None)
+                    continue
+                agg_inputs.append(None)
+                specs.append(AggSpec("count_star"))
+                string_dicts.append(None)
+                continue
+            sym = P.Symbol(fn.argument.name, fn.argument.type)
+            c = res.column(sym)
+            data, valid = c.data, c.valid_mask()
+            if c.dictionary is not None and fn.kind in ("min", "max"):
+                data = rank_codes(c.dictionary, data)
+                string_dicts.append(c.dictionary)
+            else:
+                string_dicts.append(None)
+            if fn.filter is not None:
+                fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
+                valid = valid & fc.data & fc.valid_mask()
+            agg_inputs.append((data, valid))
+            specs.append(sum_spec_for(fn, data))
+        return agg_inputs, specs, string_dicts
+
+    def _agg_partial(self, node: P.Aggregate, res: Result) -> Result:
+        """Per-shard partial aggregation -> accumulator rows (sharded)."""
+        sel = res.batch.selection_mask()
+        agg_inputs, specs, string_dicts = self._agg_inputs(node, res)
+        key_cols = [res.column(k) for k in node.group_keys]
+        keys = [(c.data, c.valid_mask()) for c in key_cols]
+        nkeys = len(keys)
+        if nkeys == 0:
+            return self._agg_partial_global(node, res, sel, agg_inputs, specs, string_dicts)
+        G = self.caps.get(f"agg{id(node)}", 1 << 12)
+
+        flat = []
+        for kd, kv in keys:
+            flat.extend([kd, kv])
+        flat.append(sel)
+        has_input = [p is not None for p in agg_inputs]
+        for p in agg_inputs:
+            if p is not None:
+                flat.extend([p[0], p[1]])
+
+        def shard_partial(*ops):
+            i = 0
+            lkeys = []
+            for _ in range(nkeys):
+                lkeys.append((ops[i], ops[i + 1]))
+                i += 2
+            lsel = ops[i]
+            i += 1
+            linputs = []
+            for has in has_input:
+                if has:
+                    linputs.append((ops[i], ops[i + 1]))
+                    i += 2
+                else:
+                    linputs.append(None)
+            (kd, kv), raw, ng, ovf = group_aggregate(lkeys, lsel, linputs, specs, G)
+            vals, cnts = [], []
+            for spec, r in zip(specs, raw):
+                if spec.kind in ("count", "count_star"):
+                    vals.append(r.astype(jnp.int64))
+                    cnts.append(None)
+                else:
+                    vals.append(r[0])
+                    cnts.append(r[1])
+            live = jnp.arange(G) < ng
+            outs = []
+            for i2 in range(nkeys):
+                outs.extend([kd[i2], kv[i2]])
+            for v, c in zip(vals, cnts):
+                outs.append(v)
+                if c is not None:
+                    outs.append(c)
+            ovf_any = jax.lax.pmax(ovf.astype(jnp.int32), AXIS)
+            return tuple(outs), live, ovf_any
+
+        # outputs: keys*2 + per agg (1 for count kinds, else value+count)
+        n_out = 2 * nkeys + sum(
+            1 if s.kind in ("count", "count_star") else 2 for s in specs
+        )
+        mapped = smap(
+            shard_partial,
+            mesh=self.mesh,
+            in_specs=(PS(AXIS),) * len(flat),
+            out_specs=(tuple(PS(AXIS) for _ in range(n_out)), PS(AXIS), PS()),
+        )
+        outs, live, ovf = mapped(*flat)
+        self.overflows.append((f"agg{id(node)}", ovf))
+
+        # assemble accumulator Result
+        cols: list[Column] = []
+        layout: dict[str, int] = {}
+        i = 0
+        for ksym, kc in zip(node.group_keys, key_cols):
+            data = outs[i].astype(ksym.type.storage_dtype)
+            cols.append(Column(ksym.type, data, outs[i + 1], kc.dictionary))
+            layout[ksym.name] = len(cols) - 1
+            i += 2
+        for (vsym, csym), spec, sdict in zip(node.acc_symbols, specs, string_dicts):
+            if spec.kind in ("count", "count_star"):
+                cols.append(Column(T.BIGINT, outs[i].astype(np.int64), None))
+                layout[vsym.name] = len(cols) - 1
+                i += 1
+            else:
+                val = outs[i]
+                if getattr(val, "ndim", 1) == 2:
+                    # 128-bit limb sums -> wide (hi, lo) acc column
+                    from trino_tpu.ops import decimal128 as D128
+
+                    hi, lo = D128.limb_sums_to_pair(val)
+                    val = jnp.stack([hi, lo], axis=1)
+                elif sdict is not None:
+                    # string min/max: convert the winning rank back to a
+                    # CODE — the accumulator wire representation is codes
+                    # (ranks are dictionary-local, codes travel with it)
+                    order = np.argsort(sdict.ranks(), kind="stable")
+                    if len(order):
+                        val = jnp.asarray(order)[
+                            jnp.clip(val, 0, len(order) - 1)
+                        ].astype(jnp.int32)
+                    else:
+                        val = jnp.full(val.shape, -1, dtype=jnp.int32)
+                cols.append(Column(vsym.type, val, None, sdict))
+                layout[vsym.name] = len(cols) - 1
+                i += 1
+                cols.append(Column(T.BIGINT, outs[i].astype(np.int64), None))
+                layout[csym.name] = len(cols) - 1
+                i += 1
+        return Result(Batch(cols, cols[0].data.shape[0], live), layout)
+
+    def _agg_partial_global(
+        self, node, res, sel, agg_inputs, specs, string_dicts
+    ) -> Result:
+        """Global (ungrouped) partial: one accumulator row per shard."""
+
+        flat = [sel]
+        has_input = [p is not None for p in agg_inputs]
+        for p in agg_inputs:
+            if p is not None:
+                flat.extend([p[0], p[1]])
+
+        def shard_partial(*ops):
+            lsel = ops[0]
+            i = 1
+            linputs = []
+            for has in has_input:
+                if has:
+                    linputs.append((ops[i], ops[i + 1]))
+                    i += 2
+                else:
+                    linputs.append(None)
+            raw = global_aggregate(lsel, linputs, specs)
+            outs = []
+            for spec, r in zip(specs, raw):
+                if spec.kind in ("count", "count_star"):
+                    outs.append(r.astype(jnp.int64)[None])
+                else:
+                    v = r[0]
+                    # limb-sum matrices (sum128*) are already (1, k)
+                    outs.append(v if getattr(v, "ndim", 0) == 2 else v[None])
+                    outs.append(r[1].astype(jnp.int64)[None])
+            return tuple(outs)
+
+        n_out = sum(1 if s.kind in ("count", "count_star") else 2 for s in specs)
+        mapped = smap(
+            shard_partial,
+            mesh=self.mesh,
+            in_specs=(PS(AXIS),) * len(flat),
+            out_specs=tuple(PS(AXIS) for _ in range(n_out)),
+        )
+        outs = mapped(*flat)
+        cols: list[Column] = []
+        layout: dict[str, int] = {}
+        i = 0
+        for (vsym, csym), spec, sdict in zip(node.acc_symbols, specs, string_dicts):
+            if spec.kind in ("count", "count_star"):
+                cols.append(Column(T.BIGINT, outs[i].astype(np.int64), None))
+                layout[vsym.name] = len(cols) - 1
+                i += 1
+            else:
+                val = outs[i]
+                if getattr(val, "ndim", 1) == 2:
+                    from trino_tpu.ops import decimal128 as D128
+
+                    hi, lo = D128.limb_sums_to_pair(val)
+                    val = jnp.stack([hi, lo], axis=1)
+                elif sdict is not None:
+                    order = np.argsort(sdict.ranks(), kind="stable")
+                    if len(order):
+                        val = jnp.asarray(order)[
+                            jnp.clip(val, 0, len(order) - 1)
+                        ].astype(jnp.int32)
+                    else:
+                        val = jnp.full(val.shape, -1, dtype=jnp.int32)
+                cols.append(Column(vsym.type, val, None, sdict))
+                layout[vsym.name] = len(cols) - 1
+                i += 1
+                cols.append(Column(T.BIGINT, outs[i].astype(np.int64), None))
+                layout[csym.name] = len(cols) - 1
+                i += 1
+        n_rows = self.n
+        return Result(
+            Batch(cols, n_rows, jnp.ones(n_rows, dtype=jnp.bool_)), layout
+        )
+
+    def _agg_final(self, node: P.Aggregate, res: Result) -> Result:
+        """Combine accumulator rows (reference: AggregationNode FINAL +
+        the aggregation combine function)."""
+        sel = res.batch.selection_mask()
+        combine_inputs: list = []
+        combine_specs: list[AggSpec] = []
+        acc_cols = []
+        for (vsym, csym), (_, fn) in zip(node.acc_symbols, node.aggregates):
+            vcol = res.column(vsym)
+            acc_cols.append(vcol)
+            if fn.kind in ("count", "count_star"):
+                combine_inputs.append((vcol.data, jnp.ones_like(sel)))
+                combine_specs.append(AggSpec("sum"))
+            else:
+                ccol = res.column(csym)
+                nonempty = ccol.data > 0
+                vdata = vcol.data
+                if vcol.dictionary is not None and fn.kind in ("min", "max"):
+                    # accumulator codes -> local ranks for order combining
+                    vdata = rank_codes(vcol.dictionary, vdata)
+                    nonempty = nonempty & (vcol.data >= 0)
+                combine_inputs.append((vdata, nonempty))
+                if fn.kind in ("sum", "avg"):
+                    from trino_tpu.ops.decimal128 import is_wide_data
+
+                    combine_specs.append(
+                        AggSpec("sum128w" if is_wide_data(vdata) else "sum")
+                    )
+                else:
+                    combine_specs.append(AggSpec(fn.kind))
+                combine_inputs.append((ccol.data, jnp.ones_like(sel)))
+                combine_specs.append(AggSpec("sum"))
+
+        dicts = [c.dictionary for c in acc_cols]
+        if not node.group_keys:
+            raw = global_aggregate(sel, combine_inputs, combine_specs)
+            results = self._fold_combined(node, raw)
+            cols = self._finalize_traced(node, results, dicts, 1)
+            return Result(
+                Batch(cols, 1, jnp.ones(1, dtype=jnp.bool_)),
+                {s.name: i for i, s in enumerate(node.output_symbols)},
+            )
+
+        key_cols = [res.column(k) for k in node.group_keys]
+        keys = [(c.data, c.valid_mask()) for c in key_cols]
+        nkeys = len(keys)
+        G = self.caps.get(f"agg{id(node)}", 1 << 12)
+
+        flat = []
+        for kd, kv in keys:
+            flat.extend([kd, kv])
+        flat.append(sel)
+        for d, v in combine_inputs:
+            flat.extend([d, v])
+
+        def shard_combine(*ops):
+            i = 0
+            lkeys = []
+            for _ in range(nkeys):
+                lkeys.append((ops[i], ops[i + 1]))
+                i += 2
+            lsel = ops[i]
+            i += 1
+            linputs = []
+            for _ in combine_specs:
+                linputs.append((ops[i], ops[i + 1]))
+                i += 2
+            (kd, kv), raw, ng, ovf = group_aggregate(
+                lkeys, lsel, linputs, combine_specs, G
+            )
+            live = jnp.arange(G) < ng
+            outs = []
+            for i2 in range(nkeys):
+                outs.extend([kd[i2], kv[i2]])
+            for r in raw:
+                outs.append(r[0])  # all combine kinds return (value, cnt)
+            ovf_any = jax.lax.pmax(ovf.astype(jnp.int32), AXIS)
+            return tuple(outs), live, ovf_any
+
+        n_out = 2 * nkeys + len(combine_specs)
+        mapped = smap(
+            shard_combine,
+            mesh=self.mesh,
+            in_specs=(PS(AXIS),) * len(flat),
+            out_specs=(tuple(PS(AXIS) for _ in range(n_out)), PS(AXIS), PS()),
+        )
+        outs, live, ovf = mapped(*flat)
+        self.overflows.append((f"agg{id(node)}", ovf))
+
+        i = 0
+        cols: list[Column] = []
+        for ksym, kc in zip(node.group_keys, key_cols):
+            data = outs[i].astype(ksym.type.storage_dtype)
+            cols.append(Column(ksym.type, data, outs[i + 1], kc.dictionary))
+            i += 2
+        combined = outs[i:]
+        results = self._fold_combined(node, list(combined))
+        total = cols[0].data.shape[0] if cols else combined[0].shape[0]
+        cols.extend(self._finalize_traced(node, results, dicts, total))
+        return Result(
+            Batch(cols, total, live),
+            {s.name: i2 for i2, s in enumerate(node.output_symbols)},
+        )
+
+    def _fold_combined(self, node: P.Aggregate, raw):
+        """Fold the combine outputs back to per-aggregate (value, count).
+        ``raw`` entries are either plain arrays (per-shard path) or
+        ``(value, count)`` tuples from :func:`global_aggregate` — take the
+        value part either way."""
+
+        def val(x):
+            return x[0] if isinstance(x, tuple) else x
+
+        results = []
+        j = 0
+        for _, fn in node.aggregates:
+            if fn.kind in ("count", "count_star"):
+                results.append(val(raw[j]))
+                j += 1
+            else:
+                results.append((val(raw[j]), val(raw[j + 1])))
+                j += 2
+        return results
+
+    def _agg_single(self, node: P.Aggregate, res: Result) -> Result:
+        sel = res.batch.selection_mask()
+        agg_inputs, specs, string_dicts = self._agg_inputs(node, res)
+        if not node.group_keys:
+            raw = global_aggregate(sel, agg_inputs, specs)
+            cols = self._finalize_traced(node, raw, string_dicts, 1)
+            return Result(
+                Batch(cols, 1, jnp.ones(1, dtype=jnp.bool_)),
+                {s.name: i for i, s in enumerate(node.output_symbols)},
+            )
+        keys = [res.pair(k) for k in node.group_keys]
+        key_cols = [res.column(k) for k in node.group_keys]
+        G = self.caps.get(f"agg{id(node)}", 1 << 12)
+        (kd, kv), raw, ng, ovf = group_aggregate(keys, sel, agg_inputs, specs, G)
+        self.overflows.append((f"agg{id(node)}", ovf.astype(jnp.int32)))
+        live = jnp.arange(G) < ng
+        cols = []
+        for i, (ksym, kc) in enumerate(zip(node.group_keys, key_cols)):
+            cols.append(
+                Column(
+                    ksym.type,
+                    kd[i].astype(ksym.type.storage_dtype),
+                    kv[i],
+                    kc.dictionary,
+                )
+            )
+        cols.extend(self._finalize_traced(node, raw, string_dicts, G))
+        return Result(
+            Batch(cols, G, live),
+            {s.name: i for i, s in enumerate(node.output_symbols)},
+        )
+
+    def _finalize_traced(self, node, results, dicts, n) -> list[Column]:
+        """Traceable _finalize_aggs: avg division, NULL-on-empty, string
+        min/max rank->code mapping."""
+        cols = []
+        for (sym, fn), raw, sdict in zip(node.aggregates, results, dicts):
+            t = fn.result_type
+            if fn.kind in ("count", "count_star"):
+                data = jnp.reshape(raw, (-1,)).astype(jnp.int64)
+                cols.append(Column(t, data, None))
+                continue
+            ssum, cnt = raw
+            if getattr(ssum, "ndim", 1) == 2 and ssum.shape[1] in (3, 5):
+                # limb sums -> wide (hi, lo) lanes, in-program
+                from trino_tpu.ops import decimal128 as D128
+
+                hi, lo = D128.limb_sums_to_pair(ssum)
+                ssum = jnp.stack([hi, lo], axis=1)
+            if getattr(ssum, "ndim", 1) == 2 and ssum.shape[1] == 2:
+                cnt = jnp.reshape(cnt, (-1,))
+                valid = cnt > 0
+                if fn.kind not in ("sum", "min", "max"):
+                    raise FusedUnsupported(f"wide decimal {fn.kind}")
+                cols.append(Column(t, ssum, valid))
+                continue
+            ssum = jnp.reshape(ssum, (-1,))
+            cnt = jnp.reshape(cnt, (-1,))
+            valid = cnt > 0
+            if fn.kind == "sum":
+                cols.append(Column(t, ssum.astype(t.storage_dtype), valid))
+            elif fn.kind == "avg":
+                safe = jnp.maximum(cnt, 1)
+                if isinstance(t, T.DecimalType):
+                    data = jnp.where(
+                        ssum >= 0,
+                        (ssum + safe // 2) // safe,
+                        -((-ssum + safe // 2) // safe),
+                    ).astype(jnp.int64)
+                else:
+                    data = (ssum / safe).astype(t.storage_dtype)
+                cols.append(Column(t, data, valid))
+            else:  # min / max
+                if sdict is not None:
+                    order = np.argsort(sdict.ranks(), kind="stable")
+                    data = jnp.asarray(order)[
+                        jnp.clip(ssum, 0, len(order) - 1)
+                    ].astype(jnp.int32)
+                    cols.append(Column(t, data, valid, sdict))
+                else:
+                    cols.append(Column(t, ssum.astype(t.storage_dtype), valid))
+        return cols
+
+    # --- joins -----------------------------------------------------------
+
+    def _exec_join(self, node: P.Join) -> Result:
+        if node.join_type not in ("INNER", "LEFT") or not node.criteria:
+            raise FusedUnsupported(f"join {node.join_type}")
+        right = self._exec(node.right)
+        left = self._exec(node.left)
+        lkeys, rkeys = self._join_keys(left, right, node.criteria)
+        ph, _pv = J.hash_keys(lkeys)
+        bh, _bv = J.hash_keys(rkeys)
+        build_sharded = not (
+            isinstance(node.right, P.RemoteSource)
+            and node.right.exchange_type == "broadcast"
+        )
+        probe_cols, probe_schema = [], []
+        for s in node.left.output_symbols:
+            c = left.column(s)
+            probe_cols.extend([c.data, c.valid_mask()])
+            probe_schema.append((s, c.dictionary))
+        build_cols, build_schema = [], []
+        for s in node.right.output_symbols:
+            c = right.column(s)
+            build_cols.extend([c.data, c.valid_mask()])
+            build_schema.append((s, c.dictionary))
+        probe_keys = []
+        for kd, kv in lkeys:
+            probe_keys.extend([kd, kv])
+        build_keys = []
+        for kd, kv in rkeys:
+            build_keys.extend([kd, kv])
+
+        probe_cap = left.batch.capacity
+        default_cap = bucket_capacity(
+            max(1024, 2 * probe_cap // max(self.n, 1))
+        )
+        cap = self.caps.get(f"join{id(node)}", default_cap)
+        out_cols, out_sel, ovf = _sharded_probe(
+            self.mesh,
+            probe_cols,
+            probe_keys,
+            ph,
+            left.batch.selection_mask(),
+            build_cols,
+            build_keys,
+            bh,
+            right.batch.selection_mask(),
+            cap,
+            node.join_type,
+            len(lkeys),  # wide criteria expand into two lane pairs
+            build_sharded=build_sharded,
+        )
+        self.overflows.append((f"join{id(node)}", ovf))
+        cols: list[Column] = []
+        layout: dict[str, int] = {}
+        i = 0
+        for s, d in probe_schema:
+            cols.append(Column(s.type, out_cols[i], out_cols[i + 1], d))
+            layout[s.name] = len(cols) - 1
+            i += 2
+        for s, d in build_schema:
+            cols.append(Column(s.type, out_cols[i], out_cols[i + 1], d))
+            layout[s.name] = len(cols) - 1
+            i += 2
+        total = out_cols[0].shape[0]
+        result = Result(Batch(cols, total, out_sel), layout)
+        if node.filter is not None:
+            from trino_tpu.compiler import ExprCompiler
+            from trino_tpu.strings import lower_string_calls
+
+            expr = self._bind(node.filter, result.layout)
+            work = list(result.batch.columns)
+            expr = lower_string_calls(expr, work)
+            mask = ExprCompiler(work).predicate_mask(expr)
+            result = Result(Batch(result.batch.columns, total, mask & out_sel), layout)
+        return result
+
+    # --- output exchange --------------------------------------------------
+
+    def apply_output_exchange(self, frag: PlanFragment, res: Result) -> Result:
+        if frag.output_exchange in (None, "single"):
+            return res  # SPMD consumers read global arrays directly
+        b = res.batch
+        sel = b.selection_mask()
+        # flatten columns into 1-D lane arrays (wide DECIMAL columns ship
+        # as separate hi/lo lanes through the collective kernels)
+        arrays = []
+        schema = []  # (type, dictionary, n_lanes)
+        for c in b.columns:
+            if getattr(c.data, "ndim", 1) == 2:
+                arrays.extend([c.data[:, 0], c.data[:, 1], c.valid_mask()])
+                schema.append((c.type, c.dictionary, 2))
+            else:
+                arrays.extend([c.data, c.valid_mask()])
+                schema.append((c.type, c.dictionary, 1))
+
+        def rebuild(out):
+            cols = []
+            i = 0
+            for t, d, lanes in schema:
+                if lanes == 2:
+                    data = jnp.stack([out[i], out[i + 1]], axis=1)
+                    cols.append(Column(t, data, out[i + 2], d))
+                    i += 3
+                else:
+                    cols.append(Column(t, out[i], out[i + 1], d))
+                    i += 2
+            return cols
+
+        if frag.output_exchange == "broadcast":
+            out, out_sel = X.broadcast_all(self.mesh, arrays, sel)
+            cols = rebuild(out)
+            return Result(
+                Batch(cols, cols[0].data.shape[0], out_sel), res.layout
+            )
+        # hash: repartition by output key hash
+        key_pairs = [res.pair(s) for s in frag.output_keys]
+        khash, _ = J.hash_keys(key_pairs)
+        default_bucket = bucket_capacity(
+            max(256, 2 * b.capacity // max(self.n, 1)), minimum=256
+        )
+        bucket = self.caps.get(f"exch{frag.id}", default_bucket)
+        out, out_sel, ovf = X.hash_repartition(
+            self.mesh, arrays + [khash], khash, sel, bucket
+        )
+        self.overflows.append((f"exch{frag.id}", ovf))
+        cols = rebuild(out)
+        return Result(Batch(cols, cols[0].data.shape[0], out_sel), res.layout)

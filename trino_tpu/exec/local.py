@@ -39,6 +39,31 @@ class ExecutionError(Exception):
     pass
 
 
+def rank_codes(dictionary, data):
+    """Map dictionary codes to lexicographic ranks; safe on empty
+    dictionaries (padding rows over empty tables have no real codes)."""
+    if dictionary is None or len(dictionary) == 0:
+        return jnp.zeros(data.shape, dtype=jnp.int64)
+    r = jnp.asarray(dictionary.ranks())
+    return r[jnp.maximum(data, 0)].astype(jnp.int64)
+
+
+def sum_spec_for(fn: P.AggFunction, data) -> AggSpec:
+    """Pick the accumulation kernel for a sum/avg: 128-bit limb
+    accumulation when the declared result is a wide DECIMAL or the input
+    already carries wide (hi, lo) storage (reference:
+    DecimalSumAggregation over UnscaledDecimal128 state)."""
+    from trino_tpu.ops.decimal128 import is_wide_data
+
+    if fn.kind in ("sum", "avg"):
+        if data is not None and is_wide_data(data):
+            return AggSpec("sum128w")
+        rt = fn.result_type
+        if isinstance(rt, T.DecimalType) and rt.wide:
+            return AggSpec("sum128")
+    return AggSpec(fn.kind if fn.kind != "count_star" else "count_star")
+
+
 @dataclasses.dataclass
 class Result:
     """A materialized intermediate: batch + symbol layout."""
@@ -285,7 +310,230 @@ class LocalExecutor:
 
     # === aggregation ====================================================
     def _exec_aggregate(self, node: P.Aggregate) -> Result:
+        if node.step == "partial" and node.acc_symbols is not None:
+            return self._aggregate_partial(node, self._exec(node.source))
+        if node.step == "final" and node.acc_symbols is not None:
+            return self._aggregate_final(node, self._exec(node.source))
         return self._aggregate_result(node, self._exec(node.source))
+
+    def _aggregate_partial(self, node: P.Aggregate, res: Result) -> Result:
+        """PARTIAL step: emit accumulator columns (value, count) per agg —
+        the wire representation between fragments (reference:
+        AccumulatorStateSerializer). String min/max values travel as
+        lexicographic ranks with the dictionary attached to the column."""
+        res = self._nonempty(res)
+        sel = res.batch.selection_mask()
+        agg_inputs, specs, string_aggs = self._prepare_partial_inputs(node, res)
+        key_dicts = [res.column(k).dictionary for k in node.group_keys]
+        if not node.group_keys:
+            raw = global_aggregate(sel, agg_inputs, specs)
+            cols, layout = self._acc_columns(node, raw, 1, string_aggs)
+            return Result(Batch(cols, 1), layout)
+        keys = [res.pair(k) for k in node.group_keys]
+        max_groups = 1 << 12
+        while True:
+            (kd, kv), raw, ng, overflow = group_aggregate(
+                keys, sel, agg_inputs, specs, max_groups
+            )
+            if not bool(overflow):
+                break
+            max_groups <<= 2
+            if max_groups > (1 << 26):
+                raise ExecutionError("group-by cardinality too large")
+        ng = int(ng)
+        cols: list[Column] = []
+        layout: dict[str, int] = {}
+        for i, k in enumerate(node.group_keys):
+            valid = np.asarray(kv[i])[:ng]
+            cols.append(
+                Column(
+                    k.type,
+                    np.asarray(kd[i])[:ng].astype(k.type.storage_dtype),
+                    None if valid.all() else valid,
+                    key_dicts[i],
+                )
+            )
+            layout[k.name] = len(cols) - 1
+        acc_cols, acc_layout = self._acc_columns(node, raw, ng, string_aggs)
+        for name, i in acc_layout.items():
+            layout[name] = len(cols) + i
+        cols.extend(acc_cols)
+        return Result(Batch(cols, ng), layout)
+
+    def _prepare_partial_inputs(self, node: P.Aggregate, res: Result):
+        """Like the single-step input prep but without DISTINCT handling
+        (the fragmenter never splits DISTINCT aggregates)."""
+        agg_inputs, specs, string_aggs = [], [], []
+        for _, fn in node.aggregates:
+            if fn.kind == "count_star":
+                if fn.filter is not None:
+                    fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
+                    ones = jnp.ones(res.batch.capacity, dtype=jnp.int64)
+                    agg_inputs.append((ones, fc.data & fc.valid_mask()))
+                    specs.append(AggSpec("count"))
+                    string_aggs.append(None)
+                    continue
+                agg_inputs.append(None)
+                specs.append(AggSpec("count_star"))
+                string_aggs.append(None)
+                continue
+            sym = P.Symbol(fn.argument.name, fn.argument.type)
+            c = res.column(sym)
+            data, valid = c.data, c.valid_mask()
+            if c.dictionary is not None and fn.kind in ("min", "max"):
+                data = rank_codes(c.dictionary, data)
+                string_aggs.append(c.dictionary)
+            else:
+                string_aggs.append(None)
+            if fn.filter is not None:
+                fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
+                valid = valid & fc.data & fc.valid_mask()
+            agg_inputs.append((data, valid))
+            specs.append(sum_spec_for(fn, data))
+        return agg_inputs, specs, string_aggs
+
+    def _acc_columns(self, node: P.Aggregate, raw, n, string_aggs):
+        cols: list[Column] = []
+        layout: dict[str, int] = {}
+        for (vsym, csym), (_, fn), r, sdict in zip(
+            node.acc_symbols, node.aggregates, raw, string_aggs
+        ):
+            if fn.kind in ("count", "count_star"):
+                data = np.asarray(r).reshape(-1)[:n].astype(np.int64)
+                cols.append(Column(T.BIGINT, data))
+                layout[vsym.name] = len(cols) - 1
+                continue
+            val, cnt = r
+            val_arr = np.asarray(val)
+            cnt = np.asarray(cnt).reshape(-1)[:n].astype(np.int64)
+            if val_arr.ndim == 2 and val_arr.shape[1] in (3, 5):
+                # limb accumulator -> wide (hi, lo) acc column on the wire
+                from trino_tpu.ops import decimal128 as D128
+
+                if val_arr.shape[1] == 3:
+                    ints = D128.narrow_sums_to_ints(val_arr[:n])
+                else:
+                    ints = D128.wide_sums_to_ints(val_arr[:n])
+                cols.append(Column(vsym.type, D128.wide_from_ints(ints), None))
+                layout[vsym.name] = len(cols) - 1
+                cols.append(Column(T.BIGINT, cnt))
+                layout[csym.name] = len(cols) - 1
+                continue
+            if val_arr.ndim == 2 and val_arr.shape[1] == 2:
+                # wide min/max extrema: already (hi, lo)
+                cols.append(Column(vsym.type, val_arr[:n], None))
+                layout[vsym.name] = len(cols) - 1
+                cols.append(Column(T.BIGINT, cnt))
+                layout[csym.name] = len(cols) - 1
+                continue
+            val = val_arr.reshape(-1)[:n]
+            if sdict is not None:
+                # string min/max computed over local ranks — convert the
+                # winning rank back to a CODE for the wire: ranks are only
+                # meaningful against this node's dictionary, codes travel
+                # with it (page serde / concat merge remap codes correctly)
+                order = np.argsort(sdict.ranks(), kind="stable")
+                if len(order):
+                    val = order[np.clip(val, 0, len(order) - 1)].astype(np.int32)
+                else:
+                    val = np.full(val.shape, -1, dtype=np.int32)
+                val = np.where(cnt > 0, val, -1).astype(np.int32)
+                cols.append(Column(vsym.type, val, cnt > 0, sdict))
+            else:
+                cols.append(Column(vsym.type, val, None, None))
+            layout[vsym.name] = len(cols) - 1
+            cols.append(Column(T.BIGINT, cnt))
+            layout[csym.name] = len(cols) - 1
+        return cols, layout
+
+    def _aggregate_final(self, node: P.Aggregate, res: Result) -> Result:
+        """FINAL step: combine accumulator rows shipped from partials."""
+        res = self._nonempty(res)
+        sel = res.batch.selection_mask()
+        combine_inputs: list = []
+        combine_specs: list[AggSpec] = []
+        dicts = []
+        for (vsym, csym), (_, fn) in zip(node.acc_symbols, node.aggregates):
+            vcol = res.column(vsym)
+            dicts.append(vcol.dictionary)
+            if fn.kind in ("count", "count_star"):
+                combine_inputs.append((vcol.data, vcol.valid_mask()))
+                combine_specs.append(AggSpec("sum"))
+            else:
+                ccol = res.column(csym)
+                nonempty = ccol.data > 0
+                vdata = vcol.data
+                if vcol.dictionary is not None and fn.kind in ("min", "max"):
+                    # codes -> ranks against the (possibly merged) local
+                    # dictionary before order-based combining
+                    vdata = rank_codes(vcol.dictionary, vdata)
+                    nonempty = nonempty & (vcol.data >= 0)
+                combine_inputs.append((vdata, nonempty))
+                if fn.kind in ("sum", "avg"):
+                    from trino_tpu.ops.decimal128 import is_wide_data
+
+                    combine_specs.append(
+                        AggSpec("sum128w" if is_wide_data(vdata) else "sum")
+                    )
+                else:
+                    combine_specs.append(AggSpec(fn.kind))
+                combine_inputs.append((ccol.data, ccol.valid_mask()))
+                combine_specs.append(AggSpec("sum"))
+
+        def fold(raw):
+            out = []
+            j = 0
+            for _, fn in node.aggregates:
+                if fn.kind in ("count", "count_star"):
+                    v = raw[j]
+                    out.append(v[0] if isinstance(v, tuple) else v)
+                    j += 1
+                else:
+                    v, c = raw[j], raw[j + 1]
+                    out.append(
+                        (
+                            v[0] if isinstance(v, tuple) else v,
+                            c[0] if isinstance(c, tuple) else c,
+                        )
+                    )
+                    j += 2
+            return out
+
+        if not node.group_keys:
+            raw = fold(global_aggregate(sel, combine_inputs, combine_specs))
+            cols = self._finalize_aggs(node, raw, 1, None, dicts)
+            return Result(
+                Batch(cols, 1),
+                {s.name: i for i, s in enumerate(node.output_symbols)},
+            )
+        keys = [res.pair(k) for k in node.group_keys]
+        key_dicts = [res.column(k).dictionary for k in node.group_keys]
+        max_groups = 1 << 12
+        while True:
+            (kd, kv), raw, ng, overflow = group_aggregate(
+                keys, sel, combine_inputs, combine_specs, max_groups
+            )
+            if not bool(overflow):
+                break
+            max_groups <<= 2
+            if max_groups > (1 << 26):
+                raise ExecutionError("group-by cardinality too large")
+        ng = int(ng)
+        cols = []
+        for i, k in enumerate(node.group_keys):
+            valid = np.asarray(kv[i])[:ng]
+            cols.append(
+                Column(
+                    k.type,
+                    np.asarray(kd[i])[:ng].astype(k.type.storage_dtype),
+                    None if valid.all() else valid,
+                    key_dicts[i],
+                )
+            )
+        cols.extend(self._finalize_aggs(node, fold(raw), ng, None, dicts))
+        return Result(
+            Batch(cols, ng), {s.name: i for i, s in enumerate(node.output_symbols)}
+        )
 
     def _spill_aggregate(self, node: P.Aggregate, res: Result) -> Result:
         """Partitioned (spill-to-host) group-by: rows hash-partitioned by
@@ -361,8 +609,7 @@ class LocalExecutor:
                 data, valid = c.data, c.valid_mask()
                 if c.dictionary is not None and fn.kind in ("min", "max"):
                     # strings: min/max over lexicographic ranks, map back after
-                    r = jnp.asarray(c.dictionary.ranks())
-                    data = r[jnp.maximum(data, 0)]
+                    data = rank_codes(c.dictionary, data)
                     string_aggs.append(c.dictionary)
                 else:
                     string_aggs.append(None)
@@ -381,7 +628,7 @@ class LocalExecutor:
                     valid = valid & first
                 pair = (data, valid)
             agg_inputs.append(pair)
-            specs.append(AggSpec(fn.kind if fn.kind != "count_star" else "count_star"))
+            specs.append(sum_spec_for(fn, pair[0] if pair else None))
 
         if not node.group_keys:
             results = global_aggregate(sel, agg_inputs, specs)
@@ -431,6 +678,30 @@ class LocalExecutor:
             ssum, cnt = raw
             cnt_np = np.asarray(cnt).reshape(-1)[:n]
             valid = cnt_np > 0
+            ssum_arr = np.asarray(ssum)
+            if ssum_arr.ndim == 2 and ssum_arr.shape[1] in (3, 5):
+                # 128-bit limb accumulation: exact host reconstruction
+                from trino_tpu.ops import decimal128 as D128
+
+                if ssum_arr.shape[1] == 3:
+                    ints = D128.narrow_sums_to_ints(ssum_arr[:n])
+                else:
+                    ints = D128.wide_sums_to_ints(ssum_arr[:n])
+                if fn.kind == "avg":
+                    vals = []
+                    for s_i, c_i in zip(ints, cnt_np):
+                        c_i = max(int(c_i), 1)
+                        q, r = divmod(abs(s_i), c_i)
+                        q = q + (1 if 2 * r >= c_i else 0)
+                        vals.append(q if s_i >= 0 else -q)
+                    ints = vals
+                wide_t = isinstance(t, T.DecimalType) and t.wide
+                if wide_t:
+                    data = D128.wide_from_ints(ints)
+                else:
+                    data = np.asarray(ints, dtype=np.int64)
+                cols.append(Column(t, data, None if valid.all() else valid))
+                continue
             if fn.kind == "sum":
                 data = np.asarray(ssum).reshape(-1)[:n].astype(t.storage_dtype)
                 cols.append(Column(t, data, None if valid.all() else valid))
@@ -448,11 +719,21 @@ class LocalExecutor:
                     data = (s_np / safe).astype(t.storage_dtype)
                 cols.append(Column(t, data, None if valid.all() else valid))
             else:  # min / max
-                data = np.asarray(ssum).reshape(-1)[:n]
+                ssum_mm = np.asarray(ssum)
+                if ssum_mm.ndim == 2 and ssum_mm.shape[1] == 2:
+                    # wide (hi, lo) extrema pass through as wide storage
+                    cols.append(
+                        Column(t, ssum_mm[:n], None if valid.all() else valid)
+                    )
+                    continue
+                data = ssum_mm.reshape(-1)[:n]
                 if sdict is not None:
                     # map ranks back to codes
                     order = np.argsort(sdict.ranks(), kind="stable")
-                    data = order[np.clip(data, 0, len(order) - 1)].astype(np.int32)
+                    if len(order):
+                        data = order[np.clip(data, 0, len(order) - 1)].astype(np.int32)
+                    else:
+                        data = np.full(data.shape, -1, dtype=np.int32)
                     cols.append(
                         Column(t, data, None if valid.all() else valid, sdict)
                     )
@@ -630,8 +911,10 @@ class LocalExecutor:
             )
             res = self._exec_join(flipped)
             return res  # layout covers both sides; order fixed by Output
-        if node.join_type not in ("INNER", "LEFT"):
+        if node.join_type not in ("INNER", "LEFT", "FULL"):
             raise ExecutionError(f"join type {node.join_type} not supported yet")
+        if node.join_type == "FULL" and node.filter is not None:
+            raise ExecutionError("FULL OUTER JOIN with a non-equi ON filter")
         right = self._exec(node.right)  # build first: enables dynamic filter
         left_plan = self._apply_dynamic_filters(node, right)
         left = self._exec(left_plan)  # probe
@@ -641,6 +924,7 @@ class LocalExecutor:
             self._reservations[id(node.left)] = self._reservations.pop(id(left_plan))
         if (
             node.criteria
+            and node.join_type != "FULL"  # spill drops empty-probe partitions
             and self.session.get("spill_enabled")
             and int(left.batch.count_rows()) + int(right.batch.count_rows())
             > int(self.session.get("spill_threshold_rows"))
@@ -748,7 +1032,8 @@ class LocalExecutor:
         while True:
             ppos, bpos, osel, total, ovf = J.probe_join(
                 sbk, sbi, bcount, ph, pv, probe_sel,
-                out_capacity, "left" if node.join_type == "LEFT" else "inner",
+                out_capacity,
+                "left" if node.join_type in ("LEFT", "FULL") else "inner",
             )
             if not bool(ovf):
                 break
@@ -788,6 +1073,44 @@ class LocalExecutor:
         out = Result(
             Batch(cols, out_capacity, osel_np), layout
         )
+        if node.join_type == "FULL":
+            # append null-extended unmatched build rows (the reference's
+            # LookupJoinOperator FULL mode replays unvisited positions,
+            # LookupJoinOperator.java:71)
+            build_n = right.batch.capacity
+            matched = np.zeros(build_n, dtype=bool)
+            matched[bpos_np[osel_np & ~is_outer]] = True
+            build_sel = np.asarray(right.batch.selection_mask())
+            unmatched = np.nonzero(build_sel & ~matched)[0]
+            if unmatched.size:
+                n_left = len(node.left.output_symbols)
+                cols2 = []
+                for j, c in enumerate(out.batch.columns):
+                    data, valid = c.to_numpy()
+                    if j < n_left:  # probe columns: NULL
+                        add_shape = (unmatched.size,) + data.shape[1:]
+                        add = np.zeros(add_shape, dtype=data.dtype)
+                        addv = np.zeros(unmatched.size, dtype=bool)
+                    else:  # build columns: gather the unmatched rows
+                        rc = right.column(node.right.output_symbols[j - n_left])
+                        rd, rv = rc.to_numpy()
+                        add, addv = rd[unmatched], rv[unmatched]
+                    cols2.append(
+                        Column(
+                            c.type,
+                            np.concatenate([data, add]),
+                            np.concatenate([valid, addv]),
+                            c.dictionary,
+                        )
+                    )
+                keep = np.concatenate(
+                    [osel_np, np.ones(unmatched.size, dtype=bool)]
+                )
+                return Result(
+                    Batch(cols2, out.batch.num_rows + unmatched.size, keep),
+                    out.layout,
+                )
+            return out
         if node.filter is not None:
             from trino_tpu.strings import lower_string_calls
 
@@ -850,6 +1173,40 @@ class LocalExecutor:
         for ls, rs in criteria:
             lc = left.column(ls)
             rc = right.column(rs)
+            if getattr(lc.data, "ndim", 1) == 2 or getattr(rc.data, "ndim", 1) == 2:
+                # wide DECIMAL join keys: one (hi) + one (lo) int64 key
+                # pair per criterion — hashing and equality verification
+                # treat the lanes as two ordinary keys
+                if isinstance(ls.type, (T.DoubleType, T.RealType)) or isinstance(
+                    rs.type, (T.DoubleType, T.RealType)
+                ):
+                    raise ExecutionError(
+                        "join between DECIMAL(38) and floating point"
+                    )
+                from trino_tpu.ops import decimal128 as D128
+
+                ls_s = ls.type.scale if isinstance(ls.type, T.DecimalType) else 0
+                rs_s = rs.type.scale if isinstance(rs.type, T.DecimalType) else 0
+                s = max(ls_s, rs_s)
+
+                def lanes(col, scale):
+                    if getattr(col.data, "ndim", 1) == 2:
+                        hi, lo = col.data[:, 0], col.data[:, 1]
+                    else:
+                        hi, lo = D128.widen_i64(col.data.astype(jnp.int64))
+                    if s > scale:
+                        hi, lo = D128.rescale_up_wide(hi, lo, s - scale)
+                    return hi, lo
+
+                lhi, llo = lanes(lc, ls_s)
+                rhi, rlo = lanes(rc, rs_s)
+                lv = lc.valid_mask()
+                rv = rc.valid_mask()
+                lkeys.append((lhi, lv))
+                lkeys.append((llo, lv))
+                rkeys.append((rhi, rv))
+                rkeys.append((rlo, rv))
+                continue
             ld, lv = lc.data, lc.valid_mask()
             rd, rv = rc.data, rc.valid_mask()
             if lc.dictionary is not None or rc.dictionary is not None:

@@ -27,6 +27,9 @@ import jax.numpy as jnp
 from trino_tpu import types as T
 
 # Supported aggregate kinds and their (partial, final-combine) decomposition.
+# sum128 / sum128w are the exact 128-bit accumulation variants for wide
+# DECIMAL results (narrow int64 input / wide (n,2) input respectively) —
+# see trino_tpu.ops.decimal128 (UnscaledDecimal128Arithmetic semantics).
 AGG_KINDS = ("sum", "count", "count_star", "min", "max", "avg")
 
 
@@ -40,11 +43,16 @@ class AggSpec:
 
 def _sortable_keys(keys: Sequence[tuple[jnp.ndarray, jnp.ndarray]], sel: jnp.ndarray):
     """Build lax.sort operand list: selection first (selected rows to the
-    front), then per-key (valid, data) pairs so NULL keys form one group."""
+    front), then per-key (valid, data) pairs so NULL keys form one group.
+    Wide DECIMAL keys ((n, 2) lanes) contribute one operand per lane."""
     ops = [~sel]  # False (selected) sorts before True
     for data, valid in keys:
         ops.append(~valid)  # non-null first; all nulls group together
-        ops.append(jnp.where(valid, data, jnp.zeros_like(data)))
+        if getattr(data, "ndim", 1) == 2:
+            for lane in (data[:, 0], data[:, 1]):
+                ops.append(jnp.where(valid, lane, jnp.zeros_like(lane)))
+        else:
+            ops.append(jnp.where(valid, data, jnp.zeros_like(data)))
     return ops
 
 
@@ -74,7 +82,21 @@ def group_aggregate(
     """
     n = sel.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    ops = _sortable_keys(keys, sel)
+    # build sort operands, tracking each key's operand positions (wide
+    # DECIMAL keys contribute two value lanes)
+    ops = [~sel]
+    key_pos: list[tuple[int, tuple[int, ...]]] = []  # (valid_idx, data_idx...)
+    for data, valid in keys:
+        vi = len(ops)
+        ops.append(~valid)
+        if getattr(data, "ndim", 1) == 2:
+            di = (len(ops), len(ops) + 1)
+            for lane in (data[:, 0], data[:, 1]):
+                ops.append(jnp.where(valid, lane, jnp.zeros_like(lane)))
+        else:
+            di = (len(ops),)
+            ops.append(jnp.where(valid, data, jnp.zeros_like(data)))
+        key_pos.append((vi, di))
     num_keys = len(ops)
     sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=num_keys)
     perm = sorted_ops[-1]
@@ -94,16 +116,23 @@ def group_aggregate(
 
     # group key output: scatter first-row-of-group values
     out_key_data, out_key_valid = [], []
-    for ki, (data, valid) in enumerate(keys):
-        s_valid = ~sorted_ops[1 + 2 * ki]
-        s_data = sorted_ops[2 + 2 * ki]
-        kd = jnp.zeros((max_groups,), dtype=data.dtype).at[group_id].set(
-            s_data, mode="drop"
-        )
+    for (data, valid), (vi, di) in zip(keys, key_pos):
+        s_valid = ~sorted_ops[vi]
         kv = jnp.zeros((max_groups,), dtype=jnp.bool_).at[group_id].set(
             s_valid, mode="drop"
         )
-        out_key_data.append(kd)
+        lanes_out = []
+        for d_idx in di:
+            s_data = sorted_ops[d_idx]
+            lanes_out.append(
+                jnp.zeros((max_groups,), dtype=s_data.dtype).at[group_id].set(
+                    s_data, mode="drop"
+                )
+            )
+        if len(lanes_out) == 2:
+            out_key_data.append(jnp.stack(lanes_out, axis=1).astype(data.dtype))
+        else:
+            out_key_data.append(lanes_out[0].astype(data.dtype))
         out_key_valid.append(kv)
 
     results = []
@@ -117,6 +146,20 @@ def group_aggregate(
         data, valid = pair
         s_data = data[perm]
         s_valid = valid[perm]
+        if spec.kind in ("sum128", "sum128w"):
+            from trino_tpu.ops import decimal128 as D
+
+            cnt = jax.ops.segment_sum(
+                s_valid.astype(jnp.int64), group_id, num_segments=max_groups
+            )
+            if spec.kind == "sum128":
+                limbs = D.narrow_limb_sums(s_data, s_valid, group_id, max_groups)
+            else:
+                limbs = D.wide_limb_sums(
+                    s_data[:, 0], s_data[:, 1], s_valid, group_id, max_groups
+                )
+            results.append((limbs, cnt))
+            continue
         if spec.kind == "count":
             results.append(
                 jax.ops.segment_sum(
@@ -137,20 +180,26 @@ def group_aggregate(
                     s_valid.astype(jnp.int64), group_id, num_segments=max_groups
                 )
                 results.append((ssum, cnt))
-        elif spec.kind == "min":
-            masked = jnp.where(s_valid, s_data, _max_ident(s_data.dtype))
-            m = jax.ops.segment_min(masked, group_id, num_segments=max_groups)
+        elif spec.kind in ("min", "max"):
             cnt = jax.ops.segment_sum(
                 s_valid.astype(jnp.int64), group_id, num_segments=max_groups
             )
-            results.append((m, cnt))
-        elif spec.kind == "max":
-            masked = jnp.where(s_valid, s_data, _min_ident(s_data.dtype))
-            m = jax.ops.segment_max(masked, group_id, num_segments=max_groups)
-            cnt = jax.ops.segment_sum(
-                s_valid.astype(jnp.int64), group_id, num_segments=max_groups
-            )
-            results.append((m, cnt))
+            if getattr(s_data, "ndim", 1) == 2:
+                from trino_tpu.ops.decimal128 import segment_minmax_wide
+
+                bh, bl = segment_minmax_wide(
+                    s_data[:, 0], s_data[:, 1], s_valid, group_id,
+                    max_groups, spec.kind,
+                )
+                results.append((jnp.stack([bh, bl], axis=1), cnt))
+            elif spec.kind == "min":
+                masked = jnp.where(s_valid, s_data, _max_ident(s_data.dtype))
+                m = jax.ops.segment_min(masked, group_id, num_segments=max_groups)
+                results.append((m, cnt))
+            else:
+                masked = jnp.where(s_valid, s_data, _min_ident(s_data.dtype))
+                m = jax.ops.segment_max(masked, group_id, num_segments=max_groups)
+                results.append((m, cnt))
         else:
             raise NotImplementedError(spec.kind)
     return (out_key_data, out_key_valid), results, num_groups, overflow
@@ -198,11 +247,26 @@ def global_aggregate(
         data, valid = pair
         use = valid & sel
         cnt = jnp.sum(use.astype(jnp.int64))
+        if spec.kind in ("sum128", "sum128w"):
+            from trino_tpu.ops import decimal128 as D
+
+            gid = jnp.zeros(sel.shape[0], dtype=jnp.int32)
+            if spec.kind == "sum128":
+                limbs = D.narrow_limb_sums(data, use, gid, 1)
+            else:
+                limbs = D.wide_limb_sums(data[:, 0], data[:, 1], use, gid, 1)
+            results.append((limbs, cnt))
+            continue
         if spec.kind == "count":
             results.append(cnt)
         elif spec.kind in ("sum", "avg"):
             s = jnp.sum(jnp.where(use, data, jnp.zeros_like(data)))
             results.append((s, cnt))
+        elif spec.kind in ("min", "max") and getattr(data, "ndim", 1) == 2:
+            from trino_tpu.ops.decimal128 import global_minmax_wide
+
+            bh, bl = global_minmax_wide(data[:, 0], data[:, 1], use, spec.kind)
+            results.append((jnp.stack([bh, bl], axis=1), cnt))
         elif spec.kind == "min":
             results.append((jnp.min(jnp.where(use, data, _max_ident(data.dtype))), cnt))
         elif spec.kind == "max":

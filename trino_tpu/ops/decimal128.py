@@ -1,0 +1,308 @@
+"""Int128 decimal arithmetic on int64-limb pairs.
+
+Reference semantics: ``core/trino-spi/src/main/java/io/trino/spi/type/
+UnscaledDecimal128Arithmetic.java`` — DECIMAL(p>18) unscaled values as
+128-bit integers. TPU-first representation:
+
+- A *wide* value is two int64 lanes ``(hi, lo)`` holding the two's
+  complement 128-bit integer (``lo`` interpreted unsigned). A wide COLUMN
+  is an ``(n, 2)`` int64 array — two fixed-width lanes, no dynamic width.
+- Multiplication uses the classic four-product 32-bit-limb schoolbook in
+  uint64 lanes (every partial product of 32-bit limbs fits 64 bits).
+- SUM accumulation decomposes values into four unsigned 32-bit limbs and
+  ``segment_sum``s each limb independently (a limb column sums 2^31 rows
+  without overflowing int64); carry propagation happens once per *group*
+  on the host with exact Python integers. This keeps the per-row work
+  MXU/VPU-friendly and the exactness cost O(groups), not O(rows).
+
+All two's-complement modular identities make the limb sums exact mod
+2^128; true sums of DECIMAL(38) values fit 127 bits, so reconstruction is
+exact.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MASK32 = np.int64(0xFFFFFFFF)
+_TWO127 = 1 << 127
+_TWO128 = 1 << 128
+_SIGNBIT = np.int64(np.uint64(1 << 63))  # int64 min as bit pattern
+
+
+# --- scalar conversions (host) ----------------------------------------------
+
+
+def int_to_pair(v: int) -> tuple[int, int]:
+    """Python int -> (hi, lo) two's-complement int64 scalars."""
+    u = v & (_TWO128 - 1)
+    lo = u & 0xFFFFFFFFFFFFFFFF
+    hi = u >> 64
+    if lo >= 1 << 63:
+        lo -= 1 << 64
+    if hi >= 1 << 63:
+        hi -= 1 << 64
+    return hi, lo
+
+
+def pair_to_int(hi: int, lo: int) -> int:
+    """(hi, lo) int64 scalars -> Python int (signed 128-bit)."""
+    u = ((int(hi) & 0xFFFFFFFFFFFFFFFF) << 64) | (int(lo) & 0xFFFFFFFFFFFFFFFF)
+    return u - _TWO128 if u >= _TWO127 else u
+
+
+def wide_from_ints(values: Sequence[int]) -> np.ndarray:
+    """Python ints -> (n, 2) int64 wide column data."""
+    out = np.empty((len(values), 2), dtype=np.int64)
+    for i, v in enumerate(values):
+        hi, lo = int_to_pair(int(v))
+        out[i, 0] = hi
+        out[i, 1] = lo
+    return out
+
+
+def wide_to_ints(arr: np.ndarray) -> list[int]:
+    arr = np.asarray(arr)
+    return [pair_to_int(arr[i, 0], arr[i, 1]) for i in range(arr.shape[0])]
+
+
+def is_wide_data(data) -> bool:
+    return getattr(data, "ndim", 1) == 2
+
+
+# --- device kernels ---------------------------------------------------------
+
+
+def _u(x):
+    return x.astype(jnp.uint64)
+
+
+def mulhi_u64(a, b):
+    """High 64 bits of the unsigned 64x64 product (32-bit limb schoolbook)."""
+    a, b = _u(a), _u(b)
+    a_lo = a & jnp.uint64(0xFFFFFFFF)
+    a_hi = a >> jnp.uint64(32)
+    b_lo = b & jnp.uint64(0xFFFFFFFF)
+    b_hi = b >> jnp.uint64(32)
+    p0 = a_lo * b_lo
+    p1 = a_lo * b_hi
+    p2 = a_hi * b_lo
+    p3 = a_hi * b_hi
+    cy = ((p0 >> jnp.uint64(32)) + (p1 & jnp.uint64(0xFFFFFFFF)) + (p2 & jnp.uint64(0xFFFFFFFF))) >> jnp.uint64(32)
+    return (p3 + (p1 >> jnp.uint64(32)) + (p2 >> jnp.uint64(32)) + cy).astype(
+        jnp.int64
+    )
+
+
+def mul_i64_to_i128(a, b):
+    """Signed 64x64 -> exact 128-bit product as (hi, lo) int64 lanes."""
+    lo = (_u(a) * _u(b)).astype(jnp.int64)  # wrapping low 64
+    hi = mulhi_u64(a, b)
+    # signed correction: for two's complement, hi_signed =
+    # hi_unsigned - (a<0 ? b : 0) - (b<0 ? a : 0)
+    hi = hi - jnp.where(a < 0, b, jnp.zeros_like(b)) - jnp.where(
+        b < 0, a, jnp.zeros_like(a)
+    )
+    return hi, lo
+
+
+def mul_i64_overflows(a, b):
+    """True where the signed 64x64 product does not fit int64."""
+    hi, lo = mul_i64_to_i128(a, b)
+    return hi != (lo >> jnp.int64(63))
+
+
+def add128(hi1, lo1, hi2, lo2):
+    """(hi,lo) + (hi,lo) two's complement with carry."""
+    lo = (_u(lo1) + _u(lo2)).astype(jnp.int64)
+    carry = (_u(lo) < _u(lo1)).astype(jnp.int64)
+    hi = hi1 + hi2 + carry
+    return hi, lo
+
+
+def mul128_by_i64(hi, lo, m):
+    """Low 128 bits of (hi,lo) * m (signed). Exact when the true product
+    fits 128 bits (the caller's precision cap guarantees it)."""
+    p_hi, p_lo = mul_i64_to_i128(lo, m)
+    # correction: lo was treated signed by mul_i64_to_i128 but represents an
+    # unsigned limb; add back m << 64 where lo's sign bit was set
+    p_hi = p_hi + jnp.where(lo < 0, m, jnp.zeros_like(m))
+    hi_lo = (_u(hi) * _u(m)).astype(jnp.int64)  # wrapping: low 64 of hi*m
+    return p_hi + hi_lo, p_lo
+
+
+def widen_i64(v):
+    """int64 -> (hi, lo) sign-extended."""
+    return v >> jnp.int64(63), v
+
+
+def neg128(hi, lo):
+    nlo = (~_u(lo) + jnp.uint64(1)).astype(jnp.int64)
+    carry = (nlo == 0).astype(jnp.int64)
+    nhi = (~_u(hi)).astype(jnp.int64) + carry
+    return nhi, nlo
+
+
+def compare128(hi1, lo1, hi2, lo2):
+    """-1 / 0 / +1 sign array for signed 128-bit comparison."""
+    hi_lt = hi1 < hi2
+    hi_gt = hi1 > hi2
+    lo_lt = _u(lo1) < _u(lo2)
+    lo_gt = _u(lo1) > _u(lo2)
+    lt = hi_lt | (~hi_gt & lo_lt)
+    gt = hi_gt | (~hi_lt & lo_gt)
+    return jnp.where(lt, -1, jnp.where(gt, 1, 0)).astype(jnp.int32)
+
+
+def sort_operands_wide(hi, lo, ascending: bool = True):
+    """Sort keys realizing signed-128 order under ascending lax.sort:
+    (hi signed, lo as-unsigned-shifted-to-signed)."""
+    lo_key = lo ^ _SIGNBIT  # unsigned order in signed lanes
+    if not ascending:
+        return [-1 - hi, jnp.int64(-1) - lo_key]
+    return [hi, lo_key]
+
+
+# --- accumulation -----------------------------------------------------------
+
+
+def _limbs32_from_i64(v):
+    """int64 values -> two unsigned 32-bit limbs in int64 lanes."""
+    u = _u(v)
+    return (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64), (
+        u >> jnp.uint64(32)
+    ).astype(jnp.int64)
+
+
+def narrow_limb_sums(data, weights_valid, group_id, max_groups):
+    """Per-group exact sums of int64 values via 32-bit limb accumulation.
+
+    Returns (G, 3) int64: [limb0_sum, limb1_sum, neg_count] where the true
+    per-group sum = limb0 + limb1*2^32 - neg_count*2^64 (two's complement
+    reconstruction of the sign-extended 64-bit values, exact in Python)."""
+    l0, l1 = _limbs32_from_i64(data)
+    z = jnp.zeros_like(data)
+    l0 = jnp.where(weights_valid, l0, z)
+    l1 = jnp.where(weights_valid, l1, z)
+    neg = jnp.where(weights_valid & (data < 0), jnp.ones_like(data), z)
+    s0 = jax.ops.segment_sum(l0, group_id, num_segments=max_groups)
+    s1 = jax.ops.segment_sum(l1, group_id, num_segments=max_groups)
+    sn = jax.ops.segment_sum(neg, group_id, num_segments=max_groups)
+    return jnp.stack([s0, s1, sn], axis=1)
+
+
+def wide_limb_sums(hi, lo, weights_valid, group_id, max_groups):
+    """Per-group sums of (hi, lo) wide values as 5 limb columns:
+    [lo0, lo1, hi0, hi1, hi_neg]; true sum = lo0 + lo1*2^32 +
+    (hi0 + hi1*2^32 - hi_neg*2^64)*2^64 (exact in Python)."""
+    lo0, lo1 = _limbs32_from_i64(lo)
+    hi0, hi1 = _limbs32_from_i64(hi)
+    z = jnp.zeros_like(lo)
+    lo0 = jnp.where(weights_valid, lo0, z)
+    lo1 = jnp.where(weights_valid, lo1, z)
+    hi0 = jnp.where(weights_valid, hi0, z)
+    hi1 = jnp.where(weights_valid, hi1, z)
+    neg = jnp.where(weights_valid & (hi < 0), jnp.ones_like(lo), z)
+    cols = [
+        jax.ops.segment_sum(c, group_id, num_segments=max_groups)
+        for c in (lo0, lo1, hi0, hi1, neg)
+    ]
+    return jnp.stack(cols, axis=1)
+
+
+def _shl32_128(v):
+    """int64 v << 32 as a 128-bit (hi, lo) pair."""
+    hi = v >> jnp.int64(32)  # arithmetic shift keeps the sign
+    lo = (_u(v) << jnp.uint64(32)).astype(jnp.int64)
+    return hi, lo
+
+
+def limb_sums_to_pair(limbs):
+    """Device-side reconstruction of limb sums into (hi, lo) lanes.
+
+    Accepts the (G, 3) output of :func:`narrow_limb_sums`
+    (``total = s0 + s1*2^32 - neg*2^64``) or the (G, 5) output of
+    :func:`wide_limb_sums`
+    (``total = lo0 + lo1*2^32 + (hi0 + hi1*2^32 - neg*2^64) * 2^64``).
+    Exact mod 2^128; true DECIMAL(38) sums fit 127 bits."""
+    k = limbs.shape[1]
+    if k == 3:
+        s0, s1, sn = limbs[:, 0], limbs[:, 1], limbs[:, 2]
+        hi, lo = widen_i64(s0)
+        h2, l2 = _shl32_128(s1)
+        hi, lo = add128(hi, lo, h2, l2)
+        return hi - sn, lo
+    lo0, lo1, hi0, hi1, neg = (limbs[:, i] for i in range(5))
+    lp_hi, lp_lo = widen_i64(lo0)
+    h2, l2 = _shl32_128(lo1)
+    lp_hi, lp_lo = add128(lp_hi, lp_lo, h2, l2)
+    # hi_part as 128-bit: hi0 + hi1<<32 - neg<<64; only its LOW 64 bits
+    # contribute (they land in the hi lane of the final value)
+    hp_hi, hp_lo = widen_i64(hi0)
+    h3, l3 = _shl32_128(hi1)
+    hp_hi, hp_lo = add128(hp_hi, hp_lo, h3, l3)
+    hp_lo = hp_lo  # - neg<<64 only affects bits >= 64 of hi_part: drop
+    return (lp_hi + hp_lo), lp_lo
+
+
+def rescale_up_wide(hi, lo, digits: int):
+    """Multiply a wide value by 10**digits (digits >= 0), staying exact
+    while the true result fits 128 bits."""
+    while digits > 0:
+        step = min(digits, 18)
+        hi, lo = mul128_by_i64(hi, lo, jnp.int64(10**step))
+        digits -= step
+    return hi, lo
+
+
+def segment_minmax_wide(hi, lo, use, group_id, max_groups, kind: str):
+    """Per-group min/max of (hi, lo) wide values: lexicographic two-pass —
+    extreme of the signed hi lane, then extreme of the unsigned lo lane
+    among rows tied on hi. Returns (hi_out, lo_out) of shape (G,)."""
+    i64 = jnp.int64
+    if kind == "max":
+        ident_hi = jnp.asarray(np.iinfo(np.int64).min, dtype=i64)
+        seg = jax.ops.segment_max
+    else:
+        ident_hi = jnp.asarray(np.iinfo(np.int64).max, dtype=i64)
+        seg = jax.ops.segment_min
+    h = jnp.where(use, hi, ident_hi)
+    best_hi = seg(h, group_id, num_segments=max_groups)
+    tied = use & (hi == best_hi[jnp.clip(group_id, 0, max_groups - 1)])
+    lo_key = lo ^ _SIGNBIT  # unsigned order in signed lanes
+    l = jnp.where(tied, lo_key, ident_hi)
+    best_lo_key = seg(l, group_id, num_segments=max_groups)
+    return best_hi, best_lo_key ^ _SIGNBIT
+
+
+def global_minmax_wide(hi, lo, use, kind: str):
+    bh, bl = segment_minmax_wide(
+        hi, lo, use, jnp.zeros(hi.shape[0], dtype=jnp.int32), 1, kind
+    )
+    return bh, bl
+
+
+def narrow_sums_to_ints(sums: np.ndarray) -> list[int]:
+    """Host reconstruction for :func:`narrow_limb_sums` output."""
+    sums = np.asarray(sums)
+    out = []
+    for i in range(sums.shape[0]):
+        s0, s1, sn = (int(sums[i, 0]), int(sums[i, 1]), int(sums[i, 2]))
+        out.append(s0 + (s1 << 32) - (sn << 64))
+    return out
+
+
+def wide_sums_to_ints(sums: np.ndarray) -> list[int]:
+    """Host reconstruction for :func:`wide_limb_sums` output."""
+    sums = np.asarray(sums)
+    out = []
+    for i in range(sums.shape[0]):
+        lo0, lo1, hi0, hi1, neg = (int(x) for x in sums[i])
+        lo_part = lo0 + (lo1 << 32)
+        hi_part = hi0 + (hi1 << 32) - (neg << 64)
+        out.append(lo_part + (hi_part << 64))
+    return out

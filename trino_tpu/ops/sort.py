@@ -37,6 +37,14 @@ def sortable_key(data: jnp.ndarray, valid: jnp.ndarray, key: SortKey, ranks=None
     descending = negation. No bitcasts — f64 bitcast is unsupported under
     TPU's x64 rewriting.
     """
+    if getattr(data, "ndim", 1) == 2:
+        # wide DECIMAL (hi, lo) lanes: two-operand signed-128 ordering
+        from trino_tpu.ops.decimal128 import sort_operands_wide
+
+        ops = sort_operands_wide(data[:, 0], data[:, 1], key.ascending)
+        null_key = valid if key.nulls_first else ~valid
+        ops = [jnp.where(valid, o, jnp.zeros_like(o)) for o in ops]
+        return [null_key] + ops
     if ranks is not None:  # dictionary string: map codes to ranks
         r = jnp.asarray(ranks)
         value = r[jnp.maximum(data, 0)].astype(jnp.int64)

@@ -107,6 +107,17 @@ class DistributedExecutor(LocalExecutor):
             # would double-count values seen on multiple shards. Run the
             # single-program path (XLA gathers as needed).
             return self._aggregate_result(node, res)
+        if any(
+            isinstance(fn.result_type, T.DecimalType) and fn.result_type.wide
+            for _, fn in node.aggregates
+        ) or any(
+            isinstance(k.type, T.DecimalType) and k.type.wide
+            for k in node.group_keys
+        ):
+            # wide DECIMAL sums/keys use 128-bit (hi, lo) lanes whose shapes
+            # the stacked partial/combine path below does not carry; the
+            # single-program path is exact (XLA shards the segment sums)
+            return self._aggregate_result(node, res)
         if not node.group_keys:
             # global agg: compute per-shard partials via masked group-by with
             # a single dummy key, then combine on host
@@ -230,8 +241,9 @@ class DistributedExecutor(LocalExecutor):
                 c = res.column(sym)
                 data, valid = c.data, c.valid_mask()
                 if c.dictionary is not None and fn.kind in ("min", "max"):
-                    r = jnp.asarray(c.dictionary.ranks())
-                    data = r[jnp.maximum(data, 0)]
+                    from trino_tpu.exec.local import rank_codes
+
+                    data = rank_codes(c.dictionary, data)
                     string_aggs.append(c.dictionary)
                 else:
                     string_aggs.append(None)
@@ -369,7 +381,7 @@ class DistributedExecutor(LocalExecutor):
 
     # === joins ==========================================================
     def _exec_join(self, node: P.Join) -> Result:
-        if node.join_type in ("CROSS", "SEMI", "ANTI", "RIGHT"):
+        if node.join_type in ("CROSS", "SEMI", "ANTI", "RIGHT", "FULL"):
             return super()._exec_join(node)
         if node.join_type == "LEFT" and node.filter is not None:
             # ON-clause filters on outer joins need the null-extension
@@ -528,7 +540,7 @@ class DistributedExecutor(LocalExecutor):
 
         # build shard-local Results and delegate to the local join kernel via
         # shard_map: both sides now co-partitioned by key hash
-        nlk = len(node.criteria)
+        nlk = len(lkeys)  # wide criteria expand into two lane pairs
         probe_cols = lout[: 2 * len(lschema)]
         probe_keys = lout[2 * len(lschema) : -1]
         ph2 = lout[-1]

@@ -134,15 +134,31 @@ class AggFunction:
 
 @dataclasses.dataclass
 class Aggregate(PlanNode):
-    """Group-by aggregation. step: 'single' | 'partial' | 'final'."""
+    """Group-by aggregation. step: 'single' | 'partial' | 'final'.
+
+    For the partial/final split (the reference's AccumulatorState shipping,
+    ``spi/function/AccumulatorStateSerializer.java``), ``acc_symbols`` names
+    the accumulator columns on the wire between the two steps: one
+    ``(value, count)`` pair per aggregate (count carries NULL semantics and
+    avg denominators; for count/count_star the value IS the count and the
+    second symbol is None). A partial node *outputs* them; the matching
+    final node *consumes* them."""
 
     source: PlanNode
     group_keys: list[Symbol]
     aggregates: list[tuple[Symbol, AggFunction]]
     step: str = "single"
+    acc_symbols: Optional[list[tuple[Symbol, Optional[Symbol]]]] = None
 
     @property
     def output_symbols(self):
+        if self.step == "partial" and self.acc_symbols is not None:
+            out = list(self.group_keys)
+            for v, c in self.acc_symbols:
+                out.append(v)
+                if c is not None:
+                    out.append(c)
+            return out
         return self.group_keys + [s for s, _ in self.aggregates]
 
     @property
@@ -325,6 +341,24 @@ class Output(PlanNode):
 
 
 @dataclasses.dataclass
+class RemoteSource(PlanNode):
+    """Leaf standing in for another fragment's output
+    (reference: ``plan/RemoteSourceNode.java``). ``exchange_type`` records
+    how the feeding fragment's rows arrive: 'hash' (co-partitioned by
+    ``keys`` over the mesh), 'broadcast' (replicated), 'single' (gathered),
+    or 'source' (left in the producer's scan partitioning)."""
+
+    fragment_id: int
+    symbols: list[Symbol]
+    exchange_type: str = "single"
+    keys: list[Symbol] = dataclasses.field(default_factory=list)
+
+    @property
+    def output_symbols(self):
+        return self.symbols
+
+
+@dataclasses.dataclass
 class Exchange(PlanNode):
     """Repartitioning boundary (reference: ``plan/ExchangeNode.java``).
 
@@ -375,6 +409,11 @@ def node_label(node: PlanNode) -> str:
         detail = f" n={node.count}"
     elif isinstance(node, Exchange):
         detail = f" {node.scope}/{node.partitioning} keys={[s.name for s in node.keys]}"
+    elif isinstance(node, RemoteSource):
+        detail = (
+            f" fragment={node.fragment_id} {node.exchange_type}"
+            + (f" keys={[s.name for s in node.keys]}" if node.keys else "")
+        )
     elif isinstance(node, Output):
         detail = f" columns={node.column_names}"
     return f"{name}{detail} -> {[s.name for s in node.output_symbols][:8]}"

@@ -36,6 +36,10 @@ from trino_tpu.native import (
 )
 
 PAGES_MAGIC = 0xFEA4F001
+# format version: bumped when the per-column layout changes (v2 added the
+# wide-DECIMAL lane flag); readers reject other versions loudly instead of
+# misparsing persisted part files
+PAGES_VERSION = 2
 _CODEC_LZ = 0  # native/columnar.cpp tt_lz_*
 _CODEC_ZLIB = 1
 
@@ -88,7 +92,10 @@ def serialize_batch(batch: Batch, compress: bool = True) -> bytes:
         parts.append(_pack_bytes(ty.encode()))
         has_valid = 0 if bool(valid.all()) else 1
         has_dict = 1 if c.dictionary is not None else 0
-        parts.append(struct.pack("<bb", has_valid, has_dict))
+        # wide DECIMAL columns ((n, 2) int64 hi/lo lanes) ship as two
+        # consecutive lane encodings
+        is_wide = 1 if data.ndim == 2 else 0
+        parts.append(struct.pack("<bbb", has_valid, has_dict, is_wide))
         if has_valid:
             parts.append(_pack_bytes(bitpack_encode(valid.astype(np.uint64), 1)))
         if has_dict:
@@ -97,32 +104,39 @@ def serialize_batch(batch: Batch, compress: bool = True) -> bytes:
             blob = b"".join(struct.pack("<i", len(v)) + v for v in vals)
             parts.append(struct.pack("<q", len(vals)))
             parts.append(_pack_bytes(blob))
-        if data.dtype == np.bool_:
-            parts.append(struct.pack("<b", _ENC_BOOL))
-            parts.append(_pack_bytes(bitpack_encode(data.astype(np.uint64), 1)))
-        elif data.dtype.kind == "f":
-            parts.append(struct.pack("<b", _ENC_PLAIN))
-            parts.append(_pack_bytes(np.ascontiguousarray(data).tobytes()))
-        else:
-            enc, payload = _encode_ints(data)
-            parts.append(struct.pack("<b", enc))
-            parts.append(_pack_bytes(payload))
+        lanes = [data[:, 0], data[:, 1]] if is_wide else [data]
+        for lane in lanes:
+            if lane.dtype == np.bool_:
+                parts.append(struct.pack("<b", _ENC_BOOL))
+                parts.append(_pack_bytes(bitpack_encode(lane.astype(np.uint64), 1)))
+            elif lane.dtype.kind == "f":
+                parts.append(struct.pack("<b", _ENC_PLAIN))
+                parts.append(_pack_bytes(np.ascontiguousarray(lane).tobytes()))
+            else:
+                enc, payload = _encode_ints(lane)
+                parts.append(struct.pack("<b", enc))
+                parts.append(_pack_bytes(payload))
     body = b"".join(parts)
     codec = _CODEC_LZ if NATIVE_AVAILABLE else _CODEC_ZLIB
     compressed = lz_compress(body) if compress else body
     if not compress:
         codec = 0xFF  # uncompressed marker
     header = struct.pack(
-        "<IBqqQ", PAGES_MAGIC, codec, n, len(batch.columns), len(body)
+        "<IBBqqQ", PAGES_MAGIC, PAGES_VERSION, codec, n, len(batch.columns), len(body)
     )
     return header + compressed
 
 
 def deserialize_batch(data: bytes) -> Batch:
     r = _Reader(data)
-    magic, codec, n, ncols, raw_len = r.unpack("<IBqqQ")
+    magic, version, codec, n, ncols, raw_len = r.unpack("<IBBqqQ")
     if magic != PAGES_MAGIC:
         raise ValueError(f"bad pages magic: {magic:#x}")
+    if version != PAGES_VERSION:
+        raise ValueError(
+            f"pages format v{version} (expected v{PAGES_VERSION}) — "
+            "table was written by an incompatible build"
+        )
     payload = r.data[r.pos :]
     if codec == 0xFF:
         body = payload
@@ -144,7 +158,7 @@ def deserialize_batch(data: bytes) -> Batch:
     cols: list[Column] = []
     for _ in range(ncols):
         ty = T.parse_type(br.take_bytes().decode())
-        has_valid, has_dict = br.unpack("<bb")
+        has_valid, has_dict, is_wide = br.unpack("<bbb")
         valid: Optional[np.ndarray] = None
         if has_valid:
             valid = bitpack_decode(br.take_bytes(), n, 1).astype(np.bool_)
@@ -160,16 +174,20 @@ def deserialize_batch(data: bytes) -> Batch:
                 values.append(blob[pos : pos + vlen].decode("utf-8", "surrogatepass"))
                 pos += vlen
             dictionary = Dictionary(values)
-        (enc,) = br.unpack("<b")
-        payload = br.take_bytes()
         dtype = ty.storage_dtype
-        if enc == _ENC_BOOL:
-            data_arr = bitpack_decode(payload, n, 1).astype(np.bool_)
-        elif enc == _ENC_PLAIN:
-            data_arr = np.frombuffer(payload, dtype=dtype).copy()
-        elif enc == _ENC_RLE:
-            data_arr = rle_decode(payload, n).astype(dtype)
-        else:
-            data_arr = varint_decode(payload, n).astype(dtype)
-        cols.append(Column(ty, data_arr.astype(dtype), valid, dictionary))
+        lanes = []
+        for _lane in range(2 if is_wide else 1):
+            (enc,) = br.unpack("<b")
+            payload = br.take_bytes()
+            if enc == _ENC_BOOL:
+                data_arr = bitpack_decode(payload, n, 1).astype(np.bool_)
+            elif enc == _ENC_PLAIN:
+                data_arr = np.frombuffer(payload, dtype=dtype).copy()
+            elif enc == _ENC_RLE:
+                data_arr = rle_decode(payload, n).astype(dtype)
+            else:
+                data_arr = varint_decode(payload, n).astype(dtype)
+            lanes.append(data_arr.astype(dtype))
+        data_out = np.stack(lanes, axis=1) if is_wide else lanes[0]
+        cols.append(Column(ty, data_out, valid, dictionary))
     return Batch(cols, n)

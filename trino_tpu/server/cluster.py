@@ -1,0 +1,345 @@
+"""Coordinator cluster layer: discovery, remote tasks, stage scheduling.
+
+Reference:
+- ``metadata/DiscoveryNodeManager.java:68,148`` — worker membership via the
+  embedded discovery service (workers announce; coordinator polls). Here
+  workers PUT /v1/announce on the coordinator and re-announce periodically.
+- ``failuredetector/HeartbeatFailureDetector.java:78,91-120`` — the
+  existing detector (server/failuredetector.py) monitors announced workers;
+  failed nodes are excluded from scheduling.
+- ``server/remotetask/HttpRemoteTask.java:103,317`` — coordinator-side
+  proxy of a worker task: POST TaskUpdateRequest, long-poll status.
+- ``execution/scheduler/SqlQueryScheduler.java:112,538`` +
+  ``SqlStageExecution.java:384`` — stage tree scheduling. Fragment task
+  counts: SOURCE/HASH fragments get one task per live worker (splits
+  round-robin, FIXED_HASH partitions by index), SINGLE fragments one task;
+  the root fragment executes on the coordinator, pulling child output over
+  the same HTTP exchange (``server/protocol/Query.java:117``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from trino_tpu.config import Session
+from trino_tpu.exec.local import ExecutionError, Result
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.fragmenter import (
+    HASH,
+    SINGLE,
+    SOURCE,
+    PlanFragment,
+    SubPlan,
+    fragment_plan,
+)
+
+_task_counter = itertools.count(1)
+
+
+class WorkerNode:
+    def __init__(self, node_id: str, uri: str):
+        self.node_id = node_id
+        self.uri = uri.rstrip("/")
+        self.last_announce = time.time()
+
+    def to_json(self) -> dict:
+        return {
+            "nodeId": self.node_id,
+            "uri": self.uri,
+            "lastAnnounceSecondsAgo": round(time.time() - self.last_announce, 3),
+        }
+
+
+class ClusterNodeManager:
+    """Announce-based membership + failure-detector exclusion."""
+
+    def __init__(self, announce_timeout: float = 30.0, ping_interval: float = 2.0):
+        self._nodes: dict[str, WorkerNode] = {}
+        self._lock = threading.Lock()
+        self.announce_timeout = announce_timeout
+        from trino_tpu.server.failuredetector import HeartbeatFailureDetector
+
+        def ping(uri: str) -> bool:
+            with urllib.request.urlopen(f"{uri}/v1/info", timeout=5) as r:
+                return r.status == 200
+
+        self.failure_detector = HeartbeatFailureDetector(
+            ping, interval=ping_interval
+        )
+        self._started = False
+
+    def announce(self, node_id: str, uri: str) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                self._nodes[node_id] = WorkerNode(node_id, uri)
+                self.failure_detector.register(node_id, uri)
+            else:
+                node.last_announce = time.time()
+                node.uri = uri.rstrip("/")
+        if not self._started:
+            self._started = True
+            try:
+                self.failure_detector.start()
+            except Exception:  # pragma: no cover - detector is advisory
+                pass
+
+    def remove(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def all_nodes(self) -> list[WorkerNode]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def active_nodes(self) -> list[WorkerNode]:
+        """Announced recently AND not flagged by the failure detector
+        (scheduling exclusion, reference NodeScheduler + failure detector)."""
+        now = time.time()
+        with self._lock:
+            nodes = list(self._nodes.values())
+        return [
+            n
+            for n in nodes
+            if now - n.last_announce < self.announce_timeout
+            and not self.failure_detector.is_failed(n.node_id)
+        ]
+
+
+class HttpRemoteTask:
+    """Coordinator-side handle of one worker task."""
+
+    def __init__(self, node: WorkerNode, task_id: str, payload: dict):
+        self.node = node
+        self.task_id = task_id
+        self.payload = payload
+        self.uri = f"{node.uri}/v1/task/{task_id}"
+
+    def start(self) -> None:
+        body = json.dumps(self.payload).encode()
+        req = urllib.request.Request(self.uri, data=body, method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            json.loads(r.read().decode())
+
+    def status(self, max_wait: float = 0.0) -> dict:
+        uri = self.uri + (f"?maxWait={max_wait}" if max_wait else "")
+        with urllib.request.urlopen(uri, timeout=max(30, max_wait + 10)) as r:
+            return json.loads(r.read().decode())
+
+    def cancel(self) -> None:
+        req = urllib.request.Request(self.uri, method="DELETE")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+        except Exception:  # noqa: BLE001 - best-effort
+            pass
+
+
+class ClusterScheduler:
+    """Schedules a fragmented plan over the worker set and gathers output.
+
+    One scheduler per coordinator; one `execute` per query.
+    """
+
+    def __init__(self, engine, node_manager: ClusterNodeManager):
+        self.engine = engine
+        self.node_manager = node_manager
+
+    def execute(self, plan: P.PlanNode, session: Session):
+        """Returns (Batch, column_names)."""
+        sub = fragment_plan(plan)
+        nodes = self.node_manager.active_nodes()
+        if not nodes:
+            raise ExecutionError("no active workers in the cluster")
+        n = len(nodes)
+        query_id = f"cq{next(_task_counter)}"
+
+        fragments = {f.id: f for f in sub.all_fragments()}
+        order = self._bottom_up(sub)
+
+        # task counts per fragment (root runs on the coordinator)
+        task_counts: dict[int, int] = {}
+        for frag in order:
+            if frag.id == sub.fragment.id:
+                task_counts[frag.id] = 0  # coordinator
+            elif frag.partitioning.kind in (SOURCE, HASH):
+                task_counts[frag.id] = n
+            else:
+                task_counts[frag.id] = 1
+
+        consumer_of: dict[int, int] = {}
+        for frag in order:
+            for fid in frag.source_fragment_ids:
+                consumer_of[fid] = frag.id
+
+        remote_tasks: dict[int, list[HttpRemoteTask]] = {}
+        session_json = {
+            "user": session.user,
+            "catalog": session.catalog,
+            "schema": session.schema,
+            "properties": {
+                k: v
+                for k, v in session.properties.items()
+                if isinstance(v, (str, int, float, bool))
+                and k not in ("execution_mode",)
+            },
+        }
+        try:
+            for frag in order:
+                if frag.id == sub.fragment.id:
+                    continue
+                remote_tasks[frag.id] = self._schedule_fragment(
+                    query_id,
+                    frag,
+                    nodes,
+                    task_counts,
+                    consumer_of,
+                    remote_tasks,
+                    session_json,
+                )
+            return self._execute_root(
+                sub.fragment, session, remote_tasks, task_counts
+            )
+        except Exception:
+            for tasks in remote_tasks.values():
+                for t in tasks:
+                    t.cancel()
+            raise
+
+    # --- stage scheduling -------------------------------------------------
+
+    def _bottom_up(self, sub: SubPlan) -> list[PlanFragment]:
+        out: list[PlanFragment] = []
+
+        def rec(sp: SubPlan):
+            for c in sp.children:
+                rec(c)
+            out.append(sp.fragment)
+
+        rec(sub)
+        return out
+
+    def _sources_payload(
+        self,
+        frag: PlanFragment,
+        partition: int,
+        remote_tasks: dict[int, list[HttpRemoteTask]],
+    ) -> dict:
+        sources = {}
+        for fid in frag.source_fragment_ids:
+            tasks = remote_tasks[fid]
+            sources[str(fid)] = {
+                "locations": [t.uri for t in tasks],
+                "partition": partition,
+            }
+        return sources
+
+    def _schedule_fragment(
+        self,
+        query_id: str,
+        frag: PlanFragment,
+        nodes: list[WorkerNode],
+        task_counts: dict[int, int],
+        consumer_of: dict[int, int],
+        remote_tasks: dict[int, list[HttpRemoteTask]],
+        session_json: dict,
+    ) -> list[HttpRemoteTask]:
+        from trino_tpu.planner.serde import fragment_to_json
+
+        n_tasks = task_counts[frag.id]
+        consumer = consumer_of.get(frag.id)
+        output_partitions = max(
+            1, task_counts.get(consumer, 1) if consumer is not None else 1
+        )
+        # split assignment for SOURCE fragments (enumerated on the
+        # coordinator during scheduling, reference SplitManager timing)
+        split_assignment: list[dict[str, list[dict]]] = [
+            {} for _ in range(max(n_tasks, 1))
+        ]
+        if frag.partitioning.kind == SOURCE:
+            for node in P.walk_plan(frag.root):
+                if isinstance(node, P.TableScan):
+                    connector = self.engine.catalogs.get(node.catalog)
+                    splits = connector.get_splits(
+                        node.schema,
+                        node.table,
+                        target_splits=max(n_tasks, 1) * 4,
+                        constraint=node.constraint,
+                    )
+                    key = f"{node.catalog}.{node.schema}.{node.table}"
+                    for i, s in enumerate(splits):
+                        split_assignment[i % max(n_tasks, 1)].setdefault(
+                            key, []
+                        ).append(
+                            {
+                                "table": s.table,
+                                "index": s.index,
+                                "total": s.total,
+                                "info": s.info,
+                            }
+                        )
+        frag_json = fragment_to_json(frag)
+        tasks: list[HttpRemoteTask] = []
+        for p in range(n_tasks):
+            payload = {
+                "session": session_json,
+                "fragment": frag_json,
+                "splits": split_assignment[p],
+                "sources": self._sources_payload(frag, p, remote_tasks),
+                "output_partitions": output_partitions,
+            }
+            task = HttpRemoteTask(
+                nodes[p % len(nodes)], f"{query_id}.{frag.id}.{p}", payload
+            )
+            task.start()
+            tasks.append(task)
+        return tasks
+
+    # --- root fragment on the coordinator --------------------------------
+
+    def _execute_root(
+        self,
+        frag: PlanFragment,
+        session: Session,
+        remote_tasks: dict[int, list[HttpRemoteTask]],
+        task_counts: dict[int, int],
+    ):
+        from trino_tpu.server.task import WorkerExecutor
+
+        sources = {
+            fid: {"locations": [t.uri for t in tasks], "partition": 0}
+            for fid, tasks in remote_tasks.items()
+            if fid in frag.source_fragment_ids
+            or any(
+                isinstance(nd, P.RemoteSource) and nd.fragment_id == fid
+                for nd in P.walk_plan(frag.root)
+            )
+        }
+        local_session = Session(
+            user=session.user, catalog=session.catalog, schema=session.schema
+        )
+        for k, v in session.properties.items():
+            if k != "execution_mode":
+                local_session.properties[k] = v
+        executor = WorkerExecutor(self.engine.catalogs, local_session, {}, sources)
+        root = frag.root
+        if isinstance(root, P.Output):
+            batch, names = executor.execute(root)
+        else:
+            res = executor._exec(root)
+            batch = res.batch.compact()
+            names = [s.name for s in root.output_symbols]
+        # surface any worker failure even if results looked complete
+        for tasks in remote_tasks.values():
+            for t in tasks:
+                st = t.status()
+                if st.get("state") == "FAILED":
+                    raise ExecutionError(
+                        f"task {st.get('taskId')} failed: {st.get('error')}"
+                    )
+        return batch, names

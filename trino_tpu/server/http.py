@@ -53,11 +53,28 @@ class TrinoTpuServer:
         port: int = 0,
         max_concurrent: int = 16,
         resource_groups=None,
+        role: str = "coordinator",
+        node_id: Optional[str] = None,
+        discovery_uri: Optional[str] = None,
     ):
         from trino_tpu.server.resourcegroups import ResourceGroupManager
+        from trino_tpu.server.task import SqlTaskManager
 
         self.engine = engine or Engine()
+        self.role = role
+        self.node_id = node_id or f"{role}-{port}"
+        self.discovery_uri = discovery_uri
         self.resource_groups = resource_groups or ResourceGroupManager()
+        # every node can run tasks (reference: same binary, coordinator=true/false)
+        self.task_manager = SqlTaskManager(self.engine)
+        self.node_manager = None
+        if role == "coordinator":
+            from trino_tpu.server.cluster import ClusterNodeManager, ClusterScheduler
+
+            self.node_manager = ClusterNodeManager()
+            self.engine.cluster_scheduler = ClusterScheduler(
+                self.engine, self.node_manager
+            )
         self.query_manager = QueryManager(
             self.engine,
             max_concurrent,
@@ -82,9 +99,33 @@ class TrinoTpuServer:
     def start(self) -> "TrinoTpuServer":
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
+        if self.role == "worker" and self.discovery_uri:
+            self._announce_thread = threading.Thread(
+                target=self._announce_loop, daemon=True
+            )
+            self._announce_thread.start()
         return self
 
+    def _announce_loop(self) -> None:
+        """Periodic worker announcement to the coordinator's embedded
+        discovery (reference: airlift discovery announcer)."""
+        import urllib.request as _rq
+
+        while self.state == "ACTIVE":
+            try:
+                body = json.dumps(
+                    {"nodeId": self.node_id, "uri": self.base_uri}
+                ).encode()
+                req = _rq.Request(
+                    f"{self.discovery_uri}/v1/announce", data=body, method="PUT"
+                )
+                _rq.urlopen(req, timeout=10)
+            except Exception:  # noqa: BLE001 — coordinator may not be up yet
+                pass
+            time.sleep(2.0)
+
     def stop(self) -> None:
+        self.state = "STOPPED"
         self.httpd.shutdown()
         self.httpd.server_close()
         self.query_manager.shutdown(wait=False)
@@ -270,6 +311,13 @@ def _make_handler(server: TrinoTpuServer):
                     return self._error(400, str(e))
                 q = server.query_manager.create_query(sql, session)
                 return self._send_json(server.query_results(q, "queued", 0))
+            parts = [p for p in path.split("/") if p]
+            if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                # TaskResource.createOrUpdateTask (reference :127)
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length).decode())
+                task = server.task_manager.create_or_update(parts[2], payload)
+                return self._send_json(task.info())
             return self._error(404, f"unknown path: {path}")
 
         def do_GET(self):
@@ -315,6 +363,39 @@ def _make_handler(server: TrinoTpuServer):
                 return None
             if path == "/v1/resourceGroup":
                 return self._send_json(server.resource_groups.info())
+            if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                # task status, optional long-poll (?maxWait=seconds)
+                task = server.task_manager.get(parts[2])
+                if task is None:
+                    return self._error(404, "task not found")
+                qs = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+                max_wait = float(qs.get("maxWait", ["0"])[0])
+                deadline = time.time() + max_wait
+                while task.state == "RUNNING" and time.time() < deadline:
+                    time.sleep(0.02)
+                return self._send_json(task.info())
+            if (
+                len(parts) == 6
+                and parts[:2] == ["v1", "task"]
+                and parts[3] == "results"
+            ):
+                # GET /v1/task/{id}/results/{partition}/{token}
+                # (TaskResource.java:261 paged binary fetch)
+                task = server.task_manager.get(parts[2])
+                if task is None:
+                    return self._error(404, "task not found")
+                return self._send_json(
+                    task.results(int(parts[4]), int(parts[5]), max_wait=1.0)
+                )
+            if path == "/v1/node":
+                if server.node_manager is None:
+                    return self._send_json([])
+                return self._send_json(
+                    {
+                        "nodes": [n.to_json() for n in server.node_manager.all_nodes()],
+                        "failureInfo": server.node_manager.failure_detector.info(),
+                    }
+                )
             if path == "/v1/query":
                 return self._send_json(
                     [q.info() for q in server.query_manager.queries()]
@@ -383,10 +464,22 @@ def _make_handler(server: TrinoTpuServer):
                 if server.query_manager.cancel(parts[2]):
                     return self._send_no_content()
                 return self._error(404, "query not found")
+            if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                if server.task_manager.cancel(parts[2]):
+                    return self._send_no_content()
+                return self._error(404, "task not found")
             return self._error(404, f"unknown path: {path}")
 
         def do_PUT(self):
             path = urllib.parse.urlparse(self.path).path
+            if path == "/v1/announce":
+                # embedded discovery: workers announce themselves
+                if server.node_manager is None:
+                    return self._error(400, "not a coordinator")
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length).decode())
+                server.node_manager.announce(body["nodeId"], body["uri"])
+                return self._send_json({"ok": True})
             if path == "/v1/info/state":
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length).decode().strip().strip('"')

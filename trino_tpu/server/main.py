@@ -1,0 +1,64 @@
+"""Server entry point: ``python -m trino_tpu.server.main``.
+
+Reference: ``server/Server.java:73`` — one binary, coordinator vs worker by
+config. Workers take ``--discovery`` pointing at the coordinator and
+announce themselves (DiscoveryNodeManager analog in server/cluster.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="trino-tpu server")
+    parser.add_argument("--role", choices=["coordinator", "worker"], default="coordinator")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--node-id", default=None)
+    parser.add_argument("--discovery", default=None, help="coordinator URI (workers)")
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="force a JAX platform (e.g. cpu) before engine start",
+    )
+    args = parser.parse_args(argv)
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from trino_tpu.server.http import TrinoTpuServer
+
+    server = TrinoTpuServer(
+        host=args.host,
+        port=args.port,
+        role=args.role,
+        node_id=args.node_id,
+        discovery_uri=args.discovery,
+    )
+    server.start()
+    # parent supervisors (tests, orchestration) read this line
+    print(f"LISTENING {server.base_uri}", flush=True)
+
+    stop = {"flag": False}
+
+    def on_term(_sig, _frm):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

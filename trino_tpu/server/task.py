@@ -1,0 +1,337 @@
+"""Worker task runtime: execute one fragment, buffer partitioned output.
+
+Reference: ``execution/SqlTaskManager.java:88,370`` (task registry +
+updateTask), ``execution/SqlTaskExecution.java`` (fragment -> drivers),
+``execution/buffer/OutputBuffer.java:23,88-94`` with its Partitioned /
+Broadcast variants (producer side of the shuffle), and
+``server/TaskResource.java:84,127,261`` (the REST surface in http.py).
+
+A task executes its fragment with a :class:`WorkerExecutor` — the local
+interpreter with two overrides: scans read only the task's assigned splits,
+and RemoteSource leaves pull pages from upstream tasks over HTTP
+(``operator/ExchangeOperator.java:35`` / ``ExchangeClient.java:149``).
+Output rows are partitioned per the fragment's output exchange into
+per-consumer page lists served token-acked (at-least-once + dedupe, like
+``HttpPageBufferClient.java:93``).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import traceback
+import urllib.request
+from typing import Any, Optional
+
+import numpy as np
+
+from trino_tpu.columnar import Batch, Column, concat_batches
+from trino_tpu.config import Session
+from trino_tpu.exec.local import LocalExecutor, Result
+from trino_tpu.ops import join as J
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.fragmenter import PlanFragment
+from trino_tpu.serde import deserialize_batch, serialize_batch
+
+PAGE_ROWS = 1 << 16
+
+
+class OutputBuffer:
+    """Per-partition page lists with token-acked consumption."""
+
+    def __init__(self, n_partitions: int):
+        self.n = n_partitions
+        self._pages: list[list[bytes]] = [[] for _ in range(n_partitions)]
+        self._complete = False
+        self._lock = threading.Condition()
+
+    def enqueue(self, partition: int, page: bytes) -> None:
+        with self._lock:
+            self._pages[partition].append(page)
+            self._lock.notify_all()
+
+    def set_complete(self) -> None:
+        with self._lock:
+            self._complete = True
+            self._lock.notify_all()
+
+    def get(self, partition: int, token: int, max_wait: float = 1.0):
+        """Pages from `token` on; blocks up to max_wait for more data.
+        Returns (pages, next_token, complete)."""
+        deadline = time.time() + max_wait
+        with self._lock:
+            while True:
+                pages = self._pages[partition][token:]
+                if pages or self._complete:
+                    return pages, token + len(pages), self._complete
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return [], token, False
+                self._lock.wait(remaining)
+
+
+class ExchangeClient:
+    """Pull one partition of an upstream fragment from all its tasks.
+
+    Reference: ``operator/ExchangeClient.java:56,149`` — one buffer client
+    per upstream location, token-advancing GETs until complete.
+    """
+
+    def __init__(self, locations: list[str], partition: int, timeout: float = 120.0):
+        self.locations = locations
+        self.partition = partition
+        self.timeout = timeout
+
+    def read_all(self) -> list[Batch]:
+        batches: list[Batch] = []
+        threads = []
+        errors: list[Exception] = []
+        lock = threading.Lock()
+
+        def pull(loc: str):
+            try:
+                token = 0
+                deadline = time.time() + self.timeout
+                while True:
+                    uri = f"{loc}/results/{self.partition}/{token}"
+                    with urllib.request.urlopen(uri, timeout=30) as r:
+                        payload = json.loads(r.read().decode())
+                    for b64 in payload["pages"]:
+                        batch = deserialize_batch(base64.b64decode(b64))
+                        with lock:
+                            batches.append(batch)
+                    token = payload["token"]
+                    if payload["complete"]:
+                        return
+                    if payload.get("failed"):
+                        raise RuntimeError(payload.get("error", "upstream task failed"))
+                    if time.time() > deadline:
+                        raise TimeoutError(f"exchange timed out reading {uri}")
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(e)
+
+        for loc in self.locations:
+            t = threading.Thread(target=pull, args=(loc,), daemon=True)
+            t.start()
+            threads.append(t)
+        deadline = time.time() + self.timeout
+        for t in threads:
+            t.join(max(0.0, deadline - time.time()))
+        if errors:
+            raise errors[0]
+        if any(t.is_alive() for t in threads):
+            # a stalled puller must not yield silently-partial results
+            raise TimeoutError("exchange read timed out with pulls in flight")
+        return batches
+
+
+class WorkerExecutor(LocalExecutor):
+    """Local interpreter + assigned-split scans + HTTP remote sources."""
+
+    def __init__(
+        self,
+        catalogs,
+        session: Session,
+        splits: dict[str, list[dict]],
+        sources: dict[int, dict],
+    ):
+        super().__init__(catalogs, session)
+        self._splits = splits
+        self._sources = sources
+
+    def _exec_tablescan(self, node: P.TableScan) -> Result:
+        from trino_tpu.connectors.api import Split
+
+        connector = self.catalogs.get(node.catalog)
+        key = f"{node.catalog}.{node.schema}.{node.table}"
+        assigned = self._splits.get(key, [])
+        layout = {s.name: i for i, s in enumerate(node.symbols)}
+        if not assigned:
+            return Result(self._empty_batch(node), layout)
+        batches = [
+            connector.read_split(
+                node.schema,
+                node.table,
+                node.column_names,
+                Split(d["table"], d["index"], d["total"], d.get("info")),
+            )
+            for d in assigned
+        ]
+        batch = concat_batches(batches) if len(batches) > 1 else batches[0]
+        return Result(batch, layout)
+
+    def _exec_remotesource(self, node: P.RemoteSource) -> Result:
+        src = self._sources[node.fragment_id]
+        client = ExchangeClient(src["locations"], src["partition"])
+        batches = client.read_all()
+        layout = {s.name: i for i, s in enumerate(node.symbols)}
+        nonempty = [b for b in batches if b.num_rows > 0]
+        if not nonempty:
+            cols = [
+                Column(s.type, np.zeros(0, dtype=s.type.storage_dtype))
+                for s in node.symbols
+            ]
+            return Result(Batch(cols, 0), layout)
+        return Result(concat_batches(nonempty), layout)
+
+
+class SqlTask:
+    """One task = one fragment execution on this node.
+
+    Reference: ``execution/SqlTask.java`` + ``SqlTaskExecution.java``.
+    """
+
+    def __init__(self, task_id: str, engine, payload: dict):
+        self.task_id = task_id
+        self.engine = engine
+        self.state = "RUNNING"
+        self.error: Optional[str] = None
+        self.created = time.time()
+        self.fragment_id = payload["fragment"]["id"]
+        from trino_tpu.planner.serde import fragment_from_json
+
+        self.fragment: PlanFragment = fragment_from_json(payload["fragment"])
+        self.splits: dict[str, list[dict]] = payload.get("splits", {})
+        self.sources: dict[int, dict] = {
+            int(k): v for k, v in payload.get("sources", {}).items()
+        }
+        self.n_output_partitions = payload.get("output_partitions", 1)
+        self.buffer = OutputBuffer(self.n_output_partitions)
+        s = payload.get("session", {})
+        self.session = Session(
+            user=s.get("user", "worker"),
+            catalog=s.get("catalog", "tpch"),
+            schema=s.get("schema", "tiny"),
+        )
+        for k, v in s.get("properties", {}).items():
+            self.session.properties[k] = v
+        # workers run single-node interpretation of their fragment
+        self.session.properties["execution_mode"] = "local"
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # --- execution --------------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            executor = WorkerExecutor(
+                self.engine.catalogs, self.session, self.splits, self.sources
+            )
+            root = self.fragment.root
+            if isinstance(root, P.Output):
+                res_batch, _names = executor.execute(root)
+                result = Result(
+                    res_batch,
+                    {s.name: i for i, s in enumerate(root.output_symbols)},
+                )
+            else:
+                result = executor._exec(root)
+            self._emit(result)
+            self.state = "FINISHED"
+        except Exception as e:  # noqa: BLE001
+            self.error = f"{e}\n{traceback.format_exc()}"
+            self.state = "FAILED"
+        finally:
+            self.buffer.set_complete()
+
+    def _emit(self, result: Result) -> None:
+        batch = result.batch.compact()
+        n = self.n_output_partitions
+        ex = self.fragment.output_exchange
+        if ex == "broadcast":
+            page = serialize_batch(batch)
+            for p in range(n):
+                self.buffer.enqueue(p, page)
+            return
+        if ex == "hash" and n > 1:
+            key_pairs = []
+            for s in self.fragment.output_keys:
+                c = batch.columns[result.layout[s.name]]
+                key_pairs.append((c.data, c.valid_mask()))
+            khash, _ = J.hash_keys(key_pairs)
+            dest = np.asarray(khash) % n
+            for p in range(n):
+                idx = np.nonzero(dest == p)[0]
+                part = _take_rows(batch, idx)
+                if part.num_rows:
+                    self.buffer.enqueue(p, serialize_batch(part))
+            return
+        # single (or hash with one consumer): everything to partition 0
+        self.buffer.enqueue(0, serialize_batch(batch))
+
+    # --- REST support -----------------------------------------------------
+
+    def info(self) -> dict:
+        return {
+            "taskId": self.task_id,
+            "state": self.state,
+            "error": self.error,
+            "fragment": self.fragment_id,
+            "elapsed": time.time() - self.created,
+        }
+
+    def results(self, partition: int, token: int, max_wait: float) -> dict:
+        pages, next_token, complete = self.buffer.get(partition, token, max_wait)
+        return {
+            "taskId": self.task_id,
+            "pages": [base64.b64encode(p).decode() for p in pages],
+            "token": next_token,
+            "complete": complete and self.state in ("FINISHED", "CANCELED"),
+            "failed": self.state == "FAILED",
+            "error": self.error,
+        }
+
+    def cancel(self) -> None:
+        if self.state == "RUNNING":
+            self.state = "CANCELED"
+            self.buffer.set_complete()
+
+
+def _take_rows(batch: Batch, idx: np.ndarray) -> Batch:
+    cols = []
+    for c in batch.columns:
+        data, valid = c.to_numpy()
+        cols.append(
+            Column(
+                c.type,
+                data[idx],
+                None if valid[idx].all() else valid[idx],
+                c.dictionary,
+            )
+        )
+    return Batch(cols, len(idx))
+
+
+class SqlTaskManager:
+    """Task registry (reference: SqlTaskManager.java:88)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._tasks: dict[str, SqlTask] = {}
+        self._lock = threading.Lock()
+
+    def create_or_update(self, task_id: str, payload: dict) -> SqlTask:
+        with self._lock:
+            task = self._tasks.get(task_id)
+            if task is None:
+                task = SqlTask(task_id, self.engine, payload)
+                self._tasks[task_id] = task
+            return task
+
+    def get(self, task_id: str) -> Optional[SqlTask]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def cancel(self, task_id: str) -> bool:
+        task = self.get(task_id)
+        if task is None:
+            return False
+        task.cancel()
+        return True
+
+    def tasks(self) -> list[SqlTask]:
+        with self._lock:
+            return list(self._tasks.values())

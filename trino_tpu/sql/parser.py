@@ -753,6 +753,15 @@ class Parser:
                 pass  # fall through to identifier handling
             else:
                 raise SqlSyntaxError(f"unexpected keyword {tok.text!r}", tok.line, tok.col)
+        # typed literal with a non-keyword type name: DECIMAL '12.34'
+        if (
+            self.peek().kind == "IDENT"
+            and self.peek().upper == "DECIMAL"
+            and self.peek(1).kind == "STRING"
+        ):
+            self.next()
+            s = self.next().text.strip()
+            return t.Literal(s, "decimal")
         # identifier, qualified name, or function call
         if self.peek().kind in ("IDENT", "QIDENT") or (
             self.peek().kind == "KW" and self.peek().upper in _NONRESERVED

@@ -92,16 +92,29 @@ class DoubleType(SqlType):
 
 @dataclasses.dataclass(frozen=True)
 class DecimalType(SqlType):
-    """DECIMAL(precision, scale) stored as int64 scaled by 10**scale."""
+    """DECIMAL(precision, scale) as a scaled integer.
+
+    Storage: int64 for any precision whose *values* fit (narrow storage);
+    columns whose values exceed int64 — SUM accumulations over big data —
+    use *wide* storage: an (n, 2) int64 array of (hi, lo) two's-complement
+    128-bit lanes (``trino_tpu.ops.decimal128``, reference semantics
+    ``spi/type/UnscaledDecimal128Arithmetic.java``). ``p <= 38`` as in the
+    reference; a column's representation is visible from its data shape.
+    """
 
     precision: int = 18
     scale: int = 0
     name: str = ""
 
     def __post_init__(self):
-        if self.precision > 18:
-            raise NotImplementedError("DECIMAL precision > 18 not supported in v1")
+        if self.precision > 38:
+            raise NotImplementedError("DECIMAL precision > 38 is invalid")
         object.__setattr__(self, "name", f"decimal({self.precision},{self.scale})")
+
+    @property
+    def wide(self) -> bool:
+        """True when values may exceed int64 (needs 128-bit lanes)."""
+        return self.precision > 18
 
     @property
     def storage_dtype(self):
@@ -114,7 +127,13 @@ class DecimalType(SqlType):
     def to_python(self, v, dictionary=None):
         from decimal import Decimal
 
-        return Decimal(int(v)) / (10**self.scale) if self.scale else Decimal(int(v))
+        if np.ndim(v) == 1:  # wide storage scalar: (hi, lo) lanes
+            from trino_tpu.ops.decimal128 import pair_to_int
+
+            iv = pair_to_int(int(v[0]), int(v[1]))
+        else:
+            iv = int(v)
+        return Decimal(iv) / (10**self.scale) if self.scale else Decimal(iv)
 
 
 @dataclasses.dataclass(frozen=True)
