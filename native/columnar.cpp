@@ -268,3 +268,172 @@ int64_t tt_lz_decompress(const uint8_t* in, int64_t in_len, uint8_t* out,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// ===== Parquet host decode ==================================================
+// Reference: lib/trino-parquet (from-scratch reader: row-group pruning,
+// dictionary/RLE decoding — ParquetReader.java:65,161). Host tier decodes
+// pages into fixed-width arrays the device ingests directly.
+
+// Snappy block-format decompression (format spec: varint length +
+// literal/copy tagged elements). Returns decompressed size or -1.
+int64_t tt_snappy_decompress(const uint8_t* in, int64_t in_len,
+                             uint8_t* out, int64_t out_cap) {
+    int64_t ip = 0, op = 0;
+    // preamble: uncompressed length varint
+    uint64_t ulen = 0;
+    int shift = 0;
+    while (ip < in_len) {
+        uint8_t b = in[ip++];
+        ulen |= (uint64_t)(b & 0x7f) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    if ((int64_t)ulen > out_cap) return -1;
+    while (ip < in_len) {
+        uint8_t tag = in[ip++];
+        uint32_t kind = tag & 3;
+        if (kind == 0) {  // literal
+            int64_t len = (tag >> 2) + 1;
+            if ((tag >> 2) >= 60) {
+                int n_bytes = (tag >> 2) - 59;  // 1..4 length bytes
+                if (ip + n_bytes > in_len) return -1;
+                uint32_t l = 0;
+                for (int i = 0; i < n_bytes; i++) l |= (uint32_t)in[ip + i] << (8 * i);
+                len = (int64_t)l + 1;
+                ip += n_bytes;
+            }
+            if (ip + len > in_len || op + len > out_cap) return -1;
+            std::memcpy(out + op, in + ip, len);
+            ip += len;
+            op += len;
+        } else {
+            int64_t len, offset;
+            if (kind == 1) {  // copy with 1-byte offset
+                if (ip + 1 > in_len) return -1;
+                len = ((tag >> 2) & 7) + 4;
+                offset = ((int64_t)(tag >> 5) << 8) | in[ip];
+                ip += 1;
+            } else if (kind == 2) {  // 2-byte offset
+                if (ip + 2 > in_len) return -1;
+                len = (tag >> 2) + 1;
+                offset = (int64_t)in[ip] | ((int64_t)in[ip + 1] << 8);
+                ip += 2;
+            } else {  // 4-byte offset
+                if (ip + 4 > in_len) return -1;
+                len = (tag >> 2) + 1;
+                offset = (int64_t)in[ip] | ((int64_t)in[ip + 1] << 8) |
+                         ((int64_t)in[ip + 2] << 16) | ((int64_t)in[ip + 3] << 24);
+                ip += 4;
+            }
+            if (offset <= 0 || offset > op || op + len > out_cap) return -1;
+            // overlapping copies are byte-by-byte by spec
+            for (int64_t i = 0; i < len; i++) {
+                out[op] = out[op - offset];
+                op++;
+            }
+        }
+    }
+    return op;
+}
+
+// Snappy compression: literal-only emission (valid, ~1.0 ratio; the
+// writer favors simplicity — real compression is the LZ codec's job).
+int64_t tt_snappy_compress(const uint8_t* in, int64_t n, uint8_t* out) {
+    int64_t op = 0;
+    uint64_t len = (uint64_t)n;
+    while (len >= 0x80) {
+        out[op++] = (uint8_t)(len | 0x80);
+        len >>= 7;
+    }
+    out[op++] = (uint8_t)len;
+    int64_t ip = 0;
+    while (ip < n) {
+        int64_t chunk = n - ip < 65536 ? n - ip : 65536;
+        int64_t l = chunk - 1;
+        if (l < 60) {
+            out[op++] = (uint8_t)(l << 2);
+        } else {
+            out[op++] = (uint8_t)(61 << 2);  // 61 => two length bytes
+            out[op++] = (uint8_t)(l & 0xff);
+            out[op++] = (uint8_t)((l >> 8) & 0xff);
+        }
+        std::memcpy(out + op, in + ip, chunk);
+        op += chunk;
+        ip += chunk;
+    }
+    return op;
+}
+
+// Parquet RLE/bit-packed hybrid decoder (definition levels + dictionary
+// indices; format: <varint header> runs — LSB run type).
+int64_t tt_parquet_rle_decode(const uint8_t* in, int64_t in_len,
+                              int32_t bit_width, int64_t n_values,
+                              int32_t* out) {
+    int64_t ip = 0, op = 0;
+    int64_t byte_width = (bit_width + 7) / 8;
+    while (op < n_values && ip < in_len) {
+        // varint header
+        uint64_t header = 0;
+        int shift = 0;
+        while (ip < in_len) {
+            uint8_t b = in[ip++];
+            header |= (uint64_t)(b & 0x7f) << shift;
+            if (!(b & 0x80)) break;
+            shift += 7;
+        }
+        if (header & 1) {  // bit-packed run: (header>>1) groups of 8
+            int64_t count = (int64_t)(header >> 1) * 8;
+            int64_t bits_avail = (in_len - ip) * 8;
+            uint64_t acc = 0;
+            int acc_bits = 0;
+            for (int64_t i = 0; i < count; i++) {
+                while (acc_bits < bit_width && ip < in_len) {
+                    acc |= (uint64_t)in[ip++] << acc_bits;
+                    acc_bits += 8;
+                }
+                if (acc_bits < bit_width) return -1;
+                if (op < n_values)
+                    out[op++] = (int32_t)(acc & ((bit_width == 32)
+                                                     ? 0xffffffffull
+                                                     : ((1ull << bit_width) - 1)));
+                acc >>= bit_width;
+                acc_bits -= bit_width;
+            }
+            (void)bits_avail;
+        } else {  // RLE run: value in ceil(bw/8) little-endian bytes
+            int64_t count = (int64_t)(header >> 1);
+            uint32_t v = 0;
+            if (ip + byte_width > in_len) return -1;
+            for (int64_t i = 0; i < byte_width; i++) v |= (uint32_t)in[ip + i] << (8 * i);
+            ip += byte_width;
+            for (int64_t i = 0; i < count && op < n_values; i++) out[op++] = (int32_t)v;
+        }
+    }
+    return op;
+}
+
+// Parquet RLE encoder (RLE runs only — used for def levels / dict indices
+// by our writer; readers accept pure-RLE streams).
+int64_t tt_parquet_rle_encode(const int32_t* values, int64_t n,
+                              int32_t bit_width, uint8_t* out) {
+    int64_t byte_width = (bit_width + 7) / 8;
+    int64_t op = 0, i = 0;
+    while (i < n) {
+        int64_t j = i;
+        while (j < n && values[j] == values[i]) j++;
+        uint64_t header = (uint64_t)(j - i) << 1;  // RLE run
+        while (header >= 0x80) {
+            out[op++] = (uint8_t)(header | 0x80);
+            header >>= 7;
+        }
+        out[op++] = (uint8_t)header;
+        uint32_t v = (uint32_t)values[i];
+        for (int64_t b = 0; b < byte_width; b++) out[op++] = (uint8_t)(v >> (8 * b));
+        i = j;
+    }
+    return op;
+}
+
+}  // extern "C"

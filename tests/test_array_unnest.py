@@ -1,0 +1,125 @@
+"""ARRAY type + UNNEST (reference: spi/block/ArrayBlock.java,
+operator/unnest/UnnestOperator.java:39, ArrayFunctions). Arrays are
+pool-coded like dictionary strings — the TPU-first variable-width trick."""
+
+import pytest
+
+from trino_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+class TestArrayFunctions:
+    def test_cardinality(self, runner):
+        rows, _ = runner.execute(
+            "select cardinality(array[1, 2, 3]), cardinality(array[])"
+        )
+        assert rows == [(3, 0)]
+
+    def test_element_at(self, runner):
+        rows, _ = runner.execute(
+            "select element_at(array[10, 20, 30], 2),"
+            " element_at(array[10], 5), element_at(array[10, 20], -1)"
+        )
+        assert rows == [(20, None, 20)]
+
+    def test_contains(self, runner):
+        rows, _ = runner.execute(
+            "select contains(array[1, 2, 3], 2), contains(array[1, 3], 2)"
+        )
+        assert rows == [(True, False)]
+
+    def test_array_literal_output(self, runner):
+        rows, _ = runner.execute("select array[1, 2, null, 4]")
+        assert rows == [([1, 2, None, 4],)]
+
+    def test_null_elements_cardinality(self, runner):
+        rows, _ = runner.execute("select cardinality(array[1, null, 3])")
+        assert rows == [(3,)]
+
+
+class TestUnnest:
+    def test_bare_unnest(self, runner):
+        rows, _ = runner.execute(
+            "select x from unnest(array[3, 1, 2]) t(x) order by x"
+        )
+        assert rows == [(1,), (2,), (3,)]
+
+    def test_with_ordinality(self, runner):
+        rows, _ = runner.execute(
+            "select x, o from unnest(array['a', 'b']) with ordinality t(x, o)"
+        )
+        assert rows == [("a", 1), ("b", 2)]
+
+    def test_lateral_cross_join(self, runner):
+        rows, _ = runner.execute(
+            "select k, x from (values (1, 'p'), (2, 'q')) v(k, s)"
+            " cross join unnest(array[10, 20]) u(x) order by k, x"
+        )
+        assert rows == [(1, 10), (1, 20), (2, 10), (2, 20)]
+
+    def test_unnest_agg_roundtrip(self, runner):
+        # array_agg -> unnest recovers the multiset
+        rows, _ = runner.execute(
+            "select x from (select array_agg(o_orderpriority) a from"
+            " (select * from orders limit 50)) cross join unnest(a) u(x)"
+            " group by x order by x"
+        )
+        exp, _ = runner.execute(
+            "select o_orderpriority from (select * from orders limit 50)"
+            " group by 1 order by 1"
+        )
+        assert rows == exp
+
+    def test_unnest_nulls_pad_zip(self, runner):
+        rows, _ = runner.execute(
+            "select a, b from unnest(array[1, 2, 3], array[10, 20]) t(a, b)"
+            " order by a"
+        )
+        assert rows == [(1, 10), (2, 20), (3, None)]
+
+
+class TestArrayAgg:
+    def test_global(self, runner):
+        rows, _ = runner.execute("select array_agg(x) from (values 3, 1, 2) t(x)")
+        assert sorted(rows[0][0]) == [1, 2, 3]
+
+    def test_grouped_with_other_aggs(self, runner):
+        rows, _ = runner.execute(
+            "select k, array_agg(v), count(*), sum(v) from"
+            " (values (1, 10), (1, 20), (2, 30)) t(k, v) group by k order by k"
+        )
+        assert rows == [(1, [10, 20], 2, 30), (2, [30], 1, 30)]
+
+    def test_keeps_nulls(self, runner):
+        rows, _ = runner.execute(
+            "select array_agg(v) from (values 1, null, 2) t(v)"
+        )
+        assert rows[0][0].count(None) == 1 and len(rows[0][0]) == 3
+
+    def test_empty_group_is_null(self, runner):
+        rows, _ = runner.execute(
+            "select array_agg(v) from (values 1) t(v) where v > 5"
+        )
+        assert rows == [(None,)]
+
+    def test_strings(self, runner):
+        rows, _ = runner.execute(
+            "select k, array_agg(s) from (values (1, 'a'), (1, 'b')) t(k, s)"
+            " group by k"
+        )
+        assert rows == [(1, ["a", "b"])]
+
+    def test_distributed_matches_local(self, runner):
+        dist = LocalQueryRunner(engine=runner.engine)
+        dist.session.set("execution_mode", "distributed")
+        sql = (
+            "select o_orderstatus, cardinality(array_agg(o_orderkey))"
+            " from orders group by 1 order by 1"
+        )
+        lrows, _ = runner.execute(sql)
+        drows, _ = dist.execute(sql)
+        assert lrows == drows

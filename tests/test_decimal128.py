@@ -274,3 +274,21 @@ class TestWideDecimalSql:
         lrows, _ = runner.execute(sql)
         drows, _ = dist.execute(sql)
         assert lrows == drows
+
+    def test_cast_wide_sum_to_wider_scale(self, runner):
+        from decimal import Decimal
+
+        rows, _ = runner.execute(
+            "select cast(s as decimal(38,2)) from (select"
+            " sum(cast(x as decimal(18,0))) s from (values"
+            " 9000000000000000000, 9000000000000000000) t(x))"
+        )
+        assert rows == [(Decimal(18000000000000000000),)]
+
+    def test_cast_wide_to_double(self, runner):
+        rows, _ = runner.execute(
+            "select cast(s as double) / 1e18 from (select"
+            " sum(cast(x as decimal(18,0))) s from (values"
+            " 9000000000000000000, 9000000000000000000) t(x))"
+        )
+        assert abs(rows[0][0] - 18.0) < 1e-9

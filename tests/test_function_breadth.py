@@ -1,0 +1,234 @@
+"""Function breadth: bitwise, width_bucket, checksum, correlation family,
+JSON path extraction, datetime formatting (reference: FunctionRegistry's
+scalar/aggregation surface)."""
+
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu.testing import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+class TestBitwise:
+    def test_and_or_xor_not(self, runner):
+        rows, _ = runner.execute(
+            "select bitwise_and(12, 10), bitwise_or(12, 10),"
+            " bitwise_xor(12, 10), bitwise_not(0)"
+        )
+        assert rows == [(8, 14, 6, -1)]
+
+    def test_shifts(self, runner):
+        rows, _ = runner.execute(
+            "select bitwise_left_shift(1, 4), bitwise_right_shift(16, 2),"
+            " bitwise_right_shift_arithmetic(-8, 1)"
+        )
+        assert rows == [(16, 4, -4)]
+
+
+class TestWidthBucket:
+    def test_buckets(self, runner):
+        rows, _ = runner.execute(
+            "select width_bucket(3.5, 0, 10, 5), width_bucket(-1, 0, 10, 5),"
+            " width_bucket(11, 0, 10, 5), width_bucket(0, 0, 10, 5)"
+        )
+        assert rows == [(2, 0, 6, 1)]
+
+
+class TestChecksum:
+    def test_order_insensitive(self, runner):
+        a, _ = runner.execute("select checksum(x) from (values 1, 2, 3) t(x)")
+        b, _ = runner.execute("select checksum(x) from (values 3, 1, 2) t(x)")
+        assert a == b and a[0][0] is not None
+
+    def test_detects_difference(self, runner):
+        a, _ = runner.execute("select checksum(x) from (values 1, 2, 3) t(x)")
+        b, _ = runner.execute("select checksum(x) from (values 1, 2, 4) t(x)")
+        assert a != b
+
+    def test_null_sensitivity_and_empty(self, runner):
+        a, _ = runner.execute("select checksum(x) from (values 1, null) t(x)")
+        b, _ = runner.execute("select checksum(x) from (values 1) t(x)")
+        assert a != b
+        e, _ = runner.execute(
+            "select checksum(x) from (values 1) t(x) where x > 5"
+        )
+        assert e == [(None,)]
+
+
+class TestCorrelationFamily:
+    def test_corr_perfect(self, runner):
+        rows, _ = runner.execute(
+            "select round(corr(y, x), 6) from"
+            " (values (1.0, 2.0), (2.0, 4.0), (3.0, 6.0)) t(y, x)"
+        )
+        assert rows == [(1.0,)]
+
+    def test_covar(self, runner):
+        rows, _ = runner.execute(
+            "select covar_pop(y, x), covar_samp(y, x) from"
+            " (values (1.0, 1.0), (2.0, 2.0)) t(y, x)"
+        )
+        assert rows == [(0.25, 0.5)]
+
+    def test_regr(self, runner):
+        rows, _ = runner.execute(
+            "select regr_slope(y, x), regr_intercept(y, x) from"
+            " (values (3.0, 1.0), (5.0, 2.0), (7.0, 3.0)) t(y, x)"
+        )
+        assert rows == [(2.0, 1.0)]
+
+    def test_null_pairs_ignored(self, runner):
+        rows, _ = runner.execute(
+            "select covar_samp(y, x) from"
+            " (values (1.0, 1.0), (2.0, 2.0), (null, 9.0), (3.0, null)) t(y, x)"
+        )
+        assert rows == [(0.5,)]
+
+    def test_corr_single_point_null(self, runner):
+        rows, _ = runner.execute(
+            "select corr(y, x) from (values (1.0, 1.0)) t(y, x)"
+        )
+        assert rows == [(None,)]
+
+
+class TestJson:
+    def test_extract_scalar(self, runner):
+        rows, _ = runner.execute(
+            """select json_extract_scalar(j, '$.a.b') from
+               (values '{"a": {"b": 5}}', '{"a": 1}', 'not json') t(j)"""
+        )
+        assert rows == [("5",), (None,), (None,)]
+
+    def test_extract_array_index(self, runner):
+        rows, _ = runner.execute(
+            """select json_extract_scalar('{"a": [1, "x", true]}', '$.a[1]'),
+                      json_extract_scalar('{"a": [1, "x", true]}', '$.a[2]')"""
+        )
+        assert rows == [("x", "true")]
+
+    def test_extract_json(self, runner):
+        rows, _ = runner.execute(
+            """select json_extract('{"a": [1, 2]}', '$.a')"""
+        )
+        assert rows == [("[1,2]",)]
+
+    def test_scalar_of_object_is_null(self, runner):
+        rows, _ = runner.execute(
+            """select json_extract_scalar('{"a": {"b": 1}}', '$.a')"""
+        )
+        assert rows == [(None,)]
+
+
+class TestDatetimeFormat:
+    def test_format_datetime_joda(self, runner):
+        rows, _ = runner.execute(
+            "select format_datetime(date '2024-03-05', 'yyyy/MM/dd'),"
+            " format_datetime(timestamp '2024-03-05 10:20:30', 'yyyy-MM-dd HH:mm:ss')"
+        )
+        assert rows == [("2024/03/05", "2024-03-05 10:20:30")]
+
+    def test_date_format_mysql(self, runner):
+        rows, _ = runner.execute(
+            "select date_format(timestamp '2024-03-05 10:20:30', '%Y-%m-%d %H:%i')"
+        )
+        assert rows == [("2024-03-05 10:20",)]
+
+    def test_group_by_formatted(self, runner):
+        rows, _ = runner.execute(
+            "select format_datetime(o_orderdate, 'yyyy') y, count(*)"
+            " from orders group by 1 order by 1"
+        )
+        assert len(rows) >= 5 and rows[0][0].startswith("19")
+
+    def test_null_dates(self, runner):
+        rows, _ = runner.execute(
+            "select format_datetime(d, 'yyyy') from"
+            " (values date '2020-01-01', null) t(d)"
+        )
+        assert rows == [("2020",), (None,)]
+
+
+class TestReviewHardening:
+    """Round-2 review findings on the new functions."""
+
+    def test_shift_64_or_more(self, runner):
+        rows, _ = runner.execute(
+            "select bitwise_left_shift(1, 64), bitwise_right_shift(8, 64),"
+            " bitwise_right_shift_arithmetic(-8, 64)"
+        )
+        assert rows == [(0, 0, -1)]
+
+    def test_width_bucket_descending(self, runner):
+        rows, _ = runner.execute(
+            "select width_bucket(5, 10, 0, 4), width_bucket(11, 10, 0, 4),"
+            " width_bucket(0, 10, 0, 4)"
+        )
+        assert rows == [(3, 0, 5)]
+
+    def test_width_bucket_equal_bounds_errors(self, runner):
+        with pytest.raises(Exception, match="bounds"):
+            runner.execute("select width_bucket(1, 5, 5, 4)")
+
+    def test_json_invalid_path_is_null(self, runner):
+        rows, _ = runner.execute(
+            """select json_extract('{"a":[1,2]}', '$.a.1'),
+                      json_extract('{"a":{"b":7}}', '$.a!!.b'),
+                      json_extract('{"a":[1,2]}', '$.a[-1]')"""
+        )
+        assert rows == [(None, None, None)]
+
+    def test_checksum_of_strings_is_content_based(self, runner):
+        a, _ = runner.execute(
+            "select checksum(s) from (values 'x', 'y') t(s)"
+        )
+        b, _ = runner.execute(
+            "select checksum(s) from (values 'y', 'x') t(s)"
+        )
+        c, _ = runner.execute(
+            "select checksum(s) from (values 'y', 'z') t(s)"
+        )
+        assert a == b and a != c
+
+    def test_checksum_double_and_wide(self, runner):
+        rows, _ = runner.execute(
+            "select checksum(x) from (values 1.25, 1.75) t(x)"
+        )
+        other, _ = runner.execute(
+            "select checksum(x) from (values 1.25, 1.25) t(x)"
+        )
+        assert rows != other
+        rows, _ = runner.execute(
+            "select checksum(s) from (select sum(o_totalprice) s from orders"
+            " group by o_custkey)"
+        )
+        assert rows[0][0] is not None
+
+    def test_checksum_all_null_group_not_null(self, runner):
+        rows, _ = runner.execute(
+            "select checksum(x) from (values cast(null as bigint)) t(x)"
+        )
+        assert rows[0][0] is not None
+
+    def test_nullif_wide_scale_alignment(self, runner):
+        rows, _ = runner.execute(
+            "select nullif(cast(1.50 as decimal(38,2)), cast(1.5 as decimal(38,1)))"
+        )
+        assert rows == [(None,)]
+
+    def test_nested_format_datetime(self, runner):
+        rows, _ = runner.execute(
+            "select upper(format_datetime(date '2024-03-05', 'yyyy-MMM'))"
+        )
+        assert rows == [("2024-MAR",)]
+
+    def test_format_datetime_in_where(self, runner):
+        rows, _ = runner.execute(
+            "select count(*) from orders where format_datetime(o_orderdate,"
+            " 'yyyy') = '1995'"
+        )
+        assert rows[0][0] > 0

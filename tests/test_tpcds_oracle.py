@@ -1,0 +1,633 @@
+"""TPC-DS query corpus checked against a SQLite oracle on identical data.
+
+Reference testing tier: ``H2QueryRunner.java`` (same SQL on both engines,
+diff the results) applied to the TPC-DS schema, and the benchto
+``tpcds.yaml`` query list. Query text follows the spec shapes, adapted
+only where the tiny generator lacks a column (noted inline); dates are
+rewritten for SQLite (no DATE literal syntax — ISO strings compare
+identically).
+"""
+
+import re
+import sqlite3
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu.testing import LocalQueryRunner
+
+S = "tpcds.tiny"
+TABLES = [
+    "date_dim", "time_dim", "item", "customer", "customer_address",
+    "customer_demographics", "household_demographics", "income_band",
+    "store", "warehouse", "ship_mode", "reason", "promotion", "web_site",
+    "web_page", "call_center", "catalog_page", "inventory", "store_sales",
+    "store_returns", "catalog_sales", "catalog_returns", "web_sales",
+    "web_returns",
+]
+
+
+@pytest.fixture(scope="module")
+def harness():
+    runner = LocalQueryRunner()
+    db = sqlite3.connect(":memory:")
+    conn = runner.catalogs.get("tpcds")
+    for table in TABLES:
+        ts = conn.get_table("tiny", table)
+        names = [c.name for c in ts.columns]
+        db.execute(f"create table {table} ({', '.join(names)})")
+        for s in conn.get_splits("tiny", table, 4):
+            batch = conn.read_split("tiny", table, names, s)
+            rows = [
+                tuple(
+                    float(v) if isinstance(v, Decimal) else v for v in row
+                )
+                for row in batch.to_pylist()
+            ]
+            if rows:
+                ph = ", ".join("?" * len(names))
+                db.executemany(f"insert into {table} values ({ph})", rows)
+    db.commit()
+    return runner, db
+
+
+def _normalize(rows):
+    out = []
+    for row in rows:
+        norm = []
+        for v in row:
+            if isinstance(v, Decimal):
+                v = float(v)
+            if isinstance(v, float):
+                v = round(v, 2)
+            norm.append(v)
+        out.append(tuple(norm))
+    return sorted(out, key=repr)
+
+
+def _sqlite_sql(sql: str) -> str:
+    sql = sql.replace(f"{S}.", "")
+    # SQLite has no DATE literal prefix; ISO strings compare identically
+    sql = re.sub(r"date\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", sql)
+    return sql
+
+
+def _approx_equal(g, w) -> bool:
+    if len(g) != len(w):
+        return False
+    for rg, rw in zip(g, w):
+        if len(rg) != len(rw):
+            return False
+        for vg, vw in zip(rg, rw):
+            if isinstance(vg, float) and isinstance(vw, (int, float)):
+                # engine decimals round at result scale; the float oracle
+                # accumulates representation error — tolerate the boundary
+                if abs(vg - float(vw)) > 0.02 + 1e-6 * max(abs(vg), abs(vw)):
+                    return False
+            elif vg != vw:
+                return False
+    return True
+
+
+def check(harness, sql: str):
+    runner, db = harness
+    got, _ = runner.execute(sql)
+    want = db.execute(_sqlite_sql(sql)).fetchall()
+    g, w = _normalize(got), _normalize(want)
+    assert _approx_equal(g, w), (
+        f"engine != sqlite\nengine: {g[:5]}\nsqlite: {w[:5]}"
+    )
+
+
+QUERIES = {
+    # Q6: state-level count of items priced >= 1.2x category average
+    6: f"""
+select a.ca_state state, count(*) cnt
+from {S}.customer_address a, {S}.customer c, {S}.store_sales s,
+     {S}.date_dim d, {S}.item i
+where a.ca_address_sk = c.c_current_addr_sk
+  and c.c_customer_sk = s.ss_customer_sk
+  and s.ss_sold_date_sk = d.d_date_sk and s.ss_item_sk = i.i_item_sk
+  and d.d_month_seq = (select min(d_month_seq) from {S}.date_dim
+                       where d_year = 2001 and d_moy = 1)
+  and i.i_current_price > 1.2 * (select avg(j.i_current_price)
+                                 from {S}.item j
+                                 where j.i_category = i.i_category)
+group by a.ca_state having count(*) >= 2
+order by cnt, a.ca_state limit 100""",
+    # Q13: banded predicates over demographics and addresses
+    13: f"""
+select avg(ss_quantity), avg(ss_ext_sales_price),
+       avg(ss_ext_wholesale_cost), sum(ss_ext_wholesale_cost)
+from {S}.store_sales, {S}.store, {S}.customer_demographics,
+     {S}.household_demographics, {S}.customer_address, {S}.date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk
+  and d_year = 2001
+  and ((ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'M' and cd_education_status = 'Advanced Degree'
+        and ss_sales_price between 100.00 and 150.00 and hd_dep_count = 3)
+    or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'S' and cd_education_status = 'College'
+        and ss_sales_price between 50.00 and 100.00 and hd_dep_count = 1)
+    or (ss_hdemo_sk = hd_demo_sk and cd_demo_sk = ss_cdemo_sk
+        and cd_marital_status = 'W' and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 150.00 and 200.00 and hd_dep_count = 1))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('TX', 'OH', 'TX') and ss_net_profit between 100 and 200)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('OR', 'NM', 'KY') and ss_net_profit between 150 and 300)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('VA', 'TX', 'MS') and ss_net_profit between 50 and 250))""",
+    # Q15: catalog sales by zip with zip/state/price disjunction
+    15: f"""
+select ca_zip, sum(cs_sales_price)
+from {S}.catalog_sales, {S}.customer, {S}.customer_address, {S}.date_dim
+where cs_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and (substr(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405', '86475',
+                                '85392', '85460', '80348', '81792')
+       or ca_state in ('CA', 'WA', 'GA') or cs_sales_price > 500)
+  and cs_sold_date_sk = d_date_sk and d_qoy = 2 and d_year = 2001
+group by ca_zip order by ca_zip limit 100""",
+    # Q25: store sale -> store return -> catalog repurchase chain
+    25: f"""
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_net_profit) as store_sales_profit,
+       sum(sr_net_loss) as store_returns_loss,
+       sum(cs_net_profit) as catalog_sales_profit
+from {S}.store_sales, {S}.store_returns, {S}.catalog_sales,
+     {S}.date_dim d1, {S}.date_dim d2, {S}.date_dim d3, {S}.store, {S}.item
+where d1.d_moy = 4 and d1.d_year = 2001 and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 4 and 10 and d2.d_year = 2001
+  and sr_customer_sk = cs_bill_customer_sk and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk
+  and d3.d_moy between 4 and 10 and d3.d_year = 2001
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name limit 100""",
+    # Q26: catalog analog of Q7
+    26: f"""
+select i_item_id, avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+from {S}.catalog_sales, {S}.customer_demographics, {S}.date_dim,
+     {S}.item, {S}.promotion
+where cs_sold_date_sk = d_date_sk and cs_item_sk = i_item_sk
+  and cs_bill_cdemo_sk = cd_demo_sk and cs_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_tv = 'N') and d_year = 2000
+group by i_item_id order by i_item_id limit 100""",
+    # Q28: price-band buckets (6-way cross join of scalar aggregates)
+    28: f"""
+select b1.lp lp1, b1.cnt cnt1, b2.lp lp2, b2.cnt cnt2, b3.lp lp3, b3.cnt cnt3
+from (select avg(ss_list_price) lp, count(ss_list_price) cnt
+      from {S}.store_sales
+      where ss_quantity between 0 and 5
+        and (ss_list_price between 8 and 18
+             or ss_coupon_amt between 459 and 1459
+             or ss_wholesale_cost between 57 and 77)) b1,
+     (select avg(ss_list_price) lp, count(ss_list_price) cnt
+      from {S}.store_sales
+      where ss_quantity between 6 and 10
+        and (ss_list_price between 90 and 100
+             or ss_coupon_amt between 2323 and 3323
+             or ss_wholesale_cost between 31 and 51)) b2,
+     (select avg(ss_list_price) lp, count(ss_list_price) cnt
+      from {S}.store_sales
+      where ss_quantity between 11 and 15
+        and (ss_list_price between 142 and 152
+             or ss_coupon_amt between 12214 and 13214
+             or ss_wholesale_cost between 79 and 99)) b3""",
+    # Q29: like Q25 with quantity sums
+    29: f"""
+select i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) as store_sales_quantity,
+       sum(sr_return_quantity) as store_returns_quantity,
+       sum(cs_quantity) as catalog_sales_quantity
+from {S}.store_sales, {S}.store_returns, {S}.catalog_sales,
+     {S}.date_dim d1, {S}.date_dim d2, {S}.date_dim d3, {S}.store, {S}.item
+where d1.d_moy = 9 and d1.d_year = 1999 and d1.d_date_sk = ss_sold_date_sk
+  and i_item_sk = ss_item_sk and s_store_sk = ss_store_sk
+  and ss_customer_sk = sr_customer_sk and ss_item_sk = sr_item_sk
+  and ss_ticket_number = sr_ticket_number
+  and sr_returned_date_sk = d2.d_date_sk
+  and d2.d_moy between 9 and 12 and d2.d_year = 1999
+  and sr_customer_sk = cs_bill_customer_sk and sr_item_sk = cs_item_sk
+  and cs_sold_date_sk = d3.d_date_sk and d3.d_year in (1999, 2000, 2001)
+group by i_item_id, i_item_desc, s_store_id, s_store_name
+order by i_item_id, i_item_desc, s_store_id, s_store_name limit 100""",
+    # Q33: per-manufacturer revenue across the three channels (union all)
+    33: f"""
+with ss as (
+  select i_manufact_id, sum(ss_ext_sales_price) total_sales
+  from {S}.store_sales, {S}.date_dim, {S}.customer_address, {S}.item
+  where i_item_sk = ss_item_sk and ss_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 1 and ss_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id),
+ cs as (
+  select i_manufact_id, sum(cs_ext_sales_price) total_sales
+  from {S}.catalog_sales, {S}.date_dim, {S}.customer_address, {S}.item
+  where i_item_sk = cs_item_sk and cs_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 1 and cs_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id),
+ ws as (
+  select i_manufact_id, sum(ws_ext_sales_price) total_sales
+  from {S}.web_sales, {S}.date_dim, {S}.customer_address, {S}.item
+  where i_item_sk = ws_item_sk and ws_sold_date_sk = d_date_sk
+    and d_year = 1998 and d_moy = 1 and ws_bill_addr_sk = ca_address_sk
+    and ca_gmt_offset = -5
+  group by i_manufact_id)
+select i_manufact_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs union all select * from ws)
+group by i_manufact_id order by total_sales, i_manufact_id limit 100""",
+    # Q37: items with inventory in a quantity band sold via catalog
+    37: f"""
+select i_item_id, i_item_desc, i_current_price
+from {S}.item, {S}.inventory, {S}.date_dim, {S}.catalog_sales
+where i_current_price between 68 and 98
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_date between date '2000-02-01' and date '2000-04-01'
+  and i_manufact_id in (677, 940, 694, 808)
+  and inv_quantity_on_hand between 100 and 500
+  and cs_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id limit 100""",
+    # Q43: store sales pivoted by day-of-week name
+    43: f"""
+select s_store_name, s_store_id,
+       sum(case when d_day_name = 'Sunday' then ss_sales_price else null end) sun_sales,
+       sum(case when d_day_name = 'Monday' then ss_sales_price else null end) mon_sales,
+       sum(case when d_day_name = 'Tuesday' then ss_sales_price else null end) tue_sales,
+       sum(case when d_day_name = 'Wednesday' then ss_sales_price else null end) wed_sales,
+       sum(case when d_day_name = 'Thursday' then ss_sales_price else null end) thu_sales,
+       sum(case when d_day_name = 'Friday' then ss_sales_price else null end) fri_sales,
+       sum(case when d_day_name = 'Saturday' then ss_sales_price else null end) sat_sales
+from {S}.date_dim, {S}.store_sales, {S}.store
+where d_date_sk = ss_sold_date_sk and s_store_sk = ss_store_sk
+  and s_state = 'TN' and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id limit 100""",
+    # Q45: web sales by zip for listed zips or listed item ids
+    45: f"""
+select ca_zip, ca_city, sum(ws_sales_price)
+from {S}.web_sales, {S}.customer, {S}.customer_address, {S}.date_dim, {S}.item
+where ws_bill_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk and ws_item_sk = i_item_sk
+  and (substr(ca_zip, 1, 5) in ('85669', '86197', '88274', '83405', '86475',
+                                '85392', '85460', '80348', '81792')
+       or i_item_id in (select i_item_id from {S}.item
+                        where i_item_sk in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29)))
+  and ws_sold_date_sk = d_date_sk and d_qoy = 2 and d_year = 2001
+group by ca_zip, ca_city order by ca_zip, ca_city limit 100""",
+    # Q46: shopping trips with city change between home and store
+    46: f"""
+select c_last_name, c_first_name, current_addr.ca_city, bought_city,
+       ss_ticket_number, amt, profit
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from {S}.store_sales, {S}.date_dim, {S}.store,
+           {S}.household_demographics, {S}.customer_address
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+        and (hd_dep_count = 4 or hd_vehicle_count = 3)
+        and d_dow in (6, 0) and d_year in (1999, 2000, 2001)
+        and s_city in ('Fairview', 'Midway')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     {S}.customer cu, {S}.customer_address current_addr
+where ss_customer_sk = cu.c_customer_sk
+  and cu.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number
+limit 100""",
+    # Q48: quantity under banded demographic/address disjunctions
+    48: f"""
+select sum(ss_quantity)
+from {S}.store_sales, {S}.store, {S}.customer_demographics,
+     {S}.customer_address, {S}.date_dim
+where s_store_sk = ss_store_sk and ss_sold_date_sk = d_date_sk and d_year = 2000
+  and ((cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'M'
+        and cd_education_status = '4 yr Degree'
+        and ss_sales_price between 100.00 and 150.00)
+    or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'D'
+        and cd_education_status = '2 yr Degree'
+        and ss_sales_price between 50.00 and 100.00)
+    or (cd_demo_sk = ss_cdemo_sk and cd_marital_status = 'S'
+        and cd_education_status = 'College'
+        and ss_sales_price between 150.00 and 200.00))
+  and ((ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('CO', 'OH', 'TX') and ss_net_profit between 0 and 2000)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('OR', 'MN', 'KY') and ss_net_profit between 150 and 3000)
+    or (ss_addr_sk = ca_address_sk and ca_country = 'United States'
+        and ca_state in ('VA', 'CA', 'MS') and ss_net_profit between 50 and 25000))""",
+    # Q50: store return latency buckets
+    50: f"""
+select s_store_name, s_store_id,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk <= 30) then 1 else 0 end) as d30,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 30)
+                 and (sr_returned_date_sk - ss_sold_date_sk <= 60) then 1 else 0 end) as d60,
+       sum(case when (sr_returned_date_sk - ss_sold_date_sk > 60) then 1 else 0 end) as dmore
+from {S}.store_sales, {S}.store_returns, {S}.store, {S}.date_dim d2
+where ss_ticket_number = sr_ticket_number and ss_item_sk = sr_item_sk
+  and sr_returned_date_sk = d2.d_date_sk and d2.d_year = 2001 and d2.d_moy = 8
+  and ss_store_sk = s_store_sk
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id limit 100""",
+    # Q60: per-item-id revenue across channels for one category
+    60: f"""
+with ss as (
+  select i_item_id, sum(ss_ext_sales_price) total_sales
+  from {S}.store_sales, {S}.date_dim, {S}.customer_address, {S}.item
+  where i_item_sk = ss_item_sk
+    and i_item_id in (select i_item_id from {S}.item where i_category = 'Music')
+    and ss_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 9
+    and ss_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id),
+ cs as (
+  select i_item_id, sum(cs_ext_sales_price) total_sales
+  from {S}.catalog_sales, {S}.date_dim, {S}.customer_address, {S}.item
+  where i_item_sk = cs_item_sk
+    and i_item_id in (select i_item_id from {S}.item where i_category = 'Music')
+    and cs_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 9
+    and cs_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id),
+ ws as (
+  select i_item_id, sum(ws_ext_sales_price) total_sales
+  from {S}.web_sales, {S}.date_dim, {S}.customer_address, {S}.item
+  where i_item_sk = ws_item_sk
+    and i_item_id in (select i_item_id from {S}.item where i_category = 'Music')
+    and ws_sold_date_sk = d_date_sk and d_year = 1998 and d_moy = 9
+    and ws_bill_addr_sk = ca_address_sk and ca_gmt_offset = -5
+  group by i_item_id)
+select i_item_id, sum(total_sales) total_sales
+from (select * from ss union all select * from cs union all select * from ws)
+group by i_item_id order by i_item_id, total_sales limit 100""",
+    # Q61: promoted vs total sales ratio (two scalar aggregates)
+    61: f"""
+select promotions, total,
+       cast(promotions as double) / cast(total as double) * 100 as ratio
+from (select sum(ss_ext_sales_price) promotions
+      from {S}.store_sales, {S}.store, {S}.promotion, {S}.date_dim,
+           {S}.customer, {S}.customer_address, {S}.item
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_promo_sk = p_promo_sk and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5 and i_category = 'Jewelry'
+        and (p_channel_dmail = 'Y' or p_channel_email = 'Y' or p_channel_tv = 'Y')
+        and d_year = 1998 and d_moy = 11) promotional_sales,
+     (select sum(ss_ext_sales_price) total
+      from {S}.store_sales, {S}.store, {S}.date_dim,
+           {S}.customer, {S}.customer_address, {S}.item
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_customer_sk = c_customer_sk
+        and ca_address_sk = c_current_addr_sk and ss_item_sk = i_item_sk
+        and ca_gmt_offset = -5 and i_category = 'Jewelry'
+        and d_year = 1998 and d_moy = 11) all_sales
+order by promotions, total limit 100""",
+    # Q62: web shipping latency buckets
+    62: f"""
+select substr(w_warehouse_name, 1, 20), sm_type, web_name,
+       sum(case when (ws_ship_date_sk - ws_sold_date_sk <= 30) then 1 else 0 end) as d30,
+       sum(case when (ws_ship_date_sk - ws_sold_date_sk > 30)
+                 and (ws_ship_date_sk - ws_sold_date_sk <= 60) then 1 else 0 end) as d60,
+       sum(case when (ws_ship_date_sk - ws_sold_date_sk > 60) then 1 else 0 end) as dmore
+from {S}.web_sales, {S}.warehouse, {S}.ship_mode, {S}.web_site, {S}.date_dim
+where ws_ship_date_sk = d_date_sk and ws_warehouse_sk = w_warehouse_sk
+  and ws_ship_mode_sk = sm_ship_mode_sk and ws_web_site_sk = web_site_sk
+  and d_year = 2000
+group by substr(w_warehouse_name, 1, 20), sm_type, web_name
+order by 1, sm_type, web_name limit 100""",
+    # Q65: stores' lowest-revenue items vs 10% of average revenue
+    65: f"""
+select s_store_name, i_item_desc, sc.revenue, i_current_price,
+       i_wholesale_cost, i_brand
+from {S}.store, {S}.item,
+     (select ss_store_sk, avg(revenue) as ave
+      from (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+            from {S}.store_sales, {S}.date_dim
+            where ss_sold_date_sk = d_date_sk and d_month_seq between 1212 and 1223
+            group by ss_store_sk, ss_item_sk) sa
+      group by ss_store_sk) sb,
+     (select ss_store_sk, ss_item_sk, sum(ss_sales_price) as revenue
+      from {S}.store_sales, {S}.date_dim
+      where ss_sold_date_sk = d_date_sk and d_month_seq between 1212 and 1223
+      group by ss_store_sk, ss_item_sk) sc
+where sb.ss_store_sk = sc.ss_store_sk and sc.revenue <= 0.1 * sb.ave
+  and s_store_sk = sc.ss_store_sk and i_item_sk = sc.ss_item_sk
+order by s_store_name, i_item_desc, sc.revenue limit 100""",
+    # Q68: like Q46 with ext list price / tax
+    68: f"""
+select c_last_name, c_first_name, current_addr.ca_city, bought_city,
+       ss_ticket_number, extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_tax) extended_tax
+      from {S}.store_sales, {S}.date_dim, {S}.store,
+           {S}.household_demographics, {S}.customer_address
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and ss_addr_sk = ca_address_sk
+        and d_dom between 1 and 2 and (hd_dep_count = 4 or hd_vehicle_count = 3)
+        and d_year in (1999, 2000, 2001) and s_city in ('Midway', 'Fairview')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     {S}.customer cu, {S}.customer_address current_addr
+where ss_customer_sk = cu.c_customer_sk
+  and cu.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number limit 100""",
+    # Q69: demographic profile of store-only shoppers
+    69: f"""
+select cd_gender, cd_marital_status, cd_education_status, count(*) cnt1,
+       cd_purchase_estimate, count(*) cnt2
+from {S}.customer c, {S}.customer_address ca, {S}.customer_demographics
+where c.c_current_addr_sk = ca.ca_address_sk
+  and ca_state in ('KY', 'GA', 'NM')
+  and cd_demo_sk = c.c_current_cdemo_sk
+  and exists (select * from {S}.store_sales, {S}.date_dim
+              where c.c_customer_sk = ss_customer_sk
+                and ss_sold_date_sk = d_date_sk and d_year = 2001
+                and d_moy between 4 and 6)
+  and not exists (select * from {S}.web_sales, {S}.date_dim
+                  where c.c_customer_sk = ws_bill_customer_sk
+                    and ws_sold_date_sk = d_date_sk and d_year = 2001
+                    and d_moy between 4 and 6)
+  and not exists (select * from {S}.catalog_sales, {S}.date_dim
+                  where c.c_customer_sk = cs_ship_customer_sk
+                    and cs_sold_date_sk = d_date_sk and d_year = 2001
+                    and d_moy between 4 and 6)
+group by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate
+order by cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate limit 100""",
+    # Q73: ticket sizes per household profile
+    73: f"""
+select c_last_name, c_first_name, ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) cnt
+      from {S}.store_sales, {S}.date_dim, {S}.store,
+           {S}.household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk and d_dom between 1 and 2
+        and (hd_buy_potential = '>10000' or hd_buy_potential = 'Unknown')
+        and hd_vehicle_count > 0 and d_year in (1999, 2000, 2001)
+        and s_county in ('AL County 1', 'CA County 2', 'GA County 3')
+      group by ss_ticket_number, ss_customer_sk) dj, {S}.customer
+where ss_customer_sk = c_customer_sk and cnt between 1 and 5
+order by cnt desc, c_last_name asc limit 100""",
+    # Q79: per-ticket coupon/profit for large stores
+    79: f"""
+select c_last_name, c_first_name, substr(s_city, 1, 30), ss_ticket_number,
+       amt, profit
+from (select ss_ticket_number, ss_customer_sk, s_city,
+             sum(ss_coupon_amt) amt, sum(ss_net_profit) profit
+      from {S}.store_sales, {S}.date_dim, {S}.store,
+           {S}.household_demographics
+      where ss_sold_date_sk = d_date_sk and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (hd_dep_count = 6 or hd_vehicle_count > 2)
+        and d_dow = 1 and d_year in (1999, 2000, 2001)
+        and s_number_employees between 200 and 295
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, s_city) ms,
+     {S}.customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name, substr(s_city, 1, 30), profit limit 100""",
+    # Q82: store analog of Q37
+    82: f"""
+select i_item_id, i_item_desc, i_current_price
+from {S}.item, {S}.inventory, {S}.date_dim, {S}.store_sales
+where i_current_price between 62 and 92
+  and inv_item_sk = i_item_sk and d_date_sk = inv_date_sk
+  and d_date between date '2000-05-25' and date '2000-07-24'
+  and i_manufact_id in (129, 270, 821, 423)
+  and inv_quantity_on_hand between 100 and 500
+  and ss_item_sk = i_item_sk
+group by i_item_id, i_item_desc, i_current_price
+order by i_item_id limit 100""",
+    # Q88: store traffic by half-hour (cross join of count subqueries)
+    88: f"""
+select * from
+ (select count(*) h8_30_to_9 from {S}.store_sales, {S}.household_demographics,
+   {S}.time_dim, {S}.store
+  where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+    and ss_store_sk = s_store_sk and t_hour = 8 and t_minute >= 30
+    and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+         or (hd_dep_count = 2 and hd_vehicle_count <= 4)
+         or (hd_dep_count = 0 and hd_vehicle_count <= 2))
+    and s_store_name = 'ese') s1,
+ (select count(*) h9_to_9_30 from {S}.store_sales, {S}.household_demographics,
+   {S}.time_dim, {S}.store
+  where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+    and ss_store_sk = s_store_sk and t_hour = 9 and t_minute < 30
+    and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+         or (hd_dep_count = 2 and hd_vehicle_count <= 4)
+         or (hd_dep_count = 0 and hd_vehicle_count <= 2))
+    and s_store_name = 'ese') s2,
+ (select count(*) h9_30_to_10 from {S}.store_sales, {S}.household_demographics,
+   {S}.time_dim, {S}.store
+  where ss_sold_time_sk = t_time_sk and ss_hdemo_sk = hd_demo_sk
+    and ss_store_sk = s_store_sk and t_hour = 9 and t_minute >= 30
+    and ((hd_dep_count = 4 and hd_vehicle_count <= 6)
+         or (hd_dep_count = 2 and hd_vehicle_count <= 4)
+         or (hd_dep_count = 0 and hd_vehicle_count <= 2))
+    and s_store_name = 'ese') s3""",
+    # Q90: web am/pm sales-count ratio
+    90: f"""
+select cast(amc as double) / cast(pmc as double) am_pm_ratio
+from (select count(*) amc from {S}.web_sales, {S}.household_demographics,
+       {S}.time_dim, {S}.web_page
+      where ws_sold_time_sk = t_time_sk and ws_bill_hdemo_sk = hd_demo_sk
+        and ws_web_page_sk = wp_web_page_sk and t_hour between 8 and 9
+        and hd_dep_count = 6 and wp_char_count between 5000 and 5200) at1,
+     (select count(*) pmc from {S}.web_sales, {S}.household_demographics,
+       {S}.time_dim, {S}.web_page
+      where ws_sold_time_sk = t_time_sk and ws_bill_hdemo_sk = hd_demo_sk
+        and ws_web_page_sk = wp_web_page_sk and t_hour between 19 and 20
+        and hd_dep_count = 6 and wp_char_count between 5000 and 5200) pt
+order by am_pm_ratio limit 100""",
+    # Q92: web sales above 1.3x average discount
+    92: f"""
+select sum(ws_ext_discount_amt) as excess_discount_amount
+from {S}.web_sales, {S}.item, {S}.date_dim
+where i_manufact_id = 350 and i_item_sk = ws_item_sk
+  and d_date between date '2000-01-27' and date '2000-04-26'
+  and d_date_sk = ws_sold_date_sk
+  and ws_ext_discount_amt > (
+    select 1.3 * avg(ws_ext_discount_amt)
+    from {S}.web_sales, {S}.date_dim
+    where ws_item_sk = i_item_sk
+      and d_date between date '2000-01-27' and date '2000-04-26'
+      and d_date_sk = ws_sold_date_sk)
+order by sum(ws_ext_discount_amt) limit 100""",
+    # Q93: refunded quantities by customer
+    93: f"""
+select ss_customer_sk, sum(act_sales) sumsales
+from (select ss_item_sk, ss_ticket_number, ss_customer_sk,
+             case when sr_return_quantity is not null
+                  then (ss_quantity - sr_return_quantity) * ss_sales_price
+                  else ss_quantity * ss_sales_price end act_sales
+      from ({S}.store_sales left join {S}.store_returns
+        on sr_item_sk = ss_item_sk and sr_ticket_number = ss_ticket_number)
+        join {S}.reason on sr_reason_sk = r_reason_sk
+      where r_reason_desc = 'reason 28') t
+group by ss_customer_sk
+order by sumsales, ss_customer_sk limit 100""",
+    # Q94: web orders shipped from multiple warehouses with no returns
+    94: f"""
+select count(distinct ws_order_number) as order_count,
+       sum(ws_ext_ship_cost) as total_shipping_cost,
+       sum(ws_net_profit) as total_net_profit
+from {S}.web_sales ws1, {S}.date_dim, {S}.customer_address, {S}.web_site
+where d_date between date '1999-02-01' and date '1999-04-01'
+  and ws1.ws_ship_date_sk = d_date_sk
+  and ws1.ws_ship_addr_sk = ca_address_sk and ca_state = 'IL'
+  and ws1.ws_web_site_sk = web_site_sk and web_company_name = 'pri'
+  and exists (select * from {S}.web_sales ws2
+              where ws1.ws_order_number = ws2.ws_order_number
+                and ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  and not exists (select * from {S}.web_returns wr1
+                  where ws1.ws_order_number = wr1.wr_order_number)
+order by count(distinct ws_order_number) limit 100""",
+    # Q97: store/catalog purchase overlap via FULL OUTER JOIN
+    97: f"""
+with ssci as (
+  select ss_customer_sk customer_sk, ss_item_sk item_sk
+  from {S}.store_sales, {S}.date_dim
+  where ss_sold_date_sk = d_date_sk and d_month_seq between 1200 and 1211
+  group by ss_customer_sk, ss_item_sk),
+ csci as (
+  select cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  from {S}.catalog_sales, {S}.date_dim
+  where cs_sold_date_sk = d_date_sk and d_month_seq between 1200 and 1211
+  group by cs_bill_customer_sk, cs_item_sk)
+select sum(case when ssci.customer_sk is not null and csci.customer_sk is null
+                then 1 else 0 end) store_only,
+       sum(case when ssci.customer_sk is null and csci.customer_sk is not null
+                then 1 else 0 end) catalog_only,
+       sum(case when ssci.customer_sk is not null and csci.customer_sk is not null
+                then 1 else 0 end) store_and_catalog
+from ssci full outer join csci
+  on (ssci.customer_sk = csci.customer_sk and ssci.item_sk = csci.item_sk)
+limit 100""",
+    # Q98: item revenue share within class (window over aggregate)
+    98: f"""
+select i_item_desc, i_category, i_class, i_current_price,
+       sum(ss_ext_sales_price) as itemrevenue,
+       sum(ss_ext_sales_price) * 100.0 /
+         sum(sum(ss_ext_sales_price)) over (partition by i_class) as revenueratio
+from {S}.store_sales, {S}.item, {S}.date_dim
+where ss_item_sk = i_item_sk
+  and i_category in ('Sports', 'Books', 'Home')
+  and ss_sold_date_sk = d_date_sk
+  and d_date between date '1999-02-22' and date '1999-03-24'
+group by i_item_id, i_item_desc, i_category, i_class, i_current_price
+order by i_category, i_class, i_item_id, i_item_desc, revenueratio limit 100""",
+}
+
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_tpcds_oracle(harness, qid):
+    check(harness, QUERIES[qid])

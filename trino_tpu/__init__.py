@@ -19,4 +19,27 @@ from trino_tpu.config import enable_x64
 
 enable_x64()
 
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compile cache: plans are re-traced per query (like the
+    reference re-plans per query), but identical fragment programs hit the
+    on-disk XLA cache instead of recompiling."""
+    import os
+
+    try:
+        import jax
+
+        cache = os.environ.get(
+            "TRINO_TPU_COMPILE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "trino_tpu_xla"),
+        )
+        if cache:
+            jax.config.update("jax_compilation_cache_dir", cache)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        pass
+
+
+_enable_compile_cache()
+
 __version__ = "0.1.0"

@@ -432,6 +432,10 @@ class Analyzer:
             if spec.having is not None
             else None
         )
+        # keep raw sort expressions: ORDER BY <alias> must resolve against
+        # the SELECT outputs even when an input column shares the name
+        # (normalization would rewrite it to the input symbol)
+        raw_order_by = order_by
         order_by = tuple(
             dataclasses.replace(
                 si, expression=self._normalize(si.expression, input_scope)
@@ -540,6 +544,15 @@ class Analyzer:
                     raise SemanticError(f"{kind}(DISTINCT ...) is not supported")
                 arg = _fold(self._rewrite(fc.args[0], input_scope))
                 agg_map[fc] = add_agg("min", arg, arg.type, filt=fc_filter)
+                continue
+            if kind == "array_agg":
+                if fc.distinct:
+                    raise SemanticError("array_agg(DISTINCT ...) is not supported")
+                arg = _fold(self._rewrite(fc.args[0], input_scope))
+                agg_map[fc] = add_agg(
+                    "array_agg", arg, T.ArrayType(element=arg.type),
+                    filt=fc_filter,
+                )
                 continue
             if kind == "count" and len(fc.args) == 1 and isinstance(fc.args[0], t.Star):
                 arg_expr = None
@@ -655,10 +668,16 @@ class Analyzer:
         extra_syms: list[P.Symbol] = []
         if order_by:
             select_scope = Scope([Field(n, None, s) for n, s in zip(names, out_syms)])
-            for si in order_by:
+            for raw_si, si in zip(raw_order_by, order_by):
+                # alias/ordinal resolution uses the RAW form (the output
+                # alias wins over a same-named input column, SQL semantics)
                 sym = self._resolve_sort_symbol(
-                    si, select_scope, None, select_entries, out_syms
+                    raw_si, select_scope, None, select_entries, out_syms
                 )
+                if sym is None:
+                    sym = self._resolve_sort_symbol(
+                        si, select_scope, None, select_entries, out_syms
+                    )
                 if sym is None:
                     ex = _fold(rewrite_post(si.expression))
                     sym = P.Symbol(P.fresh_name("sortkey"), ex.type)
@@ -706,7 +725,63 @@ class Analyzer:
             return RelationPlan(rp.node, Scope(fields))
         if isinstance(rel, t.Join):
             return self._plan_join(rel)
+        if isinstance(rel, t.Unnest):
+            # bare FROM UNNEST(array): expand over a one-row dual
+            dual = P.Values([P.Symbol(P.fresh_name("dual"), T.BIGINT)], [[0]])
+            return self._plan_unnest(
+                RelationPlan(dual, Scope([])), rel, None, ()
+            )
         raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_unnest(
+        self,
+        left: RelationPlan,
+        rel: t.Unnest,
+        alias: Optional[str],
+        col_aliases: tuple[str, ...],
+    ) -> RelationPlan:
+        """Plan UNNEST (reference: UnnestOperator.java:39; RelationPlanner
+        handles CROSS JOIN UNNEST laterally)."""
+        array_exprs = []
+        element_symbols = []
+        for i, e_ast in enumerate(rel.expressions):
+            ex = _fold(self._rewrite(e_ast, left.scope))
+            if not isinstance(ex.type, T.ArrayType):
+                raise SemanticError("UNNEST argument must be an ARRAY")
+            array_exprs.append(ex)
+            name = (
+                col_aliases[i].lower()
+                if i < len(col_aliases)
+                else P.fresh_name("unnest")
+            )
+            element_symbols.append(
+                P.Symbol(P.fresh_name(name), ex.type.element)
+            )
+        ordinality = None
+        if rel.with_ordinality:
+            oname = (
+                col_aliases[len(rel.expressions)].lower()
+                if len(col_aliases) > len(rel.expressions)
+                else "ordinality"
+            )
+            ordinality = P.Symbol(P.fresh_name(oname), T.BIGINT)
+        node = P.Unnest(left.node, array_exprs, element_symbols, ordinality)
+        fields = list(left.scope.fields)
+        for i, s in enumerate(element_symbols):
+            fname = (
+                col_aliases[i].lower()
+                if i < len(col_aliases)
+                else (alias if len(element_symbols) == 1 and alias else None)
+            )
+            fields.append(Field(fname, alias, s))
+        if ordinality is not None:
+            oname = (
+                col_aliases[len(rel.expressions)].lower()
+                if len(col_aliases) > len(rel.expressions)
+                else "ordinality"
+            )
+            fields.append(Field(oname, alias, ordinality))
+        return RelationPlan(node, Scope(fields))
 
     def _plan_table(self, rel: t.Table) -> RelationPlan:
         parts = tuple(p.lower() for p in rel.name)
@@ -745,6 +820,11 @@ class Analyzer:
 
     def _plan_join(self, rel: t.Join) -> RelationPlan:
         left = self._plan_relation(rel.left)
+        # CROSS JOIN UNNEST(expr): the unnest references the LEFT relation
+        # (lateral semantics) — plan an Unnest node instead of a join
+        unnest_ast, u_alias, u_cols = _unwrap_unnest(rel.right)
+        if unnest_ast is not None and rel.join_type == "CROSS":
+            return self._plan_unnest(left, unnest_ast, u_alias, u_cols)
         right = self._plan_relation(rel.right)
         combined_scope = Scope(left.scope.fields + right.scope.fields)
         if rel.join_type == "CROSS":
@@ -993,6 +1073,119 @@ class Analyzer:
         (the FILTER clause) applies to every sub-aggregate."""
         kind = fc.name
         arg = _fold(self._rewrite(fc.args[0], input_scope))
+        if kind == "checksum":
+            # order-insensitive: wrapping SUM of per-row 64-bit hashes.
+            # (reference 'checksum' XORs hashes into a varbinary; BIGINT
+            # output is a documented deviation). Strings hash by CONTENT
+            # (str_hash64 dictionary table), not by code assignment.
+            hash_fn = "str_hash64" if T.is_string(arg.type) else "hash64"
+            hashed = call(hash_fn, T.BIGINT, arg)
+            s = add_agg("sum", hashed, T.BIGINT, filt=fc_filter)
+            # NULL only for EMPTY groups (all-NULL groups hash the NULLs)
+            rows = add_agg("count_star", None, T.BIGINT, filt=fc_filter)
+            return special(
+                "if", T.BIGINT,
+                call(
+                    "gt", T.BOOLEAN,
+                    variable(rows.name, T.BIGINT),
+                    const(0, T.BIGINT),
+                ),
+                variable(s.name, T.BIGINT),
+                Constant(type=T.BIGINT, value=None),
+            )
+        if kind in ("corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept"):
+            # two-argument moments family composed from sums (reference:
+            # CentralMomentsAggregation / CorrelationAggregation states)
+            y = _coerce_to(arg, T.DOUBLE)
+            x = _coerce_to(
+                _fold(self._rewrite(fc.args[1], input_scope)), T.DOUBLE
+            )
+            both = call(
+                "multiply", T.DOUBLE,
+                special("if", T.DOUBLE, special("not", T.BOOLEAN, special("is_null", T.BOOLEAN, x)), y, Constant(type=T.DOUBLE, value=None)),
+                const(1.0, T.DOUBLE),
+            )
+            xboth = call(
+                "multiply", T.DOUBLE,
+                special("if", T.DOUBLE, special("not", T.BOOLEAN, special("is_null", T.BOOLEAN, y)), x, Constant(type=T.DOUBLE, value=None)),
+                const(1.0, T.DOUBLE),
+            )
+            sy = variable(add_agg("sum", both, T.DOUBLE, filt=fc_filter).name, T.DOUBLE)
+            sx = variable(add_agg("sum", xboth, T.DOUBLE, filt=fc_filter).name, T.DOUBLE)
+            sxy = variable(
+                add_agg("sum", call("multiply", T.DOUBLE, x, y), T.DOUBLE, filt=fc_filter).name,
+                T.DOUBLE,
+            )
+            sxx = variable(
+                add_agg("sum", call("multiply", T.DOUBLE, xboth, xboth), T.DOUBLE, filt=fc_filter).name,
+                T.DOUBLE,
+            )
+            syy = variable(
+                add_agg("sum", call("multiply", T.DOUBLE, both, both), T.DOUBLE, filt=fc_filter).name,
+                T.DOUBLE,
+            )
+            n = _coerce_to(
+                variable(
+                    add_agg("count", call("multiply", T.DOUBLE, x, y), T.BIGINT, filt=fc_filter).name,
+                    T.BIGINT,
+                ),
+                T.DOUBLE,
+            )
+            cov_n = call(
+                "subtract", T.DOUBLE,
+                call("multiply", T.DOUBLE, n, sxy),
+                call("multiply", T.DOUBLE, sx, sy),
+            )
+            var_x_n = call(
+                "subtract", T.DOUBLE,
+                call("multiply", T.DOUBLE, n, sxx),
+                call("multiply", T.DOUBLE, sx, sx),
+            )
+            var_y_n = call(
+                "subtract", T.DOUBLE,
+                call("multiply", T.DOUBLE, n, syy),
+                call("multiply", T.DOUBLE, sy, sy),
+            )
+            if kind == "covar_pop":
+                expr = call(
+                    "divide", T.DOUBLE, cov_n,
+                    call("multiply", T.DOUBLE, n, n),
+                )
+                min_n = 0.0
+            elif kind == "covar_samp":
+                expr = call(
+                    "divide", T.DOUBLE, cov_n,
+                    call("multiply", T.DOUBLE, n,
+                         call("subtract", T.DOUBLE, n, const(1.0, T.DOUBLE))),
+                )
+                min_n = 1.0
+            elif kind == "regr_slope":
+                expr = call("divide", T.DOUBLE, cov_n, var_x_n)
+                min_n = 1.0
+            elif kind == "regr_intercept":
+                slope = call("divide", T.DOUBLE, cov_n, var_x_n)
+                expr = call(
+                    "divide", T.DOUBLE,
+                    call("subtract", T.DOUBLE, sy,
+                         call("multiply", T.DOUBLE, slope, sx)),
+                    n,
+                )
+                min_n = 1.0
+            else:  # corr
+                expr = call(
+                    "divide", T.DOUBLE, cov_n,
+                    call(
+                        "sqrt", T.DOUBLE,
+                        call("multiply", T.DOUBLE, var_x_n, var_y_n),
+                    ),
+                )
+                min_n = 1.0
+            return special(
+                "if", T.DOUBLE,
+                call("gt", T.BOOLEAN, n, const(min_n, T.DOUBLE)),
+                expr,
+                Constant(type=T.DOUBLE, value=None),
+            )
         if kind in ("bool_and", "every", "bool_or"):
             # NULL inputs are IGNORED by aggregates: map TRUE->1, FALSE->0,
             # NULL->NULL (the nested IF keeps NULL invalid, so min/max skip it)
@@ -1407,6 +1600,24 @@ class Analyzer:
             return variable(sym.name, sym.type)
         if isinstance(e, t.Literal):
             return _literal(e)
+        if isinstance(e, t.ArrayLiteral):
+            # constant element lists fold into an ARRAY Constant whose value
+            # is a tuple of STORAGE scalars (None = NULL element)
+            items = [_fold(rw(it)) for it in e.items]
+            et: T.SqlType = T.UNKNOWN
+            for it in items:
+                et = T.common_super_type(et, it.type) or et
+            if isinstance(et, T.UnknownType):
+                et = T.BIGINT
+            coerced = [_fold(_coerce_to(it, et)) for it in items]
+            if not all(isinstance(it, Constant) for it in coerced):
+                raise SemanticError(
+                    "ARRAY constructor elements must be constant (v1)"
+                )
+            return Constant(
+                type=T.ArrayType(element=et),
+                value=tuple(it.value for it in coerced),
+            )
         if isinstance(e, t.IntervalLiteral):
             return Constant(type=T.UNKNOWN, value=e)  # consumed by date arith
         if isinstance(e, t.UnaryOp):
@@ -1555,6 +1766,55 @@ class Analyzer:
         if name == "mod":
             a, b = _coerce_pair(args[0], args[1])
             return call("modulus", a.type, a, b)
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor"):
+            return call(
+                name, T.BIGINT,
+                _coerce_to(args[0], T.BIGINT), _coerce_to(args[1], T.BIGINT),
+            )
+        if name == "bitwise_not":
+            return call(name, T.BIGINT, _coerce_to(args[0], T.BIGINT))
+        if name in ("bitwise_left_shift", "bitwise_right_shift",
+                    "bitwise_right_shift_arithmetic", "shiftleft", "shiftright"):
+            canon = {
+                "shiftleft": "bitwise_left_shift",
+                "shiftright": "bitwise_right_shift",
+            }.get(name, name)
+            return call(
+                canon, T.BIGINT,
+                _coerce_to(args[0], T.BIGINT), _coerce_to(args[1], T.BIGINT),
+            )
+        if name == "hash64":
+            return call("hash64", T.BIGINT, _coerce_to(args[0], T.BIGINT))
+        if name == "width_bucket":
+            return call(
+                "width_bucket", T.BIGINT,
+                _coerce_to(args[0], T.DOUBLE), _coerce_to(args[1], T.DOUBLE),
+                _coerce_to(args[2], T.DOUBLE), _coerce_to(args[3], T.BIGINT),
+            )
+        if name in ("format_datetime", "date_format"):
+            if not isinstance(args[1], Constant):
+                raise SemanticError(f"{name} pattern must be a literal")
+            return call(name, T.VARCHAR, args[0], args[1])
+        if name in ("json_extract_scalar", "json_extract"):
+            return call(name, T.VARCHAR, *args)
+        if name == "cardinality":
+            if not isinstance(args[0].type, T.ArrayType):
+                raise SemanticError("cardinality requires an ARRAY argument")
+            return call("cardinality", T.BIGINT, args[0])
+        if name == "element_at":
+            if not isinstance(args[0].type, T.ArrayType):
+                raise SemanticError("element_at requires an ARRAY argument")
+            return call(
+                "element_at", args[0].type.element, args[0],
+                _coerce_to(args[1], T.BIGINT),
+            )
+        if name == "contains":
+            if not isinstance(args[0].type, T.ArrayType):
+                raise SemanticError("contains requires an ARRAY argument")
+            return call(
+                "array_contains", T.BOOLEAN, args[0],
+                _coerce_to(args[1], args[0].type.element),
+            )
         if name == "power" or name == "pow":
             return call(
                 "power",
@@ -2039,10 +2299,12 @@ def _collect_windows(e: t.Node, out: list) -> None:
 _DERIVED_AGGS = {
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or", "every",
+    "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
+    "checksum",
 }
 AGGREGATE_NAMES = {
     "sum", "count", "avg", "min", "max", "count_if", "approx_distinct",
-    "arbitrary", "any_value",
+    "arbitrary", "any_value", "array_agg",
 } | _DERIVED_AGGS
 
 
@@ -2229,6 +2491,18 @@ _MATH_DOUBLE_FNS = {
     "ln", "log2", "log10", "exp", "sin", "cos", "tan", "asin", "acos",
     "atan", "sinh", "cosh", "tanh", "cbrt", "degrees", "radians",
 }
+
+
+def _unwrap_unnest(rel: t.Node):
+    """(Unnest ast, alias, column_aliases) if rel is UNNEST (possibly
+    aliased), else (None, None, ())."""
+    if isinstance(rel, t.Unnest):
+        return rel, None, ()
+    if isinstance(rel, t.AliasedRelation) and isinstance(rel.relation, t.Unnest):
+        return rel.relation, rel.alias.lower(), tuple(
+            c.lower() for c in rel.column_aliases
+        )
+    return None, None, ()
 
 
 def _expand_quantified(e: "t.QuantifiedComparison") -> t.Node:

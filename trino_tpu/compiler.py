@@ -106,6 +106,62 @@ def _check_int_overflow(name, rt, a64, b64, r64, valid):
         raise ArithmeticError(f"{rt.name} overflow")
 
 
+def _pool_values_pair(et, vals, codes, valid, ec) -> Pair:
+    """Per-pool-code values -> (data, valid) pair of element type ``et``
+    (strings re-enter the dictionary machinery via the compiler's unified
+    dictionary when present)."""
+    present = np.asarray([v is not None for v in vals] + [False], dtype=np.bool_)
+    if T.is_string(et):
+        # string elements need the projection's unified dictionary to
+        # absorb pool values — UNNEST covers that shape today
+        raise NotImplementedError(
+            "element_at over ARRAY(varchar) — use UNNEST"
+        )
+    table = np.asarray(
+        [v if v is not None else 0 for v in vals] + [0],
+        dtype=et.storage_dtype,
+    )
+    out = jnp.asarray(table)[jnp.clip(codes, 0, len(table) - 1)]
+    out_valid = valid & jnp.asarray(present)[jnp.clip(codes, 0, len(present) - 1)]
+    return out, out_valid
+
+
+def _avalanche64(x):
+    """xxhash64/murmur3 finalizer over int64 lanes."""
+    x = x.astype(jnp.uint64)
+    x = x ^ (x >> jnp.uint64(33))
+    x = x * jnp.uint64(0xFF51AFD7ED558CCD)
+    x = x ^ (x >> jnp.uint64(33))
+    x = x * jnp.uint64(0xC4CEB9FE1A85EC53)
+    x = x ^ (x >> jnp.uint64(33))
+    return x.astype(jnp.int64)
+
+
+def _widen_storage(data):
+    """Any decimal storage -> wide (n, 2) lanes."""
+    if _is_wide(data):
+        return data
+    from trino_tpu.ops.decimal128 import widen_i64
+
+    hi, lo = widen_i64(data.astype(jnp.int64))
+    return jnp.stack([hi, lo], axis=1)
+
+
+def _where_pair(mask, x, y):
+    """jnp.where that follows wide (n, 2) operands (mask stays (n,))."""
+    if _is_wide(x) or _is_wide(y):
+        return jnp.where(mask[:, None], _widen_storage(x), _widen_storage(y))
+    return jnp.where(mask, x, y)
+
+
+def _wide_to_double(data, scale: int):
+    lo_u = data[:, 1].astype(jnp.float64) + jnp.where(
+        data[:, 1] < 0, jnp.float64(2**64), jnp.float64(0)
+    )
+    f = data[:, 0].astype(jnp.float64) * jnp.float64(2**64) + lo_u
+    return f / (10**scale)
+
+
 def _narrow_checked(data, what: str):
     """Wide storage -> int64, erroring if any value does not fit."""
     if not _is_wide(data):
@@ -211,14 +267,14 @@ class ExprCompiler:
         if form == "if":
             cond, then, other = (self._eval(a) for a in expr.args)
             take_then = cond[0] & cond[1]
-            data = jnp.where(take_then, then[0], other[0])
+            data = _where_pair(take_then, then[0], other[0])
             valid = jnp.where(take_then, then[1], other[1])
             return data, valid
         if form == "coalesce":
             data, valid = self._eval(expr.args[0])
             for a in expr.args[1:]:
                 d2, v2 = self._eval(a)
-                data = jnp.where(valid, data, d2)
+                data = _where_pair(valid, data, d2)
                 valid = valid | v2
             return data, valid
         if form == "is_null":
@@ -226,7 +282,26 @@ class ExprCompiler:
             return ~v, jnp.ones_like(v)
         if form == "null_if":
             a, b = self._eval(expr.args[0]), self._eval(expr.args[1])
-            eq = (a[0] == b[0]) & a[1] & b[1]
+            if _is_wide(a[0]) or _is_wide(b[0]):
+                from trino_tpu.ops.decimal128 import compare128
+
+                sa = _dec_scale(expr.args[0].type)
+                sb = _dec_scale(expr.args[1].type)
+                s = max(sa, sb)
+                ahi, alo = _as_pair128(a[0], sa, s)
+                bhi, blo = _as_pair128(b[0], sb, s)
+                same = compare128(ahi, alo, bhi, blo) == 0
+                eq = same & a[1] & b[1]
+            else:
+                sa = _dec_scale(expr.args[0].type)
+                sb = _dec_scale(expr.args[1].type)
+                if sa != sb:
+                    s = max(sa, sb)
+                    ad = _rescale(a[0].astype(jnp.int64), sa, s)
+                    bd = _rescale(b[0].astype(jnp.int64), sb, s)
+                    eq = (ad == bd) & a[1] & b[1]
+                else:
+                    eq = (a[0] == b[0]) & a[1] & b[1]
             return a[0], a[1] & ~eq
         if form == "in":
             # args[0] IN (args[1:]) — chain of equality ORs (small lists)
@@ -300,10 +375,113 @@ class ExprCompiler:
             a, av = self._eval(expr.args[0])
             b, bv = self._eval(expr.args[1])
             return jnp.power(a, b), av & bv
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_left_shift", "bitwise_right_shift",
+                    "bitwise_right_shift_arithmetic"):
+            a, av = self._eval(expr.args[0])
+            b, bv = self._eval(expr.args[1])
+            a = a.astype(jnp.int64)
+            b = b.astype(jnp.int64)
+            if name == "bitwise_and":
+                r = a & b
+            elif name == "bitwise_or":
+                r = a | b
+            elif name == "bitwise_xor":
+                r = a ^ b
+            elif name == "bitwise_left_shift":
+                shifted = (
+                    a.astype(jnp.uint64) << (b.astype(jnp.uint64) & jnp.uint64(63))
+                ).astype(jnp.int64)
+                r = jnp.where(b >= 64, jnp.int64(0), shifted)
+            elif name == "bitwise_right_shift":
+                shifted = (
+                    a.astype(jnp.uint64) >> (b.astype(jnp.uint64) & jnp.uint64(63))
+                ).astype(jnp.int64)
+                r = jnp.where(b >= 64, jnp.int64(0), shifted)
+            else:  # arithmetic right shift: >=64 saturates to the sign fill
+                r = jnp.where(b >= 64, a >> jnp.int64(63), a >> (b & jnp.int64(63)))
+            return r, av & bv
+        if name == "bitwise_not":
+            d, v = self._eval(expr.args[0])
+            return ~d.astype(jnp.int64), v
+        if name == "hash64":
+            # xxhash64-style avalanche finalizer (checksum building block)
+            d, v = self._eval(expr.args[0])
+            at = expr.args[0].type
+            if _is_wide(d):
+                # mix both 64-bit lanes
+                lanes = _avalanche64(d[:, 0]) ^ _avalanche64(
+                    d[:, 1] ^ jnp.int64(0x5851F42D4C957F2D - 2**63)
+                )
+                out = lanes
+            elif isinstance(at, (T.DoubleType, T.RealType)):
+                # decompose (no f64 bitcasts on TPU x64): mantissa + exponent
+                m, e = jnp.frexp(d.astype(jnp.float64))
+                im = (m * (2.0**53)).astype(jnp.int64)
+                out = _avalanche64(im ^ (e.astype(jnp.int64) << jnp.int64(53)))
+            else:
+                out = _avalanche64(d.astype(jnp.int64))
+            # NULL hashes to a fixed constant so checksum reflects NULLs
+            return jnp.where(v, out, jnp.int64(0x9E3779B97F4A7C15 - 2**63)), jnp.ones_like(v)
+        if name == "str_hash64":
+            # content hash of a dictionary string column (deterministic
+            # across processes/dictionary assignments)
+            import hashlib
+
+            col_e = expr.args[0]
+            d, v = self._eval(col_e)
+            dictionary = self._arg_dictionary(col_e)
+            if dictionary is None:
+                raise ValueError("str_hash64 on string column without dictionary")
+            table = np.asarray(
+                [
+                    int.from_bytes(
+                        hashlib.blake2b(
+                            s.encode("utf-8", "surrogatepass"), digest_size=8
+                        ).digest(),
+                        "little",
+                        signed=True,
+                    )
+                    for s in dictionary.values
+                ]
+                + [0],
+                dtype=np.int64,
+            )
+            out = jnp.asarray(table)[jnp.clip(d, 0, len(table) - 1)]
+            return jnp.where(v, out, jnp.int64(0x9E3779B97F4A7C15 - 2**63)), jnp.ones_like(v)
+        if name == "width_bucket":
+            x, xv = self._eval(expr.args[0])
+            lo, lov = self._eval(expr.args[1])
+            hi, hiv = self._eval(expr.args[2])
+            nb, nbv = self._eval(expr.args[3])
+            nb = nb.astype(jnp.int64)
+            valid = xv & lov & hiv & nbv
+            try:
+                if bool(jnp.any(valid & (hi == lo))):
+                    raise ArithmeticError("width_bucket bounds cannot be equal")
+            except ArithmeticError:
+                raise
+            except Exception:  # noqa: BLE001 — traced: skip the eager check
+                pass
+            span = jnp.where(hi == lo, 1.0, hi - lo)
+            raw = (jnp.floor((x - lo) / span * nb.astype(jnp.float64)) + 1).astype(
+                jnp.int64
+            )
+            asc = jnp.where(
+                x < lo, jnp.int64(0), jnp.where(x >= hi, nb + 1, raw)
+            )
+            # descending bounds (bound1 > bound2): reference supports both
+            desc = jnp.where(
+                x > lo, jnp.int64(0), jnp.where(x <= hi, nb + 1, raw)
+            )
+            r = jnp.where(lo <= hi, asc, desc)
+            return r.astype(jnp.int64), valid
         if name == "like":
             return self._like(expr)
         if name in ("length", "strpos", "starts_with"):
             return self._string_table(expr)
+        if name in ("cardinality", "element_at", "array_contains"):
+            return self._array_table(expr)
         if name == "substr_pred":  # reserved for host-eval string predicates
             raise NotImplementedError
         if name == "sqrt":
@@ -588,6 +766,50 @@ class ExprCompiler:
             return _rescale(r, s, rs), valid & (bn != 0)
         raise AssertionError(name)
 
+    def _array_table(self, expr: Call) -> Pair:
+        """Array functions over pool-coded arrays: per-code host lookup
+        tables gathered on device (the dictionary-function pattern —
+        reference scalars: ArrayFunctions / spi/block/ArrayBlock)."""
+        col_e = expr.args[0]
+        if isinstance(col_e, Constant):
+            from trino_tpu.columnar import Dictionary
+
+            pool = Dictionary([col_e.value if col_e.value is not None else ()])
+            d = jnp.zeros(self.n, dtype=jnp.int32)
+            v = jnp.full(self.n, col_e.value is not None, dtype=jnp.bool_)
+        else:
+            d, v = self._eval(col_e)
+            pool = self._arg_dictionary(col_e)
+        if pool is None:
+            raise ValueError(f"{expr.name} on array column without value pool")
+        tuples = pool.values
+        name = expr.name
+        if name == "cardinality":
+            table = np.asarray([len(t_) for t_ in tuples] + [0], dtype=np.int64)
+            out = jnp.asarray(table)[jnp.clip(d, 0, len(table) - 1)]
+            return out, v
+        if name == "element_at":
+            idx_e = expr.args[1]
+            if not isinstance(idx_e, Constant) or idx_e.value is None:
+                raise NotImplementedError("element_at index must be a literal")
+            i = int(idx_e.value)
+            et = expr.type
+            vals = []
+            for t_ in tuples:
+                j = i - 1 if i > 0 else len(t_) + i
+                vals.append(t_[j] if 0 <= j < len(t_) else None)
+            return _pool_values_pair(et, vals, d, v, self)
+        # array_contains
+        lit_e = expr.args[1]
+        if not isinstance(lit_e, Constant) or lit_e.value is None:
+            raise NotImplementedError("contains value must be a literal")
+        needle = lit_e.value
+        table = np.asarray(
+            [needle in t_ for t_ in tuples] + [False], dtype=np.bool_
+        )
+        out = jnp.asarray(table)[jnp.clip(d, 0, len(table) - 1)]
+        return out, v
+
     def _compare(self, expr: Call) -> Pair:
         a_e, b_e = expr.args
         a_t, b_t = a_e.type, b_e.type
@@ -598,6 +820,13 @@ class ExprCompiler:
         valid = _all_valid(a, b)
         sa, sb = _dec_scale(a_t), _dec_scale(b_t)
         if _is_wide(a[0]) or _is_wide(b[0]):
+            if isinstance(a_t, (T.DoubleType, T.RealType)) or isinstance(
+                b_t, (T.DoubleType, T.RealType)
+            ):
+                # mixed wide-decimal / float: compare in double space
+                ad = _wide_to_double(a[0], sa) if _is_wide(a[0]) else a[0]
+                bd = _wide_to_double(b[0], sb) if _is_wide(b[0]) else b[0]
+                return _cmp_op(expr.name, ad, bd), valid
             from trino_tpu.ops.decimal128 import compare128
 
             s = max(sa, sb)
@@ -762,19 +991,21 @@ class ExprCompiler:
             if isinstance(rt, (T.DoubleType, T.RealType)) and isinstance(
                 st, T.DecimalType
             ):
-                # (hi, lo) -> float: hi*2^64 + unsigned(lo), then unscale
-                lo_u = d[:, 1].astype(jnp.float64) + jnp.where(
-                    d[:, 1] < 0, jnp.float64(2**64), jnp.float64(0)
-                )
-                f = d[:, 0].astype(jnp.float64) * jnp.float64(2**64) + lo_u
-                return (f / st.unscale).astype(rt.storage_dtype), v
+                return _wide_to_double(d, st.scale).astype(rt.storage_dtype), v
+            if (
+                isinstance(rt, T.DecimalType)
+                and isinstance(st, T.DecimalType)
+                and rt.wide
+                and rt.scale >= st.scale
+            ):
+                # wide -> wide upscale stays in (hi, lo) lanes
+                hi, lo = _as_pair128(d, st.scale, rt.scale)
+                return jnp.stack([hi, lo], axis=1), v
             # other casts narrow at runtime (exact when values fit int64)
             d = _narrow_checked(d, f"cast {st} -> {rt}")
         if isinstance(rt, T.DecimalType):
             if isinstance(st, T.DecimalType):
                 if rt.wide and rt.scale >= st.scale:
-                    from trino_tpu.ops import decimal128 as D128
-
                     hi, lo = _as_pair128(d, st.scale, rt.scale)
                     return jnp.stack([hi, lo], axis=1), v
                 return _rescale(d.astype(jnp.int64), st.scale, rt.scale), v
@@ -844,6 +1075,10 @@ def _cast_numeric(data, from_t: T.SqlType, to_t: T.SqlType):
         return data
     if isinstance(from_t, T.DecimalType):
         if isinstance(to_t, (T.DoubleType, T.RealType)):
+            if _is_wide(data):
+                return _wide_to_double(data, from_t.scale).astype(
+                    to_t.storage_dtype
+                )
             return (data.astype(jnp.float64) / from_t.unscale).astype(to_t.storage_dtype)
         return data  # decimal handled by caller
     if isinstance(from_t, T.DateType) and isinstance(to_t, T.TimestampType):

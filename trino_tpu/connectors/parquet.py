@@ -1,0 +1,159 @@
+"""Parquet connector: directory-of-files tables (the hive-style layout).
+
+Reference: ``plugin/trino-hive`` selecting ``lib/trino-parquet`` readers
+(``HivePageSourceProvider``); splits are (file, row-group) pairs and
+row-group statistics drive TupleDomain split pruning
+(``TupleDomainParquetPredicate``). Layout: ``<root>/<schema>/<table>/*.parquet``;
+schema is read from the first file's footer.
+
+Writes (CTAS/INSERT) produce one parquet file per insert via the
+from-scratch writer in :mod:`trino_tpu.formats.parquet`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Sequence
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch
+from trino_tpu.connectors.api import ColumnSchema, Connector, Split, TableSchema
+from trino_tpu.formats import parquet as PQ
+
+
+class ParquetConnector(Connector):
+    name = "parquet"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        # (path, mtime) -> FileMeta; footers are small and hot
+        self._meta_cache: dict[tuple[str, float], PQ.FileMeta] = {}
+        self._write_lock = threading.Lock()
+
+    # --- layout -----------------------------------------------------------
+
+    def _table_dir(self, schema: str, table: str) -> str:
+        return os.path.join(self.root, schema, table)
+
+    def _files(self, schema: str, table: str) -> list[str]:
+        d = self._table_dir(schema, table)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            os.path.join(d, f) for f in os.listdir(d) if f.endswith(".parquet")
+        )
+
+    def _meta(self, path: str) -> PQ.FileMeta:
+        mtime = os.path.getmtime(path)
+        key = (path, mtime)
+        meta = self._meta_cache.get(key)
+        if meta is None:
+            with open(path, "rb") as f:
+                meta = PQ.read_footer(f.read())
+            self._meta_cache[key] = meta
+        return meta
+
+    # --- metadata ---------------------------------------------------------
+
+    def list_schemas(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return ["default"]
+        return sorted(
+            {
+                d
+                for d in os.listdir(self.root)
+                if os.path.isdir(os.path.join(self.root, d))
+            }
+            | {"default"}
+        )
+
+    def list_tables(self, schema: str) -> list[str]:
+        d = os.path.join(self.root, schema)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            t
+            for t in os.listdir(d)
+            if os.path.isdir(os.path.join(d, t))
+        )
+
+    def get_table(self, schema: str, table: str) -> Optional[TableSchema]:
+        files = self._files(schema, table)
+        if not files:
+            return None
+        meta = self._meta(files[0])
+        return TableSchema(
+            table,
+            tuple(
+                ColumnSchema(c.name, c.sql_type()) for c in meta.schema
+            ),
+        )
+
+    # --- splits: one per (file, row group) --------------------------------
+
+    def get_splits(self, schema, table, target_splits, constraint=None):
+        pairs = []
+        for path in self._files(schema, table):
+            meta = self._meta(path)
+            for rg in range(len(meta.row_groups)):
+                pairs.append((path, rg))
+        splits = [
+            Split(table, i, len(pairs), info=pair)
+            for i, pair in enumerate(pairs)
+        ]
+        return self.prune_splits(schema, table, splits, constraint)
+
+    def split_stats(self, schema, table, split):
+        path, rg = split.info
+        return PQ.row_group_stats(self._meta(path), rg)
+
+    def read_split(
+        self, schema, table, columns: Sequence[str], split
+    ) -> Batch:
+        path, rg = split.info
+        with open(path, "rb") as f:
+            data = f.read()
+        return PQ.read_batch(data, self._meta(path), rg, list(columns))
+
+    def estimate_rows(self, schema, table) -> Optional[int]:
+        files = self._files(schema, table)
+        if not files:
+            return None
+        return sum(self._meta(p).num_rows for p in files)
+
+    # --- writes -----------------------------------------------------------
+
+    def create_table(self, schema, table, schema_def: TableSchema) -> None:
+        d = self._table_dir(schema, table)
+        if os.path.isdir(d) and self._files(schema, table):
+            raise ValueError(f"table already exists: {schema}.{table}")
+        os.makedirs(d, exist_ok=True)
+        self._pending_schema = schema_def  # first insert writes the footer
+
+    def insert(self, schema, table, batch: Batch) -> int:
+        d = self._table_dir(schema, table)
+        if not os.path.isdir(d):
+            raise KeyError(f"table not found: {schema}.{table}")
+        ts = self.get_table(schema, table)
+        names = (
+            [c.name for c in ts.columns]
+            if ts is not None
+            else [c.name for c in getattr(self, "_pending_schema").columns]
+        )
+        with self._write_lock:
+            n = len(self._files(schema, table))
+            path = os.path.join(d, f"part-{n:05d}.parquet")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                PQ.write_parquet(f, names, [batch])
+            os.replace(tmp, path)
+        return batch.compact().num_rows
+
+    def drop_table(self, schema, table) -> None:
+        import shutil
+
+        d = self._table_dir(schema, table)
+        if os.path.isdir(d):
+            shutil.rmtree(d)

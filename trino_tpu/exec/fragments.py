@@ -21,8 +21,9 @@ Execution model:
   semantics reference.
 - Capacities (group budgets, join output sizes, exchange buckets) are
   static per compile; kernels report overflow flags and the host retries
-  with doubled capacities (shape-bucketed, so retries hit the persistent
-  compile cache).
+  with doubled capacities. Programs are re-TRACED per query (the reference
+  likewise re-plans per query); identical programs skip XLA compilation
+  via the persistent on-disk compile cache enabled in trino_tpu.__init__.
 """
 
 from __future__ import annotations
@@ -172,6 +173,10 @@ class FragmentedExecutor(DistributedExecutor):
         try:
             return self._execute_fragments(sub)
         except FusedUnsupported:
+            return super().execute(node)
+        except jax.errors.TracerArrayConversionError:
+            # an operator needed host values mid-trace (e.g. datetime
+            # formatting over unique values) — interpret instead
             return super().execute(node)
 
     # === fragment scheduling ============================================

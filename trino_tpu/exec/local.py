@@ -202,6 +202,9 @@ class LocalExecutor:
         res = self._exec(node.source)
         expr = self._bind(node.predicate, res.layout)
         cols = list(res.batch.columns)
+        from trino_tpu.datetimefmt import lower_datetime_format_calls
+
+        expr = lower_datetime_format_calls(expr, cols)
         expr = lower_string_calls(expr, cols)
         mask = ExprCompiler(cols).predicate_mask(expr)
         sel = mask if res.batch.sel is None else (mask & res.batch.sel)
@@ -215,13 +218,41 @@ class LocalExecutor:
         res = self._exec(node.source)
         work_cols = list(res.batch.columns)
         cols: list[Column] = []
+        from trino_tpu.datetimefmt import lower_datetime_format_calls
+
         for sym, expr in node.assignments:
             bound = self._bind(expr, res.layout)
+            bound = lower_datetime_format_calls(bound, work_cols)
             bound = lower_string_calls(bound, work_cols)
             ec = ExprCompiler(work_cols)
             if isinstance(bound, InputRef):
                 cols.append(work_cols[bound.channel])
                 continue
+            if isinstance(sym.type, T.ArrayType):
+                if isinstance(bound, Constant):
+                    n = res.batch.capacity
+                    if bound.value is None:
+                        cols.append(
+                            Column(
+                                sym.type,
+                                np.full(n, -1, dtype=np.int32),
+                                np.zeros(n, dtype=np.bool_),
+                                Dictionary([]),
+                            )
+                        )
+                    else:
+                        cols.append(
+                            Column(
+                                sym.type,
+                                np.zeros(n, dtype=np.int32),
+                                None,
+                                Dictionary([bound.value]),
+                            )
+                        )
+                    continue
+                raise ExecutionError(
+                    "computed ARRAY expressions are not supported yet"
+                )
             if T.is_string(sym.type):
                 if isinstance(bound, Constant):
                     n = res.batch.capacity
@@ -259,6 +290,83 @@ class LocalExecutor:
             cols.append(Column(sym.type, data, valid))
         layout = {s.name: i for i, (s, _) in enumerate(node.assignments)}
         return Result(Batch(cols, res.batch.num_rows, res.batch.sel), layout)
+
+    def _exec_unnest(self, node: P.Unnest) -> Result:
+        """Expand array values into rows (UnnestOperator.java:39). A
+        row-count-changing host boundary: arrays are pool tuples, so the
+        expansion is np.repeat over row indices + typed element columns."""
+        res = self._exec(node.source)
+        b = res.batch.compact()
+        n = b.num_rows
+        per_expr: list[tuple[list, np.ndarray]] = []  # (pool tuples per row)
+        for expr in node.array_exprs:
+            bound = self._bind(expr, res.layout)
+            if isinstance(bound, Constant):
+                tuples = [
+                    bound.value if bound.value is not None else () for _ in range(n)
+                ]
+            else:
+                work = list(b.columns)
+                ec = ExprCompiler(work)
+                data, valid = ec.evaluate(bound)
+                pool = None
+                if isinstance(bound, InputRef):
+                    pool = work[bound.channel].dictionary
+                if pool is None:
+                    raise ExecutionError("UNNEST argument has no array pool")
+                data_np = np.asarray(data)
+                valid_np = np.asarray(valid)
+                tuples = [
+                    pool.values[int(data_np[i])] if valid_np[i] else ()
+                    for i in range(n)
+                ]
+            per_expr.append(tuples)
+        lengths = np.asarray(
+            [
+                max((len(tuples[i]) for tuples in per_expr), default=0)
+                for i in range(n)
+            ],
+            dtype=np.int64,
+        )
+        row_idx = np.repeat(np.arange(n), lengths)
+        cols: list[Column] = []
+        layout: dict[str, int] = {}
+        for s in node.source.output_symbols:
+            c = b.columns[res.layout[s.name]]
+            data, valid = c.to_numpy()
+            cols.append(
+                Column(
+                    c.type,
+                    data[row_idx],
+                    None if valid[row_idx].all() else valid[row_idx],
+                    c.dictionary,
+                )
+            )
+            layout[s.name] = len(cols) - 1
+        for sym, tuples in zip(node.element_symbols, per_expr):
+            vals: list = []
+            for i in range(n):
+                t_ = tuples[i]
+                ln = int(lengths[i])
+                for j in range(ln):
+                    v = t_[j] if j < len(t_) else None
+                    if v is not None and isinstance(sym.type, T.DecimalType):
+                        # pool holds storage ints; from_values wants logical
+                        from decimal import Decimal as _D
+
+                        v = _D(int(v)) / sym.type.unscale
+                    elif v is not None and isinstance(sym.type, T.DateType):
+                        v = int(v)
+                    vals.append(v)
+            cols.append(Column.from_values(sym.type, vals))
+            layout[sym.name] = len(cols) - 1
+        if node.ordinality is not None:
+            ords = np.concatenate(
+                [np.arange(1, ln + 1, dtype=np.int64) for ln in lengths]
+            ) if len(lengths) else np.zeros(0, dtype=np.int64)
+            cols.append(Column(T.BIGINT, ords))
+            layout[node.ordinality.name] = len(cols) - 1
+        return Result(Batch(cols, int(lengths.sum())), layout)
 
     def _exec_limit(self, node: P.Limit) -> Result:
         res = self._exec(node.source)
@@ -535,6 +643,106 @@ class LocalExecutor:
             Batch(cols, ng), {s.name: i for i, s in enumerate(node.output_symbols)}
         )
 
+    def _aggregate_with_array_agg(self, node: P.Aggregate, res: Result) -> Result:
+        """array_agg collects values into pool-coded arrays host-side
+        (groups are small relative to rows; the per-row work stayed on
+        device in the feeding operators). Other aggregates in the same
+        GROUP BY run through the normal kernels and are stitched back."""
+        others = [
+            (s, fn) for s, fn in node.aggregates if fn.kind != "array_agg"
+        ]
+        base = P.Aggregate(node.source, node.group_keys, others, node.step)
+        out = self._aggregate_result(base, res)
+        ng = out.batch.num_rows
+
+        # host view of the input rows
+        sel = np.asarray(res.batch.selection_mask())
+        key_vals = []
+        for k in node.group_keys:
+            c = res.column(k)
+            d, v = c.to_numpy()
+            key_vals.append((d, v))
+
+        def key_of(i):
+            return tuple(
+                (int(d[i]), bool(v[i])) for d, v in key_vals
+            )
+
+        # group membership in output order
+        out_keys = {}
+        for gi in range(ng):
+            parts = []
+            for k in node.group_keys:
+                c = out.batch.columns[out.layout[k.name]]
+                d, v = c.to_numpy()
+                parts.append((int(d[gi]), bool(v[gi])))
+            out_keys[tuple(parts)] = gi
+
+        from trino_tpu.columnar import Dictionary as _Dict
+
+        cols = list(out.batch.columns)
+        layout = dict(out.layout)
+        for sym, fn in node.aggregates:
+            if fn.kind != "array_agg":
+                continue
+            c = res.column(P.Symbol(fn.argument.name, fn.argument.type))
+            d, v = c.to_numpy()
+            fmask = np.ones(len(d), dtype=bool)
+            if fn.filter is not None:
+                fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
+                fd, fv = fc.to_numpy()
+                fmask = fd & fv
+            groups: dict = {k: [] for k in out_keys}
+            for i in np.nonzero(sel & fmask)[0]:
+                k = key_of(i)
+                if k not in groups:
+                    continue
+                if not v[i]:
+                    groups[k].append(None)  # array_agg keeps NULLs
+                elif c.dictionary is not None:
+                    groups[k].append(c.dictionary.decode(int(d[i])))
+                else:
+                    groups[k].append(
+                        d[i].item() if hasattr(d[i], "item") else d[i]
+                    )
+            tuples: list = [()] * max(ng, 1)
+            valid_out = np.zeros(max(ng, 1), dtype=bool)
+            for k, gi in out_keys.items():
+                vals = groups.get(k, [])
+                tuples[gi] = tuple(vals)
+                valid_out[gi] = bool(vals)
+            if not node.group_keys:
+                # global: exactly one row; empty input -> NULL array
+                vals = groups.get((), [])
+                tuples = [tuple(vals)]
+                valid_out = np.asarray([bool(vals)])
+            pool_index: dict = {}
+            pool_vals: list = []
+            codes = np.empty(len(tuples), dtype=np.int32)
+            for gi, t_ in enumerate(tuples):
+                code = pool_index.get(t_)
+                if code is None:
+                    code = len(pool_vals)
+                    pool_index[t_] = code
+                    pool_vals.append(t_)
+                codes[gi] = code
+            codes = np.where(valid_out, codes, -1).astype(np.int32)
+            pool = _Dict(pool_vals)
+            cols.append(
+                Column(
+                    sym.type, codes,
+                    None if valid_out.all() else valid_out, pool,
+                )
+            )
+            layout[sym.name] = len(cols) - 1
+        # reorder to the node's declared output order
+        ordered = []
+        final_layout = {}
+        for s in node.output_symbols:
+            ordered.append(cols[layout[s.name]])
+            final_layout[s.name] = len(ordered) - 1
+        return Result(Batch(ordered, out.batch.num_rows), final_layout)
+
     def _spill_aggregate(self, node: P.Aggregate, res: Result) -> Result:
         """Partitioned (spill-to-host) group-by: rows hash-partitioned by
         group keys; each partition aggregated on device independently
@@ -574,6 +782,8 @@ class LocalExecutor:
     def _aggregate_result(
         self, node: P.Aggregate, res: Result, allow_spill: bool = True
     ) -> Result:
+        if any(fn.kind == "array_agg" for _, fn in node.aggregates):
+            return self._aggregate_with_array_agg(node, res)
         res = self._nonempty(res)
         if (
             allow_spill
@@ -799,9 +1009,14 @@ class LocalExecutor:
                 sym = P.Symbol(wf.argument.name, wf.argument.type)
                 c = res.column(sym)
                 data, valid = c.data, c.valid_mask()
+                if getattr(data, "ndim", 1) == 2:
+                    # window kernels run in int64 lanes; narrow at runtime
+                    # (errors if wide values genuinely exceed 18 digits)
+                    from trino_tpu.compiler import _narrow_checked
+
+                    data = _narrow_checked(data, "window over DECIMAL(38)")
                 if c.dictionary is not None and wf.kind in ("min", "max"):
-                    r = jnp.asarray(c.dictionary.ranks())
-                    data = r[jnp.maximum(data, 0)]
+                    data = rank_codes(c.dictionary, data)
                     mm_dict = c.dictionary
                 elif c.dictionary is not None:
                     out_dict = c.dictionary

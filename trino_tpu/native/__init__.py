@@ -73,6 +73,14 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.tt_lz_compress.argtypes = [u8p, i64, u8p]
     lib.tt_lz_decompress.restype = i64
     lib.tt_lz_decompress.argtypes = [u8p, i64, u8p, i64]
+    lib.tt_snappy_decompress.restype = i64
+    lib.tt_snappy_decompress.argtypes = [u8p, i64, u8p, i64]
+    lib.tt_snappy_compress.restype = i64
+    lib.tt_snappy_compress.argtypes = [u8p, i64, u8p]
+    lib.tt_parquet_rle_decode.restype = i64
+    lib.tt_parquet_rle_decode.argtypes = [u8p, i64, ctypes.c_int32, i64, i32p]
+    lib.tt_parquet_rle_encode.restype = i64
+    lib.tt_parquet_rle_encode.argtypes = [i32p, i64, ctypes.c_int32, u8p]
     return lib
 
 
@@ -283,6 +291,178 @@ def bitpack_decode(data: bytes, n: int, width: int) -> np.ndarray:
     for b in range(width):
         out |= bits[:, b] << np.uint64(b)
     return out
+
+
+def snappy_decompress(data: bytes, expected_len: int) -> bytes:
+    """Snappy block format (Parquet's default codec). Python fallback
+    implements the same tagged literal/copy stream."""
+    if not data:
+        return b""
+    if _LIB is not None:
+        inp = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(max(expected_len, 1), dtype=np.uint8)
+        ln = _LIB.tt_snappy_decompress(
+            _ptr(inp, ctypes.c_uint8), len(data), _ptr(out, ctypes.c_uint8),
+            max(expected_len, 1),
+        )
+        if ln < 0:
+            raise ValueError("corrupt snappy page")
+        return out[:ln].tobytes()
+    # pure-python fallback
+    ip = 0
+    ulen = 0
+    shift = 0
+    while True:
+        b = data[ip]
+        ip += 1
+        ulen |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    out = bytearray()
+    n = len(data)
+    while ip < n:
+        tag = data[ip]
+        ip += 1
+        kind = tag & 3
+        if kind == 0:
+            ln = (tag >> 2) + 1
+            if (tag >> 2) >= 60:
+                nb = (tag >> 2) - 59
+                ln = int.from_bytes(data[ip : ip + nb], "little") + 1
+                ip += nb
+            out += data[ip : ip + ln]
+            ip += ln
+        else:
+            if kind == 1:
+                ln = ((tag >> 2) & 7) + 4
+                off = ((tag >> 5) << 8) | data[ip]
+                ip += 1
+            elif kind == 2:
+                ln = (tag >> 2) + 1
+                off = int.from_bytes(data[ip : ip + 2], "little")
+                ip += 2
+            else:
+                ln = (tag >> 2) + 1
+                off = int.from_bytes(data[ip : ip + 4], "little")
+                ip += 4
+            for _ in range(ln):
+                out.append(out[-off])
+    return bytes(out)
+
+
+def snappy_compress(data: bytes) -> bytes:
+    """Literal-only snappy stream (valid for any decoder)."""
+    if _LIB is not None and data:
+        inp = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(len(data) + len(data) // 64 + 32, dtype=np.uint8)
+        ln = _LIB.tt_snappy_compress(
+            _ptr(inp, ctypes.c_uint8), len(data), _ptr(out, ctypes.c_uint8)
+        )
+        return out[:ln].tobytes()
+    out = bytearray()
+    ulen = len(data)
+    while ulen >= 0x80:
+        out.append((ulen & 0x7F) | 0x80)
+        ulen >>= 7
+    out.append(ulen)
+    ip = 0
+    while ip < len(data):
+        chunk = min(len(data) - ip, 65536)
+        ln = chunk - 1
+        if ln < 60:
+            out.append(ln << 2)
+        else:
+            out.append(61 << 2)  # 61 => two length bytes
+            out += (ln).to_bytes(2, "little")
+        out += data[ip : ip + chunk]
+        ip += chunk
+    return bytes(out)
+
+
+def parquet_rle_decode(data: bytes, bit_width: int, n: int) -> np.ndarray:
+    """Parquet RLE/bit-packed hybrid (def levels, dictionary indices)."""
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    if bit_width == 0:
+        return np.zeros(n, dtype=np.int32)
+    if _LIB is not None:
+        buf = np.frombuffer(data, dtype=np.uint8)
+        out = np.empty(n, dtype=np.int32)
+        rc = _LIB.tt_parquet_rle_decode(
+            _ptr(buf, ctypes.c_uint8), len(buf), bit_width, n,
+            _ptr(out, ctypes.c_int32),
+        )
+        if rc < 0:
+            raise ValueError("corrupt parquet RLE run")
+        return out
+    out = np.empty(n, dtype=np.int32)
+    ip = 0
+    op = 0
+    byte_width = (bit_width + 7) // 8
+    while op < n and ip < len(data):
+        header = 0
+        shift = 0
+        while True:
+            b = data[ip]
+            ip += 1
+            header |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        if header & 1:
+            count = (header >> 1) * 8
+            acc = 0
+            acc_bits = 0
+            mask = (1 << bit_width) - 1
+            for _ in range(count):
+                while acc_bits < bit_width and ip < len(data):
+                    acc |= data[ip] << acc_bits
+                    ip += 1
+                    acc_bits += 8
+                if op < n:
+                    out[op] = acc & mask
+                    op += 1
+                acc >>= bit_width
+                acc_bits -= bit_width
+        else:
+            count = header >> 1
+            v = int.from_bytes(data[ip : ip + byte_width], "little")
+            ip += byte_width
+            for _ in range(count):
+                if op < n:
+                    out[op] = v
+                    op += 1
+    return out
+
+
+def parquet_rle_encode(values: np.ndarray, bit_width: int) -> bytes:
+    values = np.ascontiguousarray(values, dtype=np.int32)
+    n = len(values)
+    if n == 0:
+        return b""
+    if _LIB is not None:
+        out = np.empty(n * 8 + 16, dtype=np.uint8)
+        ln = _LIB.tt_parquet_rle_encode(
+            _ptr(values, ctypes.c_int32), n, bit_width, _ptr(out, ctypes.c_uint8)
+        )
+        return out[:ln].tobytes()
+    out = bytearray()
+    byte_width = (bit_width + 7) // 8
+    i = 0
+    vals = values.tolist()
+    while i < n:
+        j = i
+        while j < n and vals[j] == vals[i]:
+            j += 1
+        header = (j - i) << 1
+        while header >= 0x80:
+            out.append((header & 0x7F) | 0x80)
+            header >>= 7
+        out.append(header)
+        out += int(vals[i] & 0xFFFFFFFF).to_bytes(4, "little")[:byte_width]
+        i = j
+    return bytes(out)
 
 
 def lz_compress(data: bytes) -> bytes:

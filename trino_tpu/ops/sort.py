@@ -47,7 +47,11 @@ def sortable_key(data: jnp.ndarray, valid: jnp.ndarray, key: SortKey, ranks=None
         return [null_key] + ops
     if ranks is not None:  # dictionary string: map codes to ranks
         r = jnp.asarray(ranks)
-        value = r[jnp.maximum(data, 0)].astype(jnp.int64)
+        if r.shape[0] == 0:
+            # empty dictionary: only padding rows (valid False) exist
+            value = jnp.zeros(data.shape[0], dtype=jnp.int64)
+        else:
+            value = r[jnp.maximum(data, 0)].astype(jnp.int64)
         if not key.ascending:
             value = -1 - value
     elif np.issubdtype(np.dtype(data.dtype), np.floating):

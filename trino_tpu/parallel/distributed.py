@@ -102,9 +102,10 @@ class DistributedExecutor(LocalExecutor):
         res = self._exec(node.source)
         if not _is_sharded(res.batch):
             return self._aggregate_result(node, res)
-        if any(fn.distinct for _, fn in node.aggregates):
-            # DISTINCT aggregates need a global dedup — per-shard partials
-            # would double-count values seen on multiple shards. Run the
+        if any(
+            fn.distinct or fn.kind == "array_agg" for _, fn in node.aggregates
+        ):
+            # DISTINCT / array_agg aggregates need a global view — run the
             # single-program path (XLA gathers as needed).
             return self._aggregate_result(node, res)
         if any(

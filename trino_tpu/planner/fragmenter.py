@@ -113,7 +113,7 @@ def _add_exchanges(node: P.PlanNode) -> tuple[P.PlanNode, Partitioning]:
     if isinstance(node, P.Values):
         return node, Partitioning(SINGLE)
 
-    if isinstance(node, (P.Filter, P.Project, P.GroupId)):
+    if isinstance(node, (P.Filter, P.Project, P.GroupId, P.Unnest)):
         src, part = _add_exchanges(node.source)
         node = dataclasses.replace(node, source=src)
         return node, part
@@ -201,8 +201,10 @@ def _add_exchanges_aggregate(node: P.Aggregate) -> tuple[P.PlanNode, Partitionin
     src, part = _add_exchanges(node.source)
     if part.kind == SINGLE or node.step != "single":
         return dataclasses.replace(node, source=src), part
-    if any(fn.distinct for _, fn in node.aggregates):
-        # DISTINCT aggregates need a global view of values — gather
+    if any(
+        fn.distinct or fn.kind == "array_agg" for _, fn in node.aggregates
+    ):
+        # DISTINCT / array_agg need a global view of values — gather
         # (reference uses MarkDistinct + hash exchanges; v1 gathers)
         return (
             dataclasses.replace(node, source=_gather(src, part)),

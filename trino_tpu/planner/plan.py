@@ -341,6 +341,31 @@ class Output(PlanNode):
 
 
 @dataclasses.dataclass
+class Unnest(PlanNode):
+    """Expand array values into rows (reference:
+    ``operator/unnest/UnnestOperator.java:39``). Each source row is
+    replicated once per element of each unnested array (arrays zipped
+    positionally when several are given, NULL-padded to the longest);
+    ``ordinality`` adds a 1-based position column."""
+
+    source: PlanNode
+    array_exprs: list[RowExpr]  # over source symbols, ARRAY-typed
+    element_symbols: list[Symbol]
+    ordinality: Optional[Symbol] = None
+
+    @property
+    def output_symbols(self):
+        out = self.source.output_symbols + self.element_symbols
+        if self.ordinality is not None:
+            out = out + [self.ordinality]
+        return out
+
+    @property
+    def sources(self):
+        return [self.source]
+
+
+@dataclasses.dataclass
 class RemoteSource(PlanNode):
     """Leaf standing in for another fragment's output
     (reference: ``plan/RemoteSourceNode.java``). ``exchange_type`` records

@@ -315,10 +315,6 @@ class ClusterScheduler:
             fid: {"locations": [t.uri for t in tasks], "partition": 0}
             for fid, tasks in remote_tasks.items()
             if fid in frag.source_fragment_ids
-            or any(
-                isinstance(nd, P.RemoteSource) and nd.fragment_id == fid
-                for nd in P.walk_plan(frag.root)
-            )
         }
         local_session = Session(
             user=session.user, catalog=session.catalog, schema=session.schema

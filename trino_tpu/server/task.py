@@ -79,7 +79,7 @@ class ExchangeClient:
     per upstream location, token-advancing GETs until complete.
     """
 
-    def __init__(self, locations: list[str], partition: int, timeout: float = 120.0):
+    def __init__(self, locations: list[str], partition: int, timeout: float = 300.0):
         self.locations = locations
         self.partition = partition
         self.timeout = timeout

@@ -513,6 +513,29 @@ class Parser:
         return tok.kind in ("IDENT", "QIDENT") and self.peek(2).kind == "OP" and self.peek(2).text in (",", ")")
 
     def _primary_relation(self) -> t.Node:
+        tok = self.peek()
+        if (
+            tok.kind == "IDENT"
+            and tok.upper == "UNNEST"
+            and self.peek(1).kind == "OP"
+            and self.peek(1).text == "("
+        ):
+            self.next()
+            self.expect_op("(")
+            exprs = [self.expression()]
+            while self.accept_op(","):
+                exprs.append(self.expression())
+            self.expect_op(")")
+            with_ord = False
+            if self.at_kw("WITH"):
+                self.next()
+                ident = self.next()
+                if ident.upper != "ORDINALITY":
+                    raise SqlSyntaxError(
+                        "expected ORDINALITY", ident.line, ident.col
+                    )
+                with_ord = True
+            return t.Unnest(tuple(exprs), with_ord)
         if self.at_op("("):
             self.expect_op("(")
             if self.at_kw("SELECT", "WITH", "VALUES"):
@@ -762,6 +785,22 @@ class Parser:
             self.next()
             s = self.next().text.strip()
             return t.Literal(s, "decimal")
+        # ARRAY[e1, e2, ...] constructor
+        if (
+            self.peek().kind == "IDENT"
+            and self.peek().upper == "ARRAY"
+            and self.peek(1).kind == "OP"
+            and self.peek(1).text == "["
+        ):
+            self.next()
+            self.expect_op("[")
+            items: list[t.Node] = []
+            if not self.at_op("]"):
+                items.append(self.expression())
+                while self.accept_op(","):
+                    items.append(self.expression())
+            self.expect_op("]")
+            return t.ArrayLiteral(tuple(items))
         # identifier, qualified name, or function call
         if self.peek().kind in ("IDENT", "QIDENT") or (
             self.peek().kind == "KW" and self.peek().upper in _NONRESERVED

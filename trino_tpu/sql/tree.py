@@ -199,6 +199,11 @@ class Unnest(Node):
     with_ordinality: bool = False
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayLiteral(Node):
+    items: tuple[Node, ...]
+
+
 # --- query structure -------------------------------------------------------
 
 

@@ -85,7 +85,39 @@ STRING_TRANSFORMS = {
     "upper", "lower", "trim", "ltrim", "rtrim", "reverse",
     "substr", "replace", "lpad", "rpad", "split_part", "concat", "repeat",
     "regexp_replace", "regexp_extract",
+    "json_extract_scalar", "json_extract",
 }
+
+
+_JSON_SEGMENT = __import__("re").compile(
+    r"\.(?P<key>[A-Za-z_][A-Za-z0-9_]*)|\[(?P<idx>\d+)\]|\[\"(?P<qkey>[^\"]+)\"\]"
+)
+
+
+def _json_path_get(doc, path: str):
+    """Walk a $.a.b[1] JSON path. Returns (found, value). The path must
+    parse completely — garbage segments yield not-found, never a parent
+    value."""
+    if not path.startswith("$"):
+        return False, None
+    cur = doc
+    pos = 1
+    while pos < len(path):
+        m = _JSON_SEGMENT.match(path, pos)
+        if m is None:
+            return False, None  # invalid path segment
+        pos = m.end()
+        key = m.group("key") or m.group("qkey")
+        if key is not None:
+            if not isinstance(cur, dict) or key not in cur:
+                return False, None
+            cur = cur[key]
+        else:
+            i = int(m.group("idx"))
+            if not isinstance(cur, list) or i >= len(cur):
+                return False, None
+            cur = cur[i]
+    return True, cur
 
 
 def _const_args(args) -> list:
@@ -254,6 +286,26 @@ def lower_string_calls(expr: RowExpr, columns: list[Column]) -> RowExpr:
             py_repl = repl.replace("\\", "\\\\")
             py_repl = _re.sub(r"\$(\d+)", r"\\\1", py_repl)
             return _re.sub(str(rest[0]), py_repl, v)
+        if name in ("json_extract_scalar", "json_extract"):
+            import json as _json
+
+            try:
+                doc = _json.loads(v)
+            except ValueError:
+                return None
+            found, out = _json_path_get(doc, str(rest[0]))
+            if not found:
+                return None
+            if name == "json_extract":
+                return _json.dumps(out, separators=(",", ":"))
+            # scalar: NULL for objects/arrays (reference semantics)
+            if isinstance(out, (dict, list)):
+                return None
+            if out is None:
+                return None
+            if isinstance(out, bool):
+                return "true" if out else "false"
+            return str(out)
         if name == "regexp_extract":
             import re as _re
 

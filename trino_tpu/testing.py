@@ -87,6 +87,15 @@ class MultiProcessQueryRunner:
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)  # workers run CPU-only
         env["JAX_PLATFORMS"] = platform
+        # share the parent's persistent compile cache: a cold worker cache
+        # makes first-query compiles race the exchange timeouts
+        env.setdefault(
+            "TRINO_TPU_COMPILE_CACHE",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                ".jax_cache",
+            ),
+        )
 
         def spawn(args):
             proc = subprocess.Popen(

@@ -207,6 +207,39 @@ class TimestampType(SqlType):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayType(SqlType):
+    """ARRAY(element). TPU-first storage mirrors varchar: int32 codes into
+    a host-side pool of distinct array VALUES (python tuples). Equality,
+    grouping and joining work on codes; cardinality/element_at become
+    per-code lookup tables; UNNEST expands host-side at the (inherently
+    row-count-changing) operator boundary.
+    Reference: ``spi/block/ArrayBlock.java`` (offsets + values block)."""
+
+    element: SqlType = None  # type: ignore[assignment]
+    name: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", f"array({self.element})")
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+    def to_python(self, v, dictionary=None):
+        if dictionary is None:
+            raise ValueError("array column without value pool")
+        tup = dictionary.decode(int(v))
+        if tup is None:
+            return None
+        return [
+            None
+            if e is None
+            else (e if isinstance(e, str) else self.element.to_python(e, None))
+            for e in tup
+        ]
+
+
+@dataclasses.dataclass(frozen=True)
 class UnknownType(SqlType):
     """The type of a bare NULL literal (reference: ``spi/type/UnknownType``)."""
 
