@@ -1,0 +1,68 @@
+"""Repro harness for cluster exchange timeouts (debug tool)."""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, "/root/repo")
+
+from trino_tpu.testing import MultiProcessQueryRunner
+
+Q3 = """select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+              o_orderdate, o_shippriority
+       from customer, orders, lineitem
+       where c_mktsegment = 'BUILDING'
+         and c_custkey = o_custkey and l_orderkey = o_orderkey
+         and o_orderdate < date '1995-03-15'
+         and l_shipdate > date '1995-03-15'
+       group by l_orderkey, o_orderdate, o_shippriority
+       order by revenue desc, o_orderdate limit 10"""
+
+
+def dump_tasks(runner):
+    for uri in [runner.coordinator_uri] + runner.worker_uris:
+        try:
+            with urllib.request.urlopen(f"{uri}/v1/task", timeout=5) as r:
+                tasks = json.loads(r.read().decode())
+            print(f"--- {uri}")
+            for t in tasks:
+                print("   ", t)
+        except Exception as e:
+            print(f"--- {uri}: {e}")
+
+
+def main():
+    with MultiProcessQueryRunner(n_workers=2) as runner:
+        t0 = time.time()
+        done = threading.Event()
+        result = {}
+
+        def run():
+            try:
+                result["rows"], _ = runner.execute(Q3)
+            except Exception as e:
+                result["error"] = repr(e)[:2000]
+            done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        if not done.wait(timeout=60):
+            print(f"HUNG after 60s; task states:")
+            dump_tasks(runner)
+            for i, log in enumerate(runner._logs):
+                print(f"=== proc {i} log tail:")
+                print("".join(log[-30:]))
+            return
+        print(f"finished in {time.time()-t0:.1f}s: {list(result)[0]}")
+        if "error" in result:
+            print(result["error"])
+            dump_tasks(runner)
+        for i, log in enumerate(runner._logs):
+            if log:
+                print(f"=== proc {i} log tail:")
+                print("".join(log[-30:]))
+
+
+if __name__ == "__main__":
+    main()

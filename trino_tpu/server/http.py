@@ -363,6 +363,10 @@ def _make_handler(server: TrinoTpuServer):
                 return None
             if path == "/v1/resourceGroup":
                 return self._send_json(server.resource_groups.info())
+            if path == "/v1/task":
+                return self._send_json(
+                    [t.info() for t in server.task_manager.tasks()]
+                )
             if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                 # task status, optional long-poll (?maxWait=seconds)
                 task = server.task_manager.get(parts[2])
@@ -379,13 +383,20 @@ def _make_handler(server: TrinoTpuServer):
                 and parts[:2] == ["v1", "task"]
                 and parts[3] == "results"
             ):
-                # GET /v1/task/{id}/results/{partition}/{token}
+                # GET /v1/task/{id}/results/{partition}/{token}[?maxWait=s]
                 # (TaskResource.java:261 paged binary fetch)
                 task = server.task_manager.get(parts[2])
                 if task is None:
                     return self._error(404, "task not found")
+                qs = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+                try:
+                    max_wait = min(30.0, float(qs.get("maxWait", ["1.0"])[0]))
+                except ValueError:
+                    max_wait = 1.0
+                if max_wait != max_wait:  # NaN guard
+                    max_wait = 1.0
                 return self._send_json(
-                    task.results(int(parts[4]), int(parts[5]), max_wait=1.0)
+                    task.results(int(parts[4]), int(parts[5]), max_wait=max_wait)
                 )
             if path == "/v1/node":
                 if server.node_manager is None:

@@ -39,17 +39,39 @@ PAGE_ROWS = 1 << 16
 
 
 class OutputBuffer:
-    """Per-partition page lists with token-acked consumption."""
+    """Per-partition page deques with token-acked consumption, bounded
+    memory, and producer backpressure.
 
-    def __init__(self, n_partitions: int):
+    Reference: ``execution/buffer/OutputBufferMemoryManager.java`` — the
+    producer blocks once buffered bytes exceed the cap; a consumer GET with
+    token N acknowledges (and frees) every page below N, releasing the
+    producer. At-least-once delivery: unacknowledged pages are re-served on
+    retry with the same token.
+    """
+
+    def __init__(self, n_partitions: int, max_buffered_bytes: int = 64 << 20):
         self.n = n_partitions
         self._pages: list[list[bytes]] = [[] for _ in range(n_partitions)]
+        self._base: list[int] = [0] * n_partitions  # first unacked token
+        self._buffered = 0
+        self.max_buffered_bytes = max_buffered_bytes
         self._complete = False
+        self._aborted = False
         self._lock = threading.Condition()
 
     def enqueue(self, partition: int, page: bytes) -> None:
         with self._lock:
+            # backpressure: block until consumers ack enough pages
+            while (
+                self._buffered + len(page) > self.max_buffered_bytes
+                and self._buffered > 0
+                and not self._aborted
+            ):
+                self._lock.wait(1.0)
+            if self._aborted:
+                return
             self._pages[partition].append(page)
+            self._buffered += len(page)
             self._lock.notify_all()
 
     def set_complete(self) -> None:
@@ -57,13 +79,33 @@ class OutputBuffer:
             self._complete = True
             self._lock.notify_all()
 
+    def abort(self) -> None:
+        """Unblock producers and drop buffered pages (task cancel/fail)."""
+        with self._lock:
+            self._aborted = True
+            self._complete = True
+            self._pages = [[] for _ in range(self.n)]
+            self._buffered = 0
+            self._lock.notify_all()
+
     def get(self, partition: int, token: int, max_wait: float = 1.0):
         """Pages from `token` on; blocks up to max_wait for more data.
-        Returns (pages, next_token, complete)."""
+        A request at token N acks (frees) pages below N. Returns
+        (pages, next_token, complete)."""
         deadline = time.time() + max_wait
         with self._lock:
+            # acknowledge everything below `token`
+            base = self._base[partition]
+            if token > base:
+                drop = token - base
+                dropped = self._pages[partition][:drop]
+                del self._pages[partition][:drop]
+                self._base[partition] = token
+                self._buffered -= sum(len(p) for p in dropped)
+                self._lock.notify_all()
             while True:
-                pages = self._pages[partition][token:]
+                base = self._base[partition]
+                pages = self._pages[partition][max(0, token - base):]
                 if pages or self._complete:
                     return pages, token + len(pages), self._complete
                 remaining = deadline - time.time()
@@ -83,6 +125,7 @@ class ExchangeClient:
         self.locations = locations
         self.partition = partition
         self.timeout = timeout
+        self.poll_wait = 15.0  # server-side long-poll hold per GET
 
     def read_all(self) -> list[Batch]:
         batches: list[Batch] = []
@@ -95,8 +138,13 @@ class ExchangeClient:
                 token = 0
                 deadline = time.time() + self.timeout
                 while True:
-                    uri = f"{loc}/results/{self.partition}/{token}"
-                    with urllib.request.urlopen(uri, timeout=30) as r:
+                    uri = (
+                        f"{loc}/results/{self.partition}/{token}"
+                        f"?maxWait={self.poll_wait}"
+                    )
+                    with urllib.request.urlopen(
+                        uri, timeout=self.poll_wait + 30
+                    ) as r:
                         payload = json.loads(r.read().decode())
                     for b64 in payload["pages"]:
                         batch = deserialize_batch(base64.b64decode(b64))
@@ -104,6 +152,13 @@ class ExchangeClient:
                             batches.append(batch)
                     token = payload["token"]
                     if payload["complete"]:
+                        # final ack frees the last unacked page window on
+                        # the producer (nothing re-reads a complete buffer)
+                        try:
+                            ack = f"{loc}/results/{self.partition}/{token}?maxWait=0"
+                            urllib.request.urlopen(ack, timeout=5).close()
+                        except Exception:  # noqa: BLE001 - best-effort
+                            pass
                         return
                     if payload.get("failed"):
                         raise RuntimeError(payload.get("error", "upstream task failed"))
@@ -242,9 +297,9 @@ class SqlTask:
         n = self.n_output_partitions
         ex = self.fragment.output_exchange
         if ex == "broadcast":
-            page = serialize_batch(batch)
-            for p in range(n):
-                self.buffer.enqueue(p, page)
+            for page in _paginate(batch):
+                for p in range(n):
+                    self.buffer.enqueue(p, page)
             return
         if ex == "hash" and n > 1:
             key_pairs = []
@@ -256,11 +311,12 @@ class SqlTask:
             for p in range(n):
                 idx = np.nonzero(dest == p)[0]
                 part = _take_rows(batch, idx)
-                if part.num_rows:
-                    self.buffer.enqueue(p, serialize_batch(part))
+                for page in _paginate(part):
+                    self.buffer.enqueue(p, page)
             return
         # single (or hash with one consumer): everything to partition 0
-        self.buffer.enqueue(0, serialize_batch(batch))
+        for page in _paginate(batch):
+            self.buffer.enqueue(0, page)
 
     # --- REST support -----------------------------------------------------
 
@@ -275,19 +331,50 @@ class SqlTask:
 
     def results(self, partition: int, token: int, max_wait: float) -> dict:
         pages, next_token, complete = self.buffer.get(partition, token, max_wait)
+        # CANCELED counts as failed for consumers: abort() dropped pages, so
+        # truncated output must never read as success
         return {
             "taskId": self.task_id,
             "pages": [base64.b64encode(p).decode() for p in pages],
             "token": next_token,
-            "complete": complete and self.state in ("FINISHED", "CANCELED"),
-            "failed": self.state == "FAILED",
-            "error": self.error,
+            "complete": complete and self.state == "FINISHED",
+            "failed": self.state in ("FAILED", "CANCELED"),
+            "error": self.error or (
+                "task canceled" if self.state == "CANCELED" else None
+            ),
         }
 
     def cancel(self) -> None:
         if self.state == "RUNNING":
             self.state = "CANCELED"
-            self.buffer.set_complete()
+        # always release buffered pages (a finished task's final unacked
+        # window would otherwise live as long as the registry entry)
+        self.buffer.abort()
+
+
+def _paginate(batch: Batch):
+    """Serialize a batch as bounded pages (reference: PagesSerde splits at
+    the output-operator page size); bounded pages are the unit of exchange
+    backpressure."""
+    if batch.num_rows == 0:
+        return
+    if batch.num_rows <= PAGE_ROWS:
+        yield serialize_batch(batch)
+        return
+    # materialize each column once, then slice contiguously per page
+    mats = [(c, *c.to_numpy()) for c in batch.columns]
+    for lo in range(0, batch.num_rows, PAGE_ROWS):
+        hi = min(lo + PAGE_ROWS, batch.num_rows)
+        cols = [
+            Column(
+                c.type,
+                data[lo:hi],
+                None if valid[lo:hi].all() else valid[lo:hi],
+                c.dictionary,
+            )
+            for c, data, valid in mats
+        ]
+        yield serialize_batch(Batch(cols, hi - lo))
 
 
 def _take_rows(batch: Batch, idx: np.ndarray) -> Batch:
@@ -306,15 +393,31 @@ def _take_rows(batch: Batch, idx: np.ndarray) -> Batch:
 
 
 class SqlTaskManager:
-    """Task registry (reference: SqlTaskManager.java:88)."""
+    """Task registry (reference: SqlTaskManager.java:88 — terminal tasks
+    are evicted after a retention window, like the reference's
+    ``info-max-age`` pruning)."""
+
+    TERMINAL_RETENTION = 240.0
 
     def __init__(self, engine):
         self.engine = engine
         self._tasks: dict[str, SqlTask] = {}
         self._lock = threading.Lock()
 
+    def _reap(self) -> None:
+        now = time.time()
+        for tid in [
+            tid
+            for tid, t in self._tasks.items()
+            if t.state != "RUNNING"
+            and now - t.created > self.TERMINAL_RETENTION
+        ]:
+            self._tasks[tid].buffer.abort()
+            del self._tasks[tid]
+
     def create_or_update(self, task_id: str, payload: dict) -> SqlTask:
         with self._lock:
+            self._reap()
             task = self._tasks.get(task_id)
             if task is None:
                 task = SqlTask(task_id, self.engine, payload)

@@ -97,6 +97,10 @@ class MultiProcessQueryRunner:
             ),
         )
 
+        import threading
+
+        self._logs: list[list[str]] = []
+
         def spawn(args):
             proc = subprocess.Popen(
                 [sys.executable, "-m", "trino_tpu.server.main", *args],
@@ -111,6 +115,16 @@ class MultiProcessQueryRunner:
             while time.time() < deadline:
                 line = proc.stdout.readline()
                 if line.startswith("LISTENING "):
+                    # keep draining the pipe: an undrained 64KB pipe buffer
+                    # blocks the child on its next write and freezes it
+                    log: list[str] = []
+                    self._logs.append(log)
+
+                    def drain(stream=proc.stdout, log=log):
+                        for ln in stream:
+                            log.append(ln)
+
+                    threading.Thread(target=drain, daemon=True).start()
                     return line.split()[1].strip()
                 if proc.poll() is not None:
                     raise RuntimeError(
