@@ -109,6 +109,44 @@ class TestClusterQueries:
         )
 
 
+class TestWorkerDeviceExecution:
+    def test_tasks_ran_fused(self, cluster, local):
+        """Worker tasks must execute eligible fragments via the fused
+        device path, not the interpreter (VERDICT r2 item 1)."""
+        import json
+        import urllib.request
+
+        def task_map():
+            out = {}
+            for uri in cluster.worker_uris:
+                with urllib.request.urlopen(f"{uri}/v1/task") as r:
+                    for t in json.loads(r.read().decode()):
+                        out[t["taskId"]] = t
+            return out
+
+        before = set(task_map())
+        check(
+            cluster,
+            local,
+            """select o_orderpriority, count(*) from orders
+               join lineitem on l_orderkey = o_orderkey
+               group by o_orderpriority order by o_orderpriority""",
+        )
+        mine = {
+            tid: t for tid, t in task_map().items() if tid not in before
+        }
+        paths = [
+            t["executionPath"]
+            for t in mine.values()
+            if t["state"] == "FINISHED"
+        ]
+        assert paths, "no finished tasks found for this query"
+        assert all(p == "fused" for p in paths), (
+            f"expected fused execution for every fragment of this"
+            f" fusable query, got {[(t['taskId'], t['executionPath'], t['stats'].get('fused_error')) for t in mine.values()]}"
+        )
+
+
 class TestClusterMembership:
     def test_nodes_announced(self, cluster):
         import json

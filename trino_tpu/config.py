@@ -61,6 +61,9 @@ class Session:
         ("spill_threshold_rows", 1 << 23),
         ("tpu_enabled", True),
         ("execution_mode", "local"),  # local | distributed (mesh SPMD)
+        # cluster worker tasks: 'fused' compiles the fragment onto the
+        # worker's local devices; 'interpreter' forces the CPU fallback
+        ("worker_execution", "fused"),
         # distributed mode: compile each plan fragment into one SPMD
         # program (exec/fragments.py); off -> materialized interpreter
         ("fragment_execution", True),

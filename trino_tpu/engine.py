@@ -89,6 +89,11 @@ class Engine:
         # multi-host scheduling (server/cluster.py installs this on
         # coordinator servers; execution_mode=cluster routes through it)
         self.cluster_scheduler = None
+        # multi-host SPMD (server installs SpmdRunner + peer discovery when
+        # booted inside a jax.distributed group; fusable cluster queries
+        # then run as one pjit program spanning every process)
+        self.spmd = None
+        self.spmd_peers = None
         try:
             from trino_tpu.connectors.system import SystemConnector
 
@@ -230,14 +235,25 @@ class Engine:
     ) -> StatementResult:
         from trino_tpu.memory import QueryMemoryContext
 
-        if (
-            session.get("execution_mode") == "cluster"
-            and self.cluster_scheduler is not None
+        if session.get("execution_mode") == "cluster" and (
+            self.cluster_scheduler is not None or self.spmd is not None
         ):
-            batch, names = self.cluster_scheduler.execute(plan, session)
-            return StatementResult(
-                batch.to_pylist(), names, [c.type for c in batch.columns]
-            )
+            batch = None
+            if self.spmd is not None and self.spmd_peers is not None:
+                from trino_tpu.parallel.spmd import SpmdUnsupported
+
+                try:
+                    batch, names = self.spmd.execute(
+                        plan, session, self.spmd_peers()
+                    )
+                except SpmdUnsupported:
+                    batch = None  # non-fusable: per-task scheduling below
+            if batch is None and self.cluster_scheduler is not None:
+                batch, names = self.cluster_scheduler.execute(plan, session)
+            if batch is not None:
+                return StatementResult(
+                    batch.to_pylist(), names, [c.type for c in batch.columns]
+                )
         ctx = QueryMemoryContext(
             self.memory_pool,
             query_id or self._next_query_id(),

@@ -90,50 +90,56 @@ def _expr_blocks_fusion(e) -> bool:
 
 
 def query_fusable(sub: SubPlan) -> bool:
-    for frag in sub.all_fragments():
-        for n in P.walk_plan(frag.root):
-            if not isinstance(n, _FUSABLE_NODES):
-                return False
-            if isinstance(n, P.Join):
-                if (
-                    n.join_type not in ("INNER", "LEFT")
-                    or not n.criteria
-                    or n.single_row
-                    or (n.join_type == "LEFT" and n.filter is not None)
-                    or any(
-                        _is_wide_type(a.type) or _is_wide_type(b.type)
-                        for a, b in n.criteria
-                    )
-                ):
-                    return False
-                if n.filter is not None and _expr_blocks_fusion(n.filter):
-                    return False
-            if isinstance(n, P.Aggregate):
-                if any(fn.distinct for _, fn in n.aggregates):
-                    return False
-                if any(_is_wide_type(k.type) for k in n.group_keys):
-                    return False  # wide group keys: interpreter path
-                for _, fn in n.aggregates:
-                    if fn.kind not in (
-                        "sum", "count", "count_star", "min", "max", "avg"
-                    ):
-                        return False
-                    arg_wide = fn.argument is not None and _is_wide_type(
-                        fn.argument.type
-                    )
-                    # wide sums/min/max fuse (limb accumulators, two-lane
-                    # extrema); wide avg needs exact 128/64 division,
-                    # which is host-only — interpret those
-                    if fn.kind == "avg" and (
-                        arg_wide or _is_wide_type(fn.result_type)
-                    ):
-                        return False
-            if isinstance(n, P.Filter) and _expr_blocks_fusion(n.predicate):
-                return False
-            if isinstance(n, P.Project) and any(
-                _expr_blocks_fusion(e) for _, e in n.assignments
+    return all(fragment_fusable(frag) for frag in sub.all_fragments())
+
+
+def fragment_fusable(frag: PlanFragment) -> bool:
+    """True when every node in this one fragment traces into the fused
+    program (worker tasks check per-fragment: a window fragment interprets
+    while its scan fragments still run fused on device)."""
+    for n in P.walk_plan(frag.root):
+        if not isinstance(n, _FUSABLE_NODES):
+            return False
+        if isinstance(n, P.Join):
+            if (
+                n.join_type not in ("INNER", "LEFT")
+                or not n.criteria
+                or n.single_row
+                or (n.join_type == "LEFT" and n.filter is not None)
+                or any(
+                    _is_wide_type(a.type) or _is_wide_type(b.type)
+                    for a, b in n.criteria
+                )
             ):
                 return False
+            if n.filter is not None and _expr_blocks_fusion(n.filter):
+                return False
+        if isinstance(n, P.Aggregate):
+            if any(fn.distinct for _, fn in n.aggregates):
+                return False
+            if any(_is_wide_type(k.type) for k in n.group_keys):
+                return False  # wide group keys: interpreter path
+            for _, fn in n.aggregates:
+                if fn.kind not in (
+                    "sum", "count", "count_star", "min", "max", "avg"
+                ):
+                    return False
+                arg_wide = fn.argument is not None and _is_wide_type(
+                    fn.argument.type
+                )
+                # wide sums/min/max fuse (limb accumulators, two-lane
+                # extrema); wide avg needs exact 128/64 division,
+                # which is host-only — interpret those
+                if fn.kind == "avg" and (
+                    arg_wide or _is_wide_type(fn.result_type)
+                ):
+                    return False
+        if isinstance(n, P.Filter) and _expr_blocks_fusion(n.predicate):
+            return False
+        if isinstance(n, P.Project) and any(
+            _expr_blocks_fusion(e) for _, e in n.assignments
+        ):
+            return False
     return True
 
 
@@ -194,6 +200,15 @@ class FragmentedExecutor(DistributedExecutor):
 
         run(sub)
         root = results[sub.fragment.id]
+        if jax.process_count() > 1:
+            # multi-host: replicate the (small) root result so every
+            # process holds it fully before host materialization
+            from trino_tpu.parallel.mesh import replicated
+
+            rep = jax.jit(
+                lambda b: b, out_shardings=replicated(self.mesh)
+            )(root.batch)
+            root = Result(rep, root.layout)
         out = root.batch.compact()
         names = names_holder.get(sub.fragment.id) or [
             s.name for s in sub.fragment.root.output_symbols
@@ -228,6 +243,25 @@ class FragmentedExecutor(DistributedExecutor):
                 input_layouts[f"remote{n.fragment_id}"] = res.layout
             elif isinstance(n, P.Output):
                 names_holder[frag.id] = list(n.column_names)
+        return self.run_fragment_program(frag, inputs, input_layouts)
+
+    def run_fragment_program(
+        self,
+        frag: PlanFragment,
+        inputs: dict[str, Batch],
+        input_layouts: dict[str, dict[str, int]],
+        apply_exchange: bool = True,
+        stats_sink: Optional[dict] = None,
+    ) -> Result:
+        """Compile + run one fragment as a single jitted SPMD program.
+
+        ``inputs`` maps ``scan{id(node)}`` / ``remote{fragment_id}`` keys to
+        device batches. With ``apply_exchange=False`` the fragment's output
+        exchange is skipped — callers that ship pages across processes
+        (worker tasks) partition on the host instead. ``stats_sink``
+        receives per-fragment compile/run timings when provided.
+        """
+        import time as _time
 
         caps = _Caps()
         attempts = 0
@@ -240,7 +274,8 @@ class FragmentedExecutor(DistributedExecutor):
             def fn(inp: dict[str, Batch]):
                 tracer = _FragmentTracer(self, inp, input_layouts, caps)
                 res = tracer._exec(frag.root)
-                res = tracer.apply_output_exchange(frag, res)
+                if apply_exchange:
+                    res = tracer.apply_output_exchange(frag, res)
                 meta.layout = dict(res.layout)
                 meta.column_meta = [
                     (c.type, c.dictionary) for c in res.batch.columns
@@ -252,9 +287,18 @@ class FragmentedExecutor(DistributedExecutor):
                 )
                 return data, res.batch.selection_mask(), flags
 
+            t0 = _time.perf_counter()
             jitted = jax.jit(fn)
             data, sel, flags = jitted(inputs)
             flags_np = [bool(np.asarray(f)) for f in flags]
+            if stats_sink is not None:
+                jax.block_until_ready(sel)
+                stats_sink.setdefault("attempts", 0)
+                stats_sink["attempts"] += 1
+                stats_sink["last_wall_s"] = _time.perf_counter() - t0
+                stats_sink["input_rows"] = sum(
+                    b.capacity for b in inputs.values()
+                )
             if not any(flags_np):
                 break
             for nm, f in zip(meta.overflow_names, flags_np):

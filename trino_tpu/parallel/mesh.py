@@ -39,6 +39,13 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.asarray(devices[:n]), (AXIS,))
 
 
+def make_local_mesh() -> Mesh:
+    """Mesh over this process's devices only. Inside a jax.distributed
+    group, per-task execution must not span processes (its collectives
+    would wait on programs the other processes never launch)."""
+    return Mesh(np.asarray(jax.local_devices()), (AXIS,))
+
+
 def row_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(AXIS))
 
@@ -83,8 +90,11 @@ def shard_batch(mesh: Mesh, parts: Sequence[Batch]) -> Batch:
                         np.int32
                     )
             if data.shape[0] < cap:
+                # wide DECIMAL columns carry (N, 2) hi/lo lanes — pad rows,
+                # keep trailing dims
+                pad_shape = (cap - data.shape[0],) + data.shape[1:]
                 data = np.concatenate(
-                    [data, np.zeros(cap - data.shape[0], dtype=data.dtype)]
+                    [data, np.zeros(pad_shape, dtype=data.dtype)]
                 )
             valid = np.ones(cap, dtype=np.bool_)
             if c.valid is not None:
@@ -128,9 +138,16 @@ def _unify_part_dictionaries(parts: Sequence[Batch]):
 
 
 def _global(mesh: Mesh, sharding: NamedSharding, arrs: list[np.ndarray]) -> jax.Array:
-    """Build a global sharded array from per-device host shards."""
+    """Build a global sharded array from per-device host shards.
+
+    Multi-host: each process device_puts only the shards of its own
+    addressable devices; the global shape covers all of them (every
+    process computes identical ``arrs``, see SpmdRunner)."""
+    me = jax.process_index()
     singles = [
-        jax.device_put(a, d) for a, d in zip(arrs, list(mesh.devices.flat))
+        jax.device_put(a, d)
+        for a, d in zip(arrs, list(mesh.devices.flat))
+        if d.process_index == me
     ]
     shape = (sum(a.shape[0] for a in arrs),) + arrs[0].shape[1:]
     return jax.make_array_from_single_device_arrays(shape, sharding, singles)

@@ -1,0 +1,204 @@
+"""Multi-host SPMD query execution: N server processes, one global mesh.
+
+Reference shape: Trino runs a fragment as tasks on many workers with HTTP
+shuffle between them (``SqlQueryScheduler.java:538``); its TPU-native
+translation (SURVEY §2.7) runs each fragment as ONE multi-host pjit
+program over a ``jax.distributed`` mesh — intra-host ICI and cross-host
+DCN collectives replace the HTTP data plane entirely. The control plane
+only ships the *plan*: every process traces and launches the same jitted
+programs in the same order, so XLA's collectives rendezvous without any
+explicit message passing.
+
+Protocol:
+- All server processes boot with ``jax.distributed.initialize`` (rank 0 is
+  the coordinator) and build the same global mesh.
+- A query arrives at the coordinator. If the plan is fusable it assigns a
+  sequence number, broadcasts ``{seq, plan, session}`` to every worker's
+  ``POST /v1/spmd``, and starts executing itself.
+- Workers execute strictly in sequence order; capacity-overflow retries
+  re-trace identically on every process (overflow flags are globally
+  reduced), keeping the program streams aligned.
+- The root result is replicated to all processes (tiny by then), and the
+  coordinator answers the client.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+from trino_tpu.config import Session
+from trino_tpu.exec.local import ExecutionError
+from trino_tpu.planner import plan as P
+
+
+class SpmdUnsupported(Exception):
+    """Plan not executable as one fused multi-host program."""
+
+
+def session_to_json(session: Session) -> dict:
+    return {
+        "user": session.user,
+        "catalog": session.catalog,
+        "schema": session.schema,
+        "properties": {
+            k: v
+            for k, v in session.properties.items()
+            if isinstance(v, (str, int, float, bool))
+        },
+    }
+
+
+def session_from_json(d: dict) -> Session:
+    s = Session(
+        user=d.get("user", "spmd"),
+        catalog=d.get("catalog", "tpch"),
+        schema=d.get("schema", "tiny"),
+    )
+    for k, v in d.get("properties", {}).items():
+        s.properties[k] = v
+    return s
+
+
+class SpmdRunner:
+    """Per-process SPMD execution endpoint (coordinator and workers)."""
+
+    def __init__(self, engine):
+        import jax
+
+        from trino_tpu.parallel.mesh import make_mesh
+
+        self.engine = engine
+        self.mesh = make_mesh()  # global mesh over every process's devices
+        self.process_count = jax.process_count()
+        self._lock = threading.Lock()  # one SPMD query at a time
+        self._seq = 0
+        self._done_seq = -1
+        self._cond = threading.Condition()
+
+    # --- shared execution body -------------------------------------------
+
+    def _execute(self, plan: P.PlanNode, session: Session):
+        from trino_tpu.exec.fragments import FragmentedExecutor, query_fusable
+        from trino_tpu.planner.fragmenter import fragment_plan
+
+        if not query_fusable(fragment_plan(plan)):
+            raise SpmdUnsupported("plan contains non-fusable nodes")
+        local = Session(
+            user=session.user, catalog=session.catalog, schema=session.schema
+        )
+        for k, v in session.properties.items():
+            if k not in ("execution_mode",):
+                local.properties[k] = v
+        # spill deferral would diverge program streams across processes
+        local.properties["spill_enabled"] = False
+        executor = FragmentedExecutor(self.engine.catalogs, local, self.mesh)
+        return executor.execute(plan)
+
+    # --- coordinator side -------------------------------------------------
+
+    def execute(self, plan: P.PlanNode, session: Session, peers: list[str]):
+        """Run one query SPMD across all processes; returns (batch, names).
+
+        ``peers`` are the worker base URIs (everyone but this process).
+        """
+        from trino_tpu.exec.fragments import query_fusable
+        from trino_tpu.planner.fragmenter import fragment_plan
+        from trino_tpu.planner.serde import node_to_json
+
+        # decide fusability BEFORE broadcasting: non-fusable plans fall
+        # back to per-task cluster scheduling without touching workers
+        if not query_fusable(fragment_plan(plan)):
+            raise SpmdUnsupported("plan contains non-fusable nodes")
+        if len(peers) != self.process_count - 1:
+            # the pjit program needs EVERY rank of the fixed jax.distributed
+            # group; an un-announced (or lapsed) rank would never launch it
+            # and the collective would hang — fall back to task scheduling
+            raise SpmdUnsupported(
+                f"{len(peers)} peers announced, need {self.process_count - 1}"
+            )
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            payload = json.dumps(
+                {
+                    "seq": seq,
+                    "plan": node_to_json(plan),
+                    "session": session_to_json(session),
+                }
+            ).encode()
+            errors: list[str] = []
+            threads = []
+
+            def post(uri: str):
+                req = urllib.request.Request(
+                    f"{uri}/v1/spmd", data=payload, method="POST"
+                )
+                req.add_header("Content-Type", "application/json")
+                try:
+                    with urllib.request.urlopen(req, timeout=600) as r:
+                        body = json.loads(r.read().decode())
+                    if body.get("error"):
+                        errors.append(body["error"])
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"{uri}: {e}")
+
+            for uri in peers:
+                t = threading.Thread(target=post, args=(uri,), daemon=True)
+                t.start()
+                threads.append(t)
+            try:
+                result = self._execute(plan, session)
+            finally:
+                for t in threads:
+                    t.join(timeout=600)
+            if errors:
+                raise ExecutionError(f"spmd worker failed: {errors[0]}")
+            return result
+
+    # --- worker side ------------------------------------------------------
+
+    def execute_remote(self, payload: dict) -> dict:
+        """Handle POST /v1/spmd on a worker: execute in sequence order."""
+        from trino_tpu.planner.serde import node_from_json
+
+        seq = int(payload["seq"])
+        plan = node_from_json(payload["plan"])
+        session = session_from_json(payload.get("session", {}))
+        with self._cond:
+            if self._done_seq >= seq:
+                # a predecessor's timeout already skipped this slot; running
+                # it now would launch programs out of order
+                return {"error": f"seq {seq} arrived after being skipped"}
+            deadline = 600.0
+            while self._done_seq < seq - 1:
+                if not self._cond.wait(timeout=deadline):
+                    # advance past the lost predecessor so later queries
+                    # aren't head-of-line blocked forever
+                    self._done_seq = max(self._done_seq, seq)
+                    self._cond.notify_all()
+                    return {"error": f"timed out waiting for seq {seq - 1}"}
+        try:
+            self._execute(plan, session)
+            return {"ok": True, "seq": seq}
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}", "seq": seq}
+        finally:
+            with self._cond:
+                self._done_seq = max(self._done_seq, seq)
+                self._cond.notify_all()
+
+
+def initialize_spmd(coordinator: str, num_processes: int, process_id: int):
+    """Join the jax.distributed group (call before any jax computation)."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -201,6 +201,7 @@ class ClusterScheduler:
                     consumer_of,
                     remote_tasks,
                     session_json,
+                    fragments,
                 )
             return self._execute_root(
                 sub.fragment, session, remote_tasks, task_counts
@@ -229,14 +230,24 @@ class ClusterScheduler:
         frag: PlanFragment,
         partition: int,
         remote_tasks: dict[int, list[HttpRemoteTask]],
+        fragments: dict[int, PlanFragment],
     ) -> dict:
         sources = {}
         for fid in frag.source_fragment_ids:
             tasks = remote_tasks[fid]
-            sources[str(fid)] = {
+            producer = fragments.get(fid)
+            entry = {
                 "locations": [t.uri for t in tasks],
                 "partition": partition,
             }
+            if producer is not None and producer.output_exchange == "hash":
+                # workers re-partition hash-exchanged rows over their local
+                # devices; ship the partition keys and the wire column order
+                entry["keys"] = [s.name for s in producer.output_keys]
+                entry["symbols"] = [
+                    s.name for s in producer.root.output_symbols
+                ]
+            sources[str(fid)] = entry
         return sources
 
     def _schedule_fragment(
@@ -248,6 +259,7 @@ class ClusterScheduler:
         consumer_of: dict[int, int],
         remote_tasks: dict[int, list[HttpRemoteTask]],
         session_json: dict,
+        fragments: dict[int, PlanFragment],
     ) -> list[HttpRemoteTask]:
         from trino_tpu.planner.serde import fragment_to_json
 
@@ -290,7 +302,9 @@ class ClusterScheduler:
                 "session": session_json,
                 "fragment": frag_json,
                 "splits": split_assignment[p],
-                "sources": self._sources_payload(frag, p, remote_tasks),
+                "sources": self._sources_payload(
+                    frag, p, remote_tasks, fragments
+                ),
                 "output_partitions": output_partitions,
             }
             task = HttpRemoteTask(

@@ -56,6 +56,7 @@ class TrinoTpuServer:
         role: str = "coordinator",
         node_id: Optional[str] = None,
         discovery_uri: Optional[str] = None,
+        spmd: bool = False,
     ):
         from trino_tpu.server.resourcegroups import ResourceGroupManager
         from trino_tpu.server.task import SqlTaskManager
@@ -68,6 +69,12 @@ class TrinoTpuServer:
         # every node can run tasks (reference: same binary, coordinator=true/false)
         self.task_manager = SqlTaskManager(self.engine)
         self.node_manager = None
+        self.spmd = None
+        if spmd:
+            from trino_tpu.parallel.spmd import SpmdRunner
+
+            self.spmd = SpmdRunner(self.engine)
+            self.engine.spmd = self.spmd
         if role == "coordinator":
             from trino_tpu.server.cluster import ClusterNodeManager, ClusterScheduler
 
@@ -75,6 +82,10 @@ class TrinoTpuServer:
             self.engine.cluster_scheduler = ClusterScheduler(
                 self.engine, self.node_manager
             )
+            if self.spmd is not None:
+                self.engine.spmd_peers = lambda: [
+                    n.uri for n in self.node_manager.active_nodes()
+                ]
         self.query_manager = QueryManager(
             self.engine,
             max_concurrent,
@@ -112,16 +123,17 @@ class TrinoTpuServer:
         import urllib.request as _rq
 
         while self.state == "ACTIVE":
-            try:
-                body = json.dumps(
-                    {"nodeId": self.node_id, "uri": self.base_uri}
-                ).encode()
-                req = _rq.Request(
-                    f"{self.discovery_uri}/v1/announce", data=body, method="PUT"
-                )
-                _rq.urlopen(req, timeout=10)
-            except Exception:  # noqa: BLE001 — coordinator may not be up yet
-                pass
+            if self.discovery_uri and not self.discovery_uri.startswith("@"):
+                try:
+                    body = json.dumps(
+                        {"nodeId": self.node_id, "uri": self.base_uri}
+                    ).encode()
+                    req = _rq.Request(
+                        f"{self.discovery_uri}/v1/announce", data=body, method="PUT"
+                    )
+                    _rq.urlopen(req, timeout=10)
+                except Exception:  # noqa: BLE001 — coordinator may not be up yet
+                    pass
             time.sleep(2.0)
 
     def stop(self) -> None:
@@ -318,6 +330,12 @@ def _make_handler(server: TrinoTpuServer):
                 payload = json.loads(self.rfile.read(length).decode())
                 task = server.task_manager.create_or_update(parts[2], payload)
                 return self._send_json(task.info())
+            if path == "/v1/spmd":
+                if server.spmd is None:
+                    return self._error(400, "spmd mode not enabled")
+                length = int(self.headers.get("Content-Length", 0))
+                payload = json.loads(self.rfile.read(length).decode())
+                return self._send_json(server.spmd.execute_remote(payload))
             return self._error(404, f"unknown path: {path}")
 
         def do_GET(self):
@@ -483,6 +501,13 @@ def _make_handler(server: TrinoTpuServer):
 
         def do_PUT(self):
             path = urllib.parse.urlparse(self.path).path
+            if path == "/v1/discovery":
+                # late discovery injection (SPMD boot: the coordinator's
+                # HTTP port is unknown until every rank joins the mesh)
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length).decode())
+                server.discovery_uri = body["uri"]
+                return self._send_json({"ok": True})
             if path == "/v1/announce":
                 # embedded discovery: workers announce themselves
                 if server.node_manager is None:

@@ -25,12 +25,25 @@ def main(argv=None) -> int:
         default=None,
         help="force a JAX platform (e.g. cpu) before engine start",
     )
+    parser.add_argument(
+        "--spmd-coordinator",
+        default=None,
+        help="jax.distributed coordinator host:port (enables multi-host SPMD)",
+    )
+    parser.add_argument("--spmd-procs", type=int, default=0)
+    parser.add_argument("--spmd-rank", type=int, default=0)
     args = parser.parse_args(argv)
 
     if args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    if args.spmd_coordinator:
+        # must run before any jax computation initializes backends
+        from trino_tpu.parallel.spmd import initialize_spmd
+
+        initialize_spmd(args.spmd_coordinator, args.spmd_procs, args.spmd_rank)
 
     from trino_tpu.server.http import TrinoTpuServer
 
@@ -40,6 +53,7 @@ def main(argv=None) -> int:
         role=args.role,
         node_id=args.node_id,
         discovery_uri=args.discovery,
+        spmd=bool(args.spmd_coordinator),
     )
     server.start()
     # parent supervisors (tests, orchestration) read this line

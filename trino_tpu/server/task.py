@@ -192,10 +192,12 @@ class WorkerExecutor(LocalExecutor):
         session: Session,
         splits: dict[str, list[dict]],
         sources: dict[int, dict],
+        prefetched: Optional[dict[int, list[Batch]]] = None,
     ):
         super().__init__(catalogs, session)
         self._splits = splits
         self._sources = sources
+        self._prefetched = prefetched or {}
 
     def _exec_tablescan(self, node: P.TableScan) -> Result:
         from trino_tpu.connectors.api import Split
@@ -219,9 +221,12 @@ class WorkerExecutor(LocalExecutor):
         return Result(batch, layout)
 
     def _exec_remotesource(self, node: P.RemoteSource) -> Result:
-        src = self._sources[node.fragment_id]
-        client = ExchangeClient(src["locations"], src["partition"])
-        batches = client.read_all()
+        if node.fragment_id in self._prefetched:
+            batches = self._prefetched[node.fragment_id]
+        else:
+            src = self._sources[node.fragment_id]
+            client = ExchangeClient(src["locations"], src["partition"])
+            batches = client.read_all()
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         nonempty = [b for b in batches if b.num_rows > 0]
         if not nonempty:
@@ -231,6 +236,193 @@ class WorkerExecutor(LocalExecutor):
             ]
             return Result(Batch(cols, 0), layout)
         return Result(concat_batches(nonempty), layout)
+
+
+class FusedWorkerRunner:
+    """Execute one fragment on this worker's local devices as a single
+    fused program (the reference hooks its compiled tier in at
+    ``LocalExecutionPlanner.java:307``; here the whole fragment is one
+    ``jax.jit`` program over the worker-local mesh).
+
+    Inputs arrive as host batches (splits, HTTP pages) and are placed onto
+    the mesh respecting the exchange semantics the in-process fused path
+    gets from its collectives:
+    - broadcast sources replicate (every local shard sees the full build);
+    - hash sources re-partition rows by key hash over local shards (the
+      per-shard joins/combines in the tracer require co-partitioning);
+    - everything else splits contiguously.
+    """
+
+    def __init__(self, engine, session: Session, fragment: PlanFragment):
+        from trino_tpu.exec.fragments import FragmentedExecutor
+        from trino_tpu.parallel.mesh import make_local_mesh
+
+        mesh = getattr(engine, "mesh", None) or make_local_mesh()
+        # device execution must not re-enter cluster scheduling
+        local = Session(
+            user=session.user, catalog=session.catalog, schema=session.schema
+        )
+        for k, v in session.properties.items():
+            if k != "execution_mode":
+                local.properties[k] = v
+        self.executor = FragmentedExecutor(engine.catalogs, local, mesh)
+        self.fragment = fragment
+        self.mesh = mesh
+
+    @property
+    def n(self) -> int:
+        return self.mesh.devices.size
+
+    def run(
+        self,
+        splits: dict[str, list[dict]],
+        source_batches: dict[int, list[Batch]],
+        source_meta: dict[int, dict],
+        stats_sink: Optional[dict] = None,
+    ) -> Result:
+        from trino_tpu.connectors.api import Split
+        from trino_tpu.exec.fragments import FusedUnsupported
+
+        spill_threshold = (
+            int(self.executor.session.get("spill_threshold_rows"))
+            if self.executor.session.get("spill_enabled")
+            else None
+        )
+        inputs: dict[str, Batch] = {}
+        layouts: dict[str, dict[str, int]] = {}
+        for node in P.walk_plan(self.fragment.root):
+            if isinstance(node, P.TableScan):
+                key = f"{node.catalog}.{node.schema}.{node.table}"
+                assigned = splits.get(key, [])
+                connector = self.executor.catalogs.get(node.catalog)
+                parts: list[list[Batch]] = [[] for _ in range(self.n)]
+                for i, d in enumerate(assigned):
+                    parts[i % self.n].append(
+                        connector.read_split(
+                            node.schema,
+                            node.table,
+                            node.column_names,
+                            Split(d["table"], d["index"], d["total"], d.get("info")),
+                        )
+                    )
+                layout = {s.name: i for i, s in enumerate(node.symbols)}
+                batch = self._assemble(
+                    [self._concat(p) for p in parts], node.symbols
+                )
+                if spill_threshold is not None and batch.capacity > spill_threshold:
+                    # same guard as the in-process fused path: spill-sized
+                    # working sets belong to the interpreter's spill tier
+                    raise FusedUnsupported("spill-sized input")
+                inputs[f"scan{id(node)}"] = batch
+                layouts[f"scan{id(node)}"] = layout
+            elif isinstance(node, P.RemoteSource):
+                batches = source_batches[node.fragment_id]
+                meta = source_meta.get(node.fragment_id, {})
+                batch = self._place(node, batches, meta)
+                inputs[f"remote{node.fragment_id}"] = batch
+                layouts[f"remote{node.fragment_id}"] = {
+                    s.name: i for i, s in enumerate(node.symbols)
+                }
+        return self.executor.run_fragment_program(
+            self.fragment,
+            inputs,
+            layouts,
+            apply_exchange=False,
+            stats_sink=stats_sink,
+        )
+
+    # --- input placement --------------------------------------------------
+
+    def _concat(self, batches: list[Batch]) -> Optional[Batch]:
+        nonempty = [b for b in batches if b.num_rows > 0]
+        if not nonempty:
+            return None
+        return (
+            concat_batches(nonempty) if len(nonempty) > 1 else nonempty[0]
+        ).compact()
+
+    def _assemble(
+        self, parts: list[Optional[Batch]], symbols
+    ) -> Batch:
+        from trino_tpu.parallel.mesh import shard_batch
+
+        proto = next((p for p in parts if p is not None), None)
+        filled = []
+        for p in parts:
+            if p is not None:
+                filled.append(p)
+            elif proto is not None:
+                cols = [
+                    Column(
+                        c.type,
+                        np.zeros(
+                            (0,) + np.asarray(c.data).shape[1:],
+                            dtype=np.asarray(c.data).dtype,
+                        ),
+                        None,
+                        c.dictionary,
+                    )
+                    for c in proto.columns
+                ]
+                filled.append(Batch(cols, 0))
+            else:
+                cols = [_empty_column(s.type) for s in symbols]
+                filled.append(Batch(cols, 0))
+        return shard_batch(self.mesh, filled)
+
+    def _place(self, node: P.RemoteSource, batches: list[Batch], meta: dict) -> Batch:
+        from trino_tpu.parallel.mesh import replicated
+
+        merged = self._concat(batches)
+        if merged is None:
+            merged = Batch([_empty_column(s.type) for s in node.symbols], 0)
+        if node.exchange_type == "broadcast":
+            # full build side on every local shard
+            import jax
+
+            sharding = replicated(self.mesh)
+            cols = []
+            for c in merged.columns:
+                data, valid = c.to_numpy()
+                cols.append(
+                    Column(
+                        c.type,
+                        jax.device_put(data, sharding),
+                        jax.device_put(valid, sharding),
+                        c.dictionary,
+                    )
+                )
+            return Batch(cols, merged.num_rows)
+        if node.exchange_type == "hash":
+            from trino_tpu.exec.fragments import FusedUnsupported
+
+            keys = meta.get("keys") or []
+            symbols = meta.get("symbols") or []
+            if not keys or any(k not in symbols for k in keys):
+                # co-partitioning is a correctness requirement for the
+                # per-shard joins/combines — never silently degrade
+                raise FusedUnsupported("hash source without key metadata")
+            positions = [symbols.index(k) for k in keys]
+            key_pairs = []
+            for pos in positions:
+                c = merged.columns[pos]
+                data, valid = c.to_numpy()
+                key_pairs.append((data, valid))
+            khash, _ = J.hash_keys(key_pairs)
+            dest = np.asarray(khash) % self.n
+            parts = [
+                _take_rows(merged, np.nonzero(dest == p)[0])
+                for p in range(self.n)
+            ]
+            return self._assemble(parts, node.symbols)
+        # single/gather: contiguous chunks
+        rows = merged.num_rows
+        chunk = max(1, -(-rows // self.n))
+        parts = [
+            _take_rows(merged, np.arange(lo, min(lo + chunk, rows)))
+            for lo in range(0, self.n * chunk, chunk)
+        ]
+        return self._assemble(parts, node.symbols)
 
 
 class SqlTask:
@@ -263,27 +455,49 @@ class SqlTask:
         )
         for k, v in s.get("properties", {}).items():
             self.session.properties[k] = v
-        # workers run single-node interpretation of their fragment
+        # interpreter fallback runs single-node on this fragment
         self.session.properties["execution_mode"] = "local"
+        self.execution_path = "pending"
+        self.stats: dict[str, Any] = {}
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     # --- execution --------------------------------------------------------
 
+    def _prefetch_sources(self) -> dict[int, list[Batch]]:
+        """Pull every remote source exactly once (pages are freed on final
+        ack, so a retry after a failed device attempt cannot re-pull)."""
+        out: dict[int, list[Batch]] = {}
+        threads = []
+        errors: list[Exception] = []
+
+        def pull(fid: int, src: dict):
+            try:
+                out[fid] = ExchangeClient(
+                    src["locations"], src["partition"]
+                ).read_all()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        for fid, src in self.sources.items():
+            t = threading.Thread(target=pull, args=(fid, src), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return out
+
     def _run(self) -> None:
         try:
-            executor = WorkerExecutor(
-                self.engine.catalogs, self.session, self.splits, self.sources
-            )
-            root = self.fragment.root
-            if isinstance(root, P.Output):
-                res_batch, _names = executor.execute(root)
-                result = Result(
-                    res_batch,
-                    {s.name: i for i, s in enumerate(root.output_symbols)},
-                )
-            else:
-                result = executor._exec(root)
+            prefetched = self._prefetch_sources()
+            result = None
+            if self.session.get("worker_execution") == "fused":
+                result = self._try_fused(prefetched)
+            if result is None:
+                self.execution_path = "interpreter"
+                result = self._run_interpreted(prefetched)
             self._emit(result)
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001
@@ -291,6 +505,52 @@ class SqlTask:
             self.state = "FAILED"
         finally:
             self.buffer.set_complete()
+
+    def _try_fused(self, prefetched) -> Optional[Result]:
+        """Fragment as one compiled program on worker-local devices; None
+        means fall back to the interpreter."""
+        import jax
+
+        from trino_tpu.exec.fragments import FusedUnsupported, fragment_fusable
+
+        if not fragment_fusable(self.fragment):
+            return None
+        try:
+            runner = FusedWorkerRunner(self.engine, self.session, self.fragment)
+            source_meta = {
+                fid: {"keys": src.get("keys"), "symbols": src.get("symbols")}
+                for fid, src in self.sources.items()
+            }
+            result = runner.run(
+                self.splits, prefetched, source_meta, stats_sink=self.stats
+            )
+            self.execution_path = "fused"
+            return result
+        except (FusedUnsupported, jax.errors.TracerArrayConversionError):
+            return None
+        except Exception as e:  # noqa: BLE001
+            # any other device-path failure (capacity retry exhaustion, XLA
+            # errors): the interpreter fallback recomputes from the
+            # prefetched sources — record why for observability
+            self.stats["fused_error"] = f"{type(e).__name__}: {e}"
+            return None
+
+    def _run_interpreted(self, prefetched) -> Result:
+        executor = WorkerExecutor(
+            self.engine.catalogs,
+            self.session,
+            self.splits,
+            self.sources,
+            prefetched=prefetched,
+        )
+        root = self.fragment.root
+        if isinstance(root, P.Output):
+            res_batch, _names = executor.execute(root)
+            return Result(
+                res_batch,
+                {s.name: i for i, s in enumerate(root.output_symbols)},
+            )
+        return executor._exec(root)
 
     def _emit(self, result: Result) -> None:
         batch = result.batch.compact()
@@ -327,6 +587,8 @@ class SqlTask:
             "error": self.error,
             "fragment": self.fragment_id,
             "elapsed": time.time() - self.created,
+            "executionPath": self.execution_path,
+            "stats": self.stats,
         }
 
     def results(self, partition: int, token: int, max_wait: float) -> dict:
@@ -350,6 +612,22 @@ class SqlTask:
         # always release buffered pages (a finished task's final unacked
         # window would otherwise live as long as the registry entry)
         self.buffer.abort()
+
+
+def _empty_column(t) -> Column:
+    """Zero-row column for a type: wide DECIMAL uses (0, 2) hi/lo lanes,
+    strings carry an empty dictionary (string kernels require one)."""
+    from trino_tpu import types as T
+    from trino_tpu.columnar import Dictionary
+
+    if isinstance(t, T.DecimalType) and t.wide:
+        return Column(t, np.zeros((0, 2), dtype=np.int64))
+    return Column(
+        t,
+        np.zeros(0, dtype=t.storage_dtype),
+        None,
+        Dictionary([]) if T.is_string(t) else None,
+    )
 
 
 def _paginate(batch: Batch):

@@ -77,13 +77,16 @@ class MultiProcessQueryRunner:
     stricter: nothing can leak through shared memory).
     """
 
-    def __init__(self, n_workers: int = 2, platform: str = "cpu"):
+    def __init__(self, n_workers: int = 2, platform: str = "cpu", spmd: bool = False):
         import os
         import subprocess
         import sys
+        import threading
+        import time
         import urllib.request
 
         self._procs: list[subprocess.Popen] = []
+        self.spmd = spmd
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)  # workers run CPU-only
         env["JAX_PLATFORMS"] = platform
@@ -97,11 +100,9 @@ class MultiProcessQueryRunner:
             ),
         )
 
-        import threading
-
         self._logs: list[list[str]] = []
 
-        def spawn(args):
+        def popen(args):
             proc = subprocess.Popen(
                 [sys.executable, "-m", "trino_tpu.server.main", *args],
                 stdout=subprocess.PIPE,
@@ -111,7 +112,10 @@ class MultiProcessQueryRunner:
                 cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             )
             self._procs.append(proc)
-            deadline = time.time() + 120
+            return proc
+
+        def await_listening(proc):
+            deadline = time.time() + 180
             while time.time() < deadline:
                 line = proc.stdout.readline()
                 if line.startswith("LISTENING "):
@@ -132,26 +136,86 @@ class MultiProcessQueryRunner:
                     )
             raise TimeoutError("server did not start in time")
 
-        import time
+        spmd_args: list[list[str]] = []
+        if spmd:
+            # one jax.distributed group: coordinator = rank 0
+            import socket
 
-        self.coordinator_uri = spawn(
-            ["--role", "coordinator", "--platform", platform]
-        )
-        self.worker_uris = [
-            spawn(
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            dist_port = s.getsockname()[1]
+            s.close()
+            nprocs = n_workers + 1
+            spmd_args = [
                 [
-                    "--role",
-                    "worker",
-                    "--node-id",
-                    f"worker-{i}",
-                    "--discovery",
-                    self.coordinator_uri,
-                    "--platform",
-                    platform,
+                    "--spmd-coordinator",
+                    f"127.0.0.1:{dist_port}",
+                    "--spmd-procs",
+                    str(nprocs),
+                    "--spmd-rank",
+                    str(rank),
                 ]
-            )
-            for i in range(n_workers)
-        ]
+                for rank in range(nprocs)
+            ]
+
+        coord_proc = popen(
+            ["--role", "coordinator", "--platform", platform]
+            + (spmd_args[0] if spmd else [])
+        )
+        if spmd:
+            # workers must join the jax.distributed group before any process
+            # finishes booting; spawn all before reading LISTENING lines.
+            # Workers discover the coordinator lazily via --discovery-wait.
+            self.coordinator_uri = None
+            worker_procs = [
+                popen(
+                    [
+                        "--role",
+                        "worker",
+                        "--node-id",
+                        f"worker-{i}",
+                        "--discovery",
+                        "@coordinator",
+                        "--platform",
+                        platform,
+                    ]
+                    + spmd_args[i + 1]
+                )
+                for i in range(n_workers)
+            ]
+            self.coordinator_uri = await_listening(coord_proc)
+            self.worker_uris = [await_listening(p) for p in worker_procs]
+            # late discovery: tell each worker where the coordinator is
+            import json as _json
+
+            for uri in self.worker_uris:
+                req = urllib.request.Request(
+                    f"{uri}/v1/discovery",
+                    data=_json.dumps(
+                        {"uri": self.coordinator_uri}
+                    ).encode(),
+                    method="PUT",
+                )
+                urllib.request.urlopen(req, timeout=10)
+        else:
+            self.coordinator_uri = await_listening(coord_proc)
+            self.worker_uris = [
+                await_listening(
+                    popen(
+                        [
+                            "--role",
+                            "worker",
+                            "--node-id",
+                            f"worker-{i}",
+                            "--discovery",
+                            self.coordinator_uri,
+                            "--platform",
+                            platform,
+                        ]
+                    )
+                )
+                for i in range(n_workers)
+            ]
         # wait for every worker to be announced and healthy
         deadline = time.time() + 60
         import json as _json
