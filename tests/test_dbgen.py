@@ -1,0 +1,293 @@
+"""Spec-exact TPC-H generator validation against dbgen-produced fixtures.
+
+The reference tree ships raw dbgen output (example-http test CSVs: SF1
+orders/lineitem rows), the full nation table, per-SF statistics, and the
+SF1 answer set for Q1-Q22 (product-test resources). These are DATA
+fixtures — we read them in place as the generation oracle. Every stream
+seed in connectors/dbgen.py is pinned here; several were solved from
+these fixtures by interval constraint propagation.
+
+Reference: ``plugin/trino-tpch`` delegates to the io.trino.tpch generator
+(``TpchRecordSet.java``); this suite proves our streams are bit-identical
+on everything except the grammar text pool (comments), whose dists.dss
+word weights are a best-effort reconstruction (tracked known deviation).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors import dbgen as D
+
+REF = "/root/reference"
+EXAMPLE = f"{REF}/plugin/trino-example-http/src/test/resources/example-data"
+RESULTS = (
+    f"{REF}/testing/trino-product-tests/src/main/resources/sql-tests/"
+    "testcases/hive_tpch"
+)
+STATS = f"{REF}/plugin/trino-tpch/src/main/resources/tpch/statistics"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference fixtures not available"
+)
+
+DATE0 = np.datetime64("1992-01-01")
+
+
+def d2s(off):
+    return str(DATE0 + np.timedelta64(int(off), "D"))
+
+
+@pytest.fixture(scope="module")
+def orders_fixture():
+    rows = []
+    for fn in ("orders-1.csv", "orders-2.csv"):
+        for ln in open(f"{EXAMPLE}/{fn}"):
+            rows.append(ln.rstrip("\n").split(", ", 8))
+    return rows
+
+
+@pytest.fixture(scope="module")
+def lineitem_fixture():
+    rows = []
+    for fn in ("lineitem-1.csv", "lineitem-2.csv"):
+        for ln in open(f"{EXAMPLE}/{fn}"):
+            rows.append(ln.rstrip("\n").split(", ", 15))
+    return rows
+
+
+class TestRowCounts:
+    def test_tiny_counts(self):
+        c = D.counts(0.01)
+        assert c["orders"] == 15000 and c["customer"] == 1500
+        assert c["part"] == 2000 and c["supplier"] == 100
+        n_lines = int(D.Stream(D.S_LINE_COUNT, 1).rows(0, 15000, 1, 7).sum())
+        assert n_lines == 60175  # published tiny lineitem row count
+
+    def test_sf1_lineitem_count(self):
+        total = 0
+        for row0 in range(0, 1_500_000, 500_000):
+            total += int(
+                D.Stream(D.S_LINE_COUNT, 1).rows(row0, 500_000, 1, 7).sum()
+            )
+        assert total == 6_001_215
+
+    def test_stats_fixture_row_counts(self):
+        for sf, name in ((0.01, "sf0.01"), (1.0, "sf1.0")):
+            for t in ("orders", "customer", "part", "supplier"):
+                d = json.load(open(f"{STATS}/{name}/{t}.json"))
+                assert D.counts(sf)[t] == d["rowCount"], (sf, t)
+
+
+class TestOrdersExact:
+    def test_all_fields(self, orders_fixture):
+        # fixture files cover two disjoint order-index ranges
+        g = D.gen_orders(1.0, 0, 600)
+        by_key = {int(k): i for i, k in enumerate(g["o_orderkey"])}
+        prios = D.PRIORITIES.values
+        checked = 0
+        for p in orders_fixture:
+            okey = int(p[0])
+            if okey not in by_key:
+                continue
+            r = by_key[okey]
+            checked += 1
+            assert g["o_custkey"][r] == int(p[1])
+            assert "FOP"[g["o_orderstatus"][r]] == p[2]
+            assert g["o_totalprice"][r] == int(round(float(p[3]) * 100))
+            assert d2s(g["o_orderdate"][r]) == p[4]
+            assert prios[g["o_orderpriority"][r]] == p[5]
+            assert g["o_clerk"][r] == p[6]
+            assert int(p[7]) == 0
+        assert checked >= 190
+
+
+class TestLineitemExact:
+    def test_all_fields(self, lineitem_fixture):
+        g = D.gen_lineitem(1.0, 0, 600)
+        index = {
+            (int(k), int(l)): i
+            for i, (k, l) in enumerate(
+                zip(g["l_orderkey"], g["l_linenumber"])
+            )
+        }
+        instr = D.INSTRUCTIONS.values
+        modes = D.MODES.values
+        checked = 0
+        for p in lineitem_fixture:
+            key = (int(p[0]), int(p[3]))
+            if key not in index:
+                continue
+            i = index[key]
+            checked += 1
+            assert g["l_partkey"][i] == int(p[1])
+            assert g["l_suppkey"][i] == int(p[2])
+            assert g["l_quantity"][i] == int(round(float(p[4]) * 100))
+            assert g["l_extendedprice"][i] == int(round(float(p[5]) * 100))
+            assert g["l_discount"][i] == int(round(float(p[6]) * 100))
+            assert g["l_tax"][i] == int(round(float(p[7]) * 100))
+            assert "RAN"[g["l_returnflag"][i]] == p[8]
+            assert "FO"[g["l_linestatus"][i]] == p[9]
+            assert d2s(g["l_shipdate"][i]) == p[10]
+            assert d2s(g["l_commitdate"][i]) == p[11]
+            assert d2s(g["l_receiptdate"][i]) == p[12]
+            assert instr[g["l_shipinstruct"][i]] == p[13]
+            assert modes[g["l_shipmode"][i]] == p[14]
+        assert checked >= 700
+
+
+class TestCustomerStreams:
+    def test_q10_columns(self):
+        """q10's answer rows pin customer nation/phone/acctbal exactly."""
+        nations = [nm for nm, _ in D.NATIONS]
+        for ln in open(f"{RESULTS}/q10.result"):
+            if ln.startswith("--") or "|" not in ln:
+                continue
+            p = ln.rstrip("\n").split("|")
+            ck = int(p[0])
+            g = D.gen_customer(1.0, ck - 1, 1)
+            assert g["c_name"][0] == p[1]
+            assert abs(g["c_acctbal"][0] / 100 - float(p[3])) < 0.005
+            assert nations[int(g["c_nationkey"][0])] == p[4]
+            assert g["c_phone"][0] == p[6]
+
+
+class TestAnswerSetAggregates:
+    """Q1/Q6 at SF1 computed straight off the generated arrays must match
+    the published answer set (hive's sum_charge carries float noise in its
+    last digit — compare to 1e-4 dollars, everything else exactly)."""
+
+    @pytest.fixture(scope="class")
+    def sf1_agg(self):
+        off_0902 = int(
+            (np.datetime64("1998-09-02") - DATE0) / np.timedelta64(1, "D")
+        )
+        off_9401 = int(
+            (np.datetime64("1994-01-01") - DATE0) / np.timedelta64(1, "D")
+        )
+        off_9501 = int(
+            (np.datetime64("1995-01-01") - DATE0) / np.timedelta64(1, "D")
+        )
+        acc = {}
+        q6rev = 0
+        N, CH = 1_500_000, 500_000
+        for row0 in range(0, N, CH):
+            n = min(CH, N - row0)
+            blk = D.gen_order_block(1.0, row0, n)
+            live = blk["live"]
+            ship = blk["l_ship_off"]
+            rf = blk["l_returnflag_idx"]
+            ls = blk["l_linestatus_idx"]
+            qty = blk["l_quantity"]
+            ep = blk["l_eprice"]
+            disc = blk["l_discount"]
+            tax = blk["l_tax"]
+            selq1 = live & (ship <= off_0902)
+            for r in range(3):
+                for s in range(2):
+                    m = selq1 & (rf == r) & (ls == s)
+                    if not m.any():
+                        continue
+                    a = acc.setdefault(
+                        ("RAN"[r], "FO"[s]), np.zeros(6, dtype=object)
+                    )
+                    a[0] += int(qty[m].sum())
+                    a[1] += int(ep[m].sum())
+                    a[2] += int((ep[m] * (100 - disc[m])).sum())
+                    a[3] += int(
+                        (ep[m] * (100 - disc[m]) * (100 + tax[m])).sum()
+                    )
+                    a[4] += int(disc[m].sum())
+                    a[5] += int(m.sum())
+            selq6 = (
+                live
+                & (ship >= off_9401)
+                & (ship < off_9501)
+                & (disc >= 5)
+                & (disc <= 7)
+                & (qty < 24)
+            )
+            q6rev += int((ep[selq6] * disc[selq6]).sum())
+        return acc, q6rev
+
+    def test_q1(self, sf1_agg):
+        acc, _ = sf1_agg
+        want = {}
+        for ln in open(f"{RESULTS}/q01.result"):
+            if ln.startswith("--") or "|" not in ln:
+                continue
+            p = ln.rstrip("\n").split("|")
+            want[(p[0], p[1])] = p[2:10]
+        assert set(acc) == set(want)
+        for key, a in acc.items():
+            w = want[key]
+            # exact integer comparisons in native scales:
+            assert a[0] == int(round(float(w[0])))  # sum_qty (whole units)
+            assert a[1] == int(round(float(w[1]) * 100))  # cents
+            assert a[2] == int(round(float(w[2]) * 10_000))
+            # hive's sum_charge is a double sum — compare to 1e-4 dollars
+            assert abs(a[3] / 1_000_000 - float(w[3])) < 1e-4
+            assert a[5] == int(w[7])  # count
+
+    def test_q6(self, sf1_agg):
+        _, q6rev = sf1_agg
+        for ln in open(f"{RESULTS}/q06.result"):
+            if ln.startswith("--") or "|" not in ln:
+                continue
+            want = float(ln.strip().rstrip("|"))
+        assert q6rev == int(round(want * 10_000))
+
+
+class TestTextPool:
+    def test_comment_stream_lengths(self):
+        """Offsets/lengths of every comment stream are exact (pool content
+        is the tracked deviation, lengths prove the draw protocol)."""
+        want = []
+        for ln in open(
+            f"{REF}/testing/trino-product-tests/src/main/resources/"
+            "table-results/presto-nation.result"
+        ):
+            if "|" in ln and not ln.startswith("--"):
+                want.append(len(ln.split("|")[3]))
+        draws = D.Stream(D.S_NATION_COMMENT, 2).row_draws(0, 25, 2)
+        lens = D.bounded(draws[:, 1], 28, 115)
+        assert [int(x) for x in lens] == want
+
+    def test_pool_generates(self):
+        pool = D.text_pool()
+        assert len(pool) == D.TEXT_POOL_SIZE
+        head = pool[:64].tobytes().decode()
+        # grammar produces dbgen-shaped prose
+        assert " " in head and head.strip()
+
+
+class TestEngineParity:
+    def test_tiny_q1_through_engine(self):
+        from trino_tpu.testing import LocalQueryRunner
+
+        r = LocalQueryRunner()
+        rows, _ = r.execute(
+            """select l_returnflag, l_linestatus, sum(l_quantity),
+                      count(*) from lineitem
+               group by l_returnflag, l_linestatus
+               order by l_returnflag, l_linestatus"""
+        )
+        # independent recomputation from the generator
+        blk = D.gen_lineitem(0.01, 0, 15000)
+        import collections
+
+        ctr = collections.Counter()
+        qsum = collections.Counter()
+        for rf, ls, q in zip(
+            blk["l_returnflag"], blk["l_linestatus"], blk["l_quantity"]
+        ):
+            key = ("RAN"[rf], "FO"[ls])
+            ctr[key] += 1
+            qsum[key] += int(q)
+        got = {(a, b): (int(c * 100), n) for a, b, c, n in [
+            (row[0], row[1], row[2], row[3]) for row in rows
+        ]}
+        for key in ctr:
+            assert got[key] == (qsum[key], ctr[key])

@@ -103,36 +103,10 @@ _SCHEMAS = {
     ],
 }
 
-_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
-_NATIONS = [
-    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
-    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
-    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
-    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
-    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
-    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
-    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
-]
-_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
-_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
-_SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
-_INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
-_CONTAINERS = [
-    f"{a} {b}"
-    for a in ["SM", "LG", "MED", "JUMBO", "WRAP"]
-    for b in ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
-]
-_TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
-_TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
-_TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
-_TYPES = [f"{a} {b} {c}" for a in _TYPE_S1 for b in _TYPE_S2 for c in _TYPE_S3]
-_BRANDS = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
 
 _EPOCH_START = days_from_civil(1992, 1, 1)
 _EPOCH_END = days_from_civil(1998, 8, 2)
 
-# deterministic comment pool (small dictionary — comments are rarely queried)
-_COMMENT_POOL = 64
 
 
 def scale_factor(schema: str) -> float:
@@ -144,16 +118,13 @@ def scale_factor(schema: str) -> float:
 
 
 def _counts(sf: float) -> dict[str, int]:
-    return {
-        "region": 5,
-        "nation": 25,
-        "supplier": max(1, int(10_000 * sf)),
-        "customer": max(1, int(150_000 * sf)),
-        "part": max(1, int(200_000 * sf)),
-        "partsupp": max(1, int(200_000 * sf)) * 4,
-        "orders": max(1, int(1_500_000 * sf)),
-        "lineitem": None,  # derived from orders (avg ~4 lines per order)
-    }
+    # single source of truth: dbgen.counts (rounding must match the key
+    # domains the generator draws from, or joins silently drop rows)
+    from trino_tpu.connectors.dbgen import counts
+
+    out = dict(counts(sf))
+    out["lineitem"] = None  # derived from orders (avg ~4 lines per order)
+    return out
 
 
 class TpchConnector(Connector):
@@ -194,6 +165,7 @@ class TpchConnector(Connector):
         domains (reference: ``plugin/trino-tpch/.../statistics/`` — the
         reference likewise ships precomputed stats for the CBO)."""
         from trino_tpu.connectors.api import ColumnStats, TableStats
+        from trino_tpu.connectors import dbgen as G
 
         sf = scale_factor(schema)
         c = _counts(sf)
@@ -204,7 +176,13 @@ class TpchConnector(Connector):
             base = "orders" if table == "lineitem" else table
             nkeys = c[base]
             lo = 0 if table in self._ZERO_BASED_KEYS else 1
-            cols[key] = ColumnStats(float(nkeys), 0.0, lo, lo + nkeys - 1)
+            if table in ("orders", "lineitem"):
+                from trino_tpu.connectors.dbgen import make_order_key
+
+                hi_key = int(make_order_key(np.asarray([nkeys]))[0])
+                cols[key] = ColumnStats(float(nkeys), 0.0, 1, hi_key)
+            else:
+                cols[key] = ColumnStats(float(nkeys), 0.0, lo, lo + nkeys - 1)
         fks = {
             "nation": [("n_regionkey", "region", 0)],
             "supplier": [("s_nationkey", "nation", 0)],
@@ -219,10 +197,11 @@ class TpchConnector(Connector):
         low_card = {
             "o_orderstatus": 3, "o_orderpriority": 5, "o_shippriority": 1,
             "l_returnflag": 3, "l_linestatus": 2,
-            "l_shipmode": len(_SHIPMODES), "l_shipinstruct": len(_INSTRUCTS),
-            "c_mktsegment": len(_SEGMENTS), "n_name": 25, "r_name": 5,
-            "p_brand": len(_BRANDS), "p_type": len(_TYPES),
-            "p_container": len(_CONTAINERS), "p_size": 50,
+            "l_shipmode": len(G.MODES.values),
+            "l_shipinstruct": len(G.INSTRUCTIONS.values),
+            "c_mktsegment": len(G.SEGMENTS.values), "n_name": 25, "r_name": 5,
+            "p_brand": 25, "p_type": len(G.TYPES.values),
+            "p_container": len(G.CONTAINERS.values), "p_size": 50,
         }
         dates = {
             "o_orderdate": (_EPOCH_START, _EPOCH_END),
@@ -271,6 +250,17 @@ class TpchConnector(Connector):
         lo, hi = self._range(total_rows, split.index, split.total)
         if hi <= lo:
             return {key: (None, None, False)}
+        if table in ("orders", "lineitem"):
+            # sparse but monotone order keys (dbgen mk_sparse)
+            from trino_tpu.connectors.dbgen import make_order_key
+
+            return {
+                key: (
+                    int(make_order_key(np.asarray([lo + 1]))[0]),
+                    int(make_order_key(np.asarray([hi]))[0]),
+                    False,
+                )
+            }
         if table in self._ZERO_BASED_KEYS:
             return {key: (lo, hi - 1, False)}
         return {key: (lo + 1, hi, False)}
@@ -279,7 +269,7 @@ class TpchConnector(Connector):
     def read_split(self, schema, table, columns, split):
         sf = scale_factor(schema)
         gen = getattr(self, f"_gen_{table}")
-        cols = gen(sf, split.index, split.total)
+        cols = gen(sf, split.index, split.total, columns=set(columns))
         out = [cols[c] for c in columns]
         n = out[0].data.shape[0] if out else 0
         return Batch(out, n)
@@ -291,13 +281,6 @@ class TpchConnector(Connector):
         hi = min(total_rows, lo + per)
         return lo, hi
 
-    def _rng(self, table: str, index: int) -> np.random.Generator:
-        # process-stable seed: generation must be identical across workers
-        # and across runs (PYTHONHASHSEED randomizes str hash)
-        import hashlib
-
-        h = hashlib.sha256(f"tpch:{table}:{index}".encode()).digest()
-        return np.random.default_rng(int.from_bytes(h[:8], "little"))
 
     def _strings(self, name: str, values: list[str]) -> Dictionary:
         key = f"{name}:{len(values)}"
@@ -305,262 +288,94 @@ class TpchConnector(Connector):
             self._dict_cache[key] = Dictionary(values)
         return self._dict_cache[key]
 
-    def _comments(self, rng, n: int, prefix: str) -> Column:
-        d = self._strings(
-            f"comment_{prefix}", [f"{prefix} comment {i}" for i in range(_COMMENT_POOL)]
-        )
-        codes = rng.integers(0, _COMMENT_POOL, n).astype(np.int32)
-        return Column(T.VARCHAR, codes, None, d)
 
-    def _dict_col(self, name: str, values: list[str], codes: np.ndarray) -> Column:
-        return Column(T.VARCHAR, codes.astype(np.int32), None, self._strings(name, values))
 
-    def _gen_region(self, sf, index, total):
+    # --- dbgen-backed generation -----------------------------------------
+    # (spec-exact streams; see connectors/dbgen.py and tests/test_dbgen.py)
+
+    _DEC_COLUMNS = {
+        "s_acctbal", "c_acctbal", "p_retailprice", "ps_supplycost",
+        "o_totalprice", "l_quantity", "l_extendedprice", "l_discount",
+        "l_tax",
+    }
+    _DATE_COLUMNS = {"o_orderdate", "l_shipdate", "l_commitdate", "l_receiptdate"}
+
+    def _to_batch_dict(self, raw: dict) -> dict:
+        from trino_tpu.connectors import dbgen as G
+
+        out = {}
+        for name, data in raw.items():
+            if name.startswith("_"):
+                continue
+            if name in G.DIST_VALUES:
+                d = self._strings(name, G.DIST_VALUES[name])
+                out[name] = Column(
+                    T.VARCHAR, np.asarray(data, dtype=np.int32), None, d
+                )
+            elif isinstance(data, list):  # per-split strings
+                d, codes = Dictionary.from_strings(data)
+                out[name] = Column(T.VARCHAR, codes, None, d)
+            elif name in self._DEC_COLUMNS:
+                out[name] = Column(DEC, np.asarray(data, dtype=np.int64))
+            elif name in self._DATE_COLUMNS:
+                days = _EPOCH_START + np.asarray(data, dtype=np.int64)
+                out[name] = Column(T.DATE, days.astype(np.int32))
+            else:
+                out[name] = Column(T.BIGINT, np.asarray(data, dtype=np.int64))
+        return out
+
+    def _gen_region(self, sf, index, total, columns=None):
+        from trino_tpu.connectors import dbgen as G
+
         lo, hi = self._range(5, index, total)
-        n = hi - lo
-        keys = np.arange(lo, hi, dtype=np.int64)
-        rng = self._rng("region", index)
-        return {
-            "r_regionkey": Column(T.BIGINT, keys),
-            "r_name": self._dict_col("r_name", _REGIONS, keys.astype(np.int32)),
-            "r_comment": self._comments(rng, n, "region"),
-        }
+        return self._to_batch_dict(G.gen_region(lo, hi - lo))
 
-    def _gen_nation(self, sf, index, total):
+    def _gen_nation(self, sf, index, total, columns=None):
+        from trino_tpu.connectors import dbgen as G
+
         lo, hi = self._range(25, index, total)
-        n = hi - lo
-        keys = np.arange(lo, hi, dtype=np.int64)
-        rng = self._rng("nation", index)
-        names = [nm for nm, _ in _NATIONS]
-        rkeys = np.asarray([rk for _, rk in _NATIONS], dtype=np.int64)
-        return {
-            "n_nationkey": Column(T.BIGINT, keys),
-            "n_name": self._dict_col("n_name", names, keys.astype(np.int32)),
-            "n_regionkey": Column(T.BIGINT, rkeys[lo:hi]),
-            "n_comment": self._comments(rng, n, "nation"),
-        }
+        return self._to_batch_dict(G.gen_nation(lo, hi - lo))
 
-    def _gen_supplier(self, sf, index, total):
-        rows = _counts(sf)["supplier"]
-        lo, hi = self._range(rows, index, total)
-        n = hi - lo
-        keys = np.arange(lo + 1, hi + 1, dtype=np.int64)
-        rng = self._rng("supplier", index)
-        names = self._strings(
-            "s_name_pool", [f"Supplier#{i:09d}" for i in range(1, min(rows, 100_000) + 1)]
-        )
-        nationkey = rng.integers(0, 25, n).astype(np.int64)
-        return {
-            "s_suppkey": Column(T.BIGINT, keys),
-            "s_name": Column(
-                T.VARCHAR, ((keys - 1) % len(names)).astype(np.int32), None, names
-            ),
-            "s_address": self._comments(rng, n, "addr"),
-            "s_nationkey": Column(T.BIGINT, nationkey),
-            "s_phone": _phone_col(nationkey, rng),
-            "s_acctbal": Column(DEC, rng.integers(-99999, 999999, n).astype(np.int64)),
-            "s_comment": self._comments(rng, n, "supplier"),
-        }
+    def _gen_supplier(self, sf, index, total, columns=None):
+        from trino_tpu.connectors import dbgen as G
 
-    def _gen_customer(self, sf, index, total):
-        rows = _counts(sf)["customer"]
-        lo, hi = self._range(rows, index, total)
-        n = hi - lo
-        keys = np.arange(lo + 1, hi + 1, dtype=np.int64)
-        rng = self._rng("customer", index)
-        names = self._strings(
-            "c_name_pool", [f"Customer#{i:09d}" for i in range(1, min(rows, 150_000) + 1)]
-        )
-        nationkey = rng.integers(0, 25, n).astype(np.int64)
-        return {
-            "c_custkey": Column(T.BIGINT, keys),
-            "c_name": Column(
-                T.VARCHAR, ((keys - 1) % len(names)).astype(np.int32), None, names
-            ),
-            "c_address": self._comments(rng, n, "addr"),
-            "c_nationkey": Column(T.BIGINT, nationkey),
-            "c_phone": _phone_col(nationkey, rng),
-            "c_acctbal": Column(DEC, rng.integers(-99999, 999999, n).astype(np.int64)),
-            "c_mktsegment": self._dict_col(
-                "c_mktsegment", _SEGMENTS, rng.integers(0, 5, n)
-            ),
-            "c_comment": self._comments(rng, n, "customer"),
-        }
+        lo, hi = self._range(_counts(sf)["supplier"], index, total)
+        return self._to_batch_dict(G.gen_supplier(sf, lo, hi - lo, want=columns))
 
-    def _gen_part(self, sf, index, total):
-        rows = _counts(sf)["part"]
-        lo, hi = self._range(rows, index, total)
-        n = hi - lo
-        keys = np.arange(lo + 1, hi + 1, dtype=np.int64)
-        rng = self._rng("part", index)
-        # spec color vocabulary subset incl. words TPC-H predicates probe
-        # for ('%green%' in Q9, 'forest%' in Q20)
-        name_words = [
-            "almond", "antique", "aquamarine", "azure", "beige", "bisque",
-            "black", "blanched", "blue", "blush", "brown", "burlywood",
-            "chartreuse", "chocolate", "coral", "cornflower", "cream",
-            "cyan", "dark", "deep", "dim", "dodger", "drab", "firebrick",
-            "forest", "frosted", "gainsboro", "ghost", "goldenrod", "green",
-            "grey", "honeydew", "hot", "indian", "ivory", "khaki",
-        ]
-        pnames = self._strings(
-            "p_name_pool",
-            [f"{a} {b}" for a in name_words for b in name_words],
-        )
-        return {
-            "p_partkey": Column(T.BIGINT, keys),
-            "p_name": Column(
-                T.VARCHAR, rng.integers(0, len(pnames), n).astype(np.int32), None, pnames
-            ),
-            "p_mfgr": self._dict_col(
-                "p_mfgr",
-                [f"Manufacturer#{i}" for i in range(1, 6)],
-                rng.integers(0, 5, n),
-            ),
-            "p_brand": self._dict_col("p_brand", _BRANDS, rng.integers(0, 25, n)),
-            "p_type": self._dict_col("p_type", _TYPES, rng.integers(0, len(_TYPES), n)),
-            "p_size": Column(T.BIGINT, rng.integers(1, 51, n).astype(np.int64)),
-            "p_container": self._dict_col(
-                "p_container", _CONTAINERS, rng.integers(0, len(_CONTAINERS), n)
-            ),
-            "p_retailprice": Column(
-                DEC, (90000 + ((keys % 20001) * 10) + (keys % 1000)).astype(np.int64)
-            ),
-            "p_comment": self._comments(rng, n, "part"),
-        }
+    def _gen_customer(self, sf, index, total, columns=None):
+        from trino_tpu.connectors import dbgen as G
 
-    def _gen_partsupp(self, sf, index, total):
-        nparts = _counts(sf)["part"]
-        rows = nparts * 4
-        lo, hi = self._range(rows, index, total)
-        n = hi - lo
-        rng = self._rng("partsupp", index)
-        idx = np.arange(lo, hi, dtype=np.int64)
-        partkey = idx // 4 + 1
-        nsupp = _counts(sf)["supplier"]
-        suppkey = ((partkey + (idx % 4) * (nsupp // 4 + 1)) % nsupp) + 1
-        return {
-            "ps_partkey": Column(T.BIGINT, partkey),
-            "ps_suppkey": Column(T.BIGINT, suppkey),
-            "ps_availqty": Column(T.BIGINT, rng.integers(1, 10000, n).astype(np.int64)),
-            "ps_supplycost": Column(DEC, rng.integers(100, 100001, n).astype(np.int64)),
-            "ps_comment": self._comments(rng, n, "partsupp"),
-        }
+        lo, hi = self._range(_counts(sf)["customer"], index, total)
+        return self._to_batch_dict(G.gen_customer(sf, lo, hi - lo, want=columns))
 
-    def _gen_orders(self, sf, index, total):
-        rows = _counts(sf)["orders"]
-        lo, hi = self._range(rows, index, total)
-        n = hi - lo
-        keys = np.arange(lo + 1, hi + 1, dtype=np.int64)
-        rng = self._rng("orders", index)
-        ncust = _counts(sf)["customer"]
-        custkey = rng.integers(1, ncust + 1, n).astype(np.int64)
-        odate = _order_date_for_keys(keys)  # shared derivation with lineitem
-        return {
-            "o_orderkey": Column(T.BIGINT, keys),
-            "o_custkey": Column(T.BIGINT, custkey),
-            "o_orderstatus": self._dict_col(
-                "o_orderstatus", ["F", "O", "P"], rng.integers(0, 3, n)
-            ),
-            "o_totalprice": Column(
-                DEC, rng.integers(90000, 50000000, n).astype(np.int64)
-            ),
-            "o_orderdate": Column(T.DATE, odate),
-            "o_orderpriority": self._dict_col(
-                "o_orderpriority", _PRIORITIES, rng.integers(0, 5, n)
-            ),
-            "o_clerk": self._dict_col(
-                "o_clerk",
-                [f"Clerk#{i:09d}" for i in range(1, 1001)],
-                rng.integers(0, 1000, n),
-            ),
-            "o_shippriority": Column(T.BIGINT, np.zeros(n, dtype=np.int64)),
-            "o_comment": self._comments(rng, n, "order"),
-        }
+    def _gen_part(self, sf, index, total, columns=None):
+        from trino_tpu.connectors import dbgen as G
 
-    def _gen_lineitem(self, sf, index, total):
-        # lineitem derives from orders: each order o in this split's order
-        # range contributes lines(o) rows; split over orders, not lines.
-        orders_rows = _counts(sf)["orders"]
-        lo, hi = self._range(orders_rows, index, total)
-        rng = self._rng("lineitem", index)
-        okeys = np.arange(lo + 1, hi + 1, dtype=np.int64)
-        # deterministic per-order line count 1..7 (same hash stream as orders
-        # split generation is not required — only self-consistency is)
-        nlines = (okeys * 2654435761 % 7 + 1).astype(np.int64)
-        l_orderkey = np.repeat(okeys, nlines)
-        n = l_orderkey.shape[0]
-        # o_orderdate is derived from the order key (shared keyed-hash
-        # derivation) so both generators agree without cross-reading splits
-        odate = _order_date_for_keys(okeys)
-        l_odate = np.repeat(odate, nlines)
-        lineno = _line_numbers(nlines)
-        npart = _counts(sf)["part"]
-        nsupp = _counts(sf)["supplier"]
-        partkey = rng.integers(1, npart + 1, n).astype(np.int64)
-        suppkey = ((partkey + lineno * (nsupp // 4 + 1)) % nsupp) + 1
-        qty = rng.integers(1, 51, n).astype(np.int64)
-        extprice = (qty * (90000 + (partkey % 20001) * 10 + partkey % 1000) // 100).astype(
-            np.int64
-        )
-        discount = rng.integers(0, 11, n).astype(np.int64)
-        tax = rng.integers(0, 9, n).astype(np.int64)
-        shipdate = (l_odate + rng.integers(1, 122, n)).astype(np.int32)
-        commitdate = (l_odate + rng.integers(30, 91, n)).astype(np.int32)
-        receiptdate = (shipdate + rng.integers(1, 31, n)).astype(np.int32)
-        cutoff = days_from_civil(1995, 6, 17)
-        returnflag_code = np.where(
-            receiptdate <= cutoff, rng.integers(0, 2, n), 2
-        ).astype(np.int32)  # A/R for old, N for new
-        linestatus_code = np.where(shipdate > cutoff, 1, 0).astype(np.int32)  # O/F
-        return {
-            "l_orderkey": Column(T.BIGINT, l_orderkey),
-            "l_partkey": Column(T.BIGINT, partkey),
-            "l_suppkey": Column(T.BIGINT, suppkey),
-            "l_linenumber": Column(T.BIGINT, lineno + 1),
-            "l_quantity": Column(DEC, qty * 100),
-            "l_extendedprice": Column(DEC, extprice),
-            "l_discount": Column(DEC, discount),
-            "l_tax": Column(DEC, tax),
-            "l_returnflag": self._dict_col("l_returnflag", ["A", "R", "N"], returnflag_code),
-            "l_linestatus": self._dict_col("l_linestatus", ["F", "O"], linestatus_code),
-            "l_shipdate": Column(T.DATE, shipdate),
-            "l_commitdate": Column(T.DATE, commitdate),
-            "l_receiptdate": Column(T.DATE, receiptdate),
-            "l_shipinstruct": self._dict_col(
-                "l_shipinstruct", _INSTRUCTS, rng.integers(0, 4, n)
-            ),
-            "l_shipmode": self._dict_col(
-                "l_shipmode", _SHIPMODES, rng.integers(0, 7, n)
-            ),
-            "l_comment": self._comments(rng, n, "line"),
-        }
+        lo, hi = self._range(_counts(sf)["part"], index, total)
+        return self._to_batch_dict(G.gen_part(sf, lo, hi - lo, want=columns))
 
+    def _gen_partsupp(self, sf, index, total, columns=None):
+        from trino_tpu.connectors import dbgen as G
 
-def _order_date_for_keys(okeys: np.ndarray) -> np.ndarray:
-    """Keyed-hash order date — shared derivation so that _gen_orders'
-    o_orderdate and _gen_lineitem's (shipdate = o_orderdate + delta) agree
-    exactly without either split reading the other's data."""
-    h = (okeys * np.uint64(0x9E3779B97F4A7C15)) % np.uint64(1 << 32)
-    span = _EPOCH_END - 121 - _EPOCH_START
-    return (_EPOCH_START + (h % np.uint64(span)).astype(np.int64)).astype(np.int32)
+        # split over parts (4 partsupp rows per part)
+        lo, hi = self._range(_counts(sf)["part"], index, total)
+        return self._to_batch_dict(G.gen_partsupp(sf, lo, hi - lo, want=columns))
 
+    def _gen_orders(self, sf, index, total, columns=None):
+        from trino_tpu.connectors import dbgen as G
 
-def _line_numbers(nlines: np.ndarray) -> np.ndarray:
-    """[3,2] -> [0,1,2,0,1]."""
-    total = int(nlines.sum())
-    starts = np.repeat(np.cumsum(nlines) - nlines, nlines)
-    return (np.arange(total, dtype=np.int64) - starts).astype(np.int64)
+        lo, hi = self._range(_counts(sf)["orders"], index, total)
+        return self._to_batch_dict(G.gen_orders(sf, lo, hi - lo, want=columns))
 
+    def _gen_lineitem(self, sf, index, total, columns=None):
+        from trino_tpu.connectors import dbgen as G
 
-def _phone_col(nationkey: np.ndarray, rng) -> Column:
-    """Spec phone shape CC-NNN-NNN-NNNN with CC = nationkey + 10 — Q22
-    filters on the country-code prefix, so it must be meaningful."""
-    local = rng.integers(0, 1000, (len(nationkey), 3))
-    last = rng.integers(0, 10000, len(nationkey))
-    values = [
-        f"{int(nk) + 10}-{a:03d}-{b:03d}-{c:03d}{d % 10}"
-        for nk, (a, b, c), d in zip(nationkey, local, last)
-    ]
-    d, codes = Dictionary.from_strings(values)
-    return Column(T.VARCHAR, codes, None, d)
+        lo, hi = self._range(_counts(sf)["orders"], index, total)
+        raw = G.gen_lineitem(sf, lo, hi - lo, want=columns)
+        if columns is None or "l_comment" in columns:
+            raw["l_comment"] = G.lineitem_comments(
+                lo, hi - lo, raw["_line_flat"]
+            )
+        else:
+            raw.pop("l_comment", None)
+        return self._to_batch_dict(raw)

@@ -75,6 +75,8 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.tt_lz_decompress.argtypes = [u8p, i64, u8p, i64]
     lib.tt_snappy_decompress.restype = i64
     lib.tt_snappy_decompress.argtypes = [u8p, i64, u8p, i64]
+    lib.tt_tpch_textpool.restype = i64
+    lib.tt_tpch_textpool.argtypes = [u8p, i64, u8p, i64, i64]
     lib.tt_snappy_compress.restype = i64
     lib.tt_snappy_compress.argtypes = [u8p, i64, u8p]
     lib.tt_parquet_rle_decode.restype = i64
@@ -497,3 +499,24 @@ def lz_decompress(data: bytes, expected_len: int) -> bytes:
     import zlib
 
     return zlib.decompress(data)
+
+
+def tpch_textpool(size: int, dists_blob: bytes, seed: int) -> np.ndarray:
+    """Generate the dbgen grammar text pool (uint8 array of `size`).
+
+    Native path is ~1s for the spec's 300MB pool; the Python fallback is
+    the same algorithm (slow — callers cache the pool on disk either way).
+    """
+    if _LIB is not None:
+        out = np.empty(size, dtype=np.uint8)
+        blob = np.frombuffer(dists_blob, dtype=np.uint8)
+        ln = _LIB.tt_tpch_textpool(
+            _ptr(out, ctypes.c_uint8), size,
+            _ptr(blob, ctypes.c_uint8), len(dists_blob), seed,
+        )
+        if ln != size:
+            raise ValueError("text pool generation failed")
+        return out
+    from trino_tpu.connectors.dbgen import textpool_python
+
+    return textpool_python(size, dists_blob, seed)
