@@ -1,0 +1,127 @@
+"""Streaming scan execution (Driver-loop analog): scan→agg fragments run
+as a bounded chunk loop with carried accumulators; results must match the
+materializing interpreter bit-for-bit.
+
+Reference: ``operator/Driver.java:355-392`` (bounded pages through the
+pipeline); here the whole chunk pipeline is one compiled step program.
+"""
+
+import pytest
+
+from trino_tpu.testing import DistributedQueryRunner, LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner()
+
+
+@pytest.fixture(scope="module")
+def streaming():
+    r = DistributedQueryRunner()
+    # force tiny tables onto the streaming path with multiple small chunks
+    r.session.set("stream_scan_threshold_rows", 1000)
+    r.session.set("stream_chunk_rows", 4096)
+    return r
+
+
+def check(streaming, local, sql):
+    got, _ = streaming.execute(sql)
+    want, _ = local.execute(sql)
+    assert got == want, f"stream != local for {sql}\n{got[:4]}\n{want[:4]}"
+
+
+class TestStreamingAggregation:
+    def test_grouped_with_all_kinds(self, streaming, local):
+        check(
+            streaming,
+            local,
+            """select l_returnflag, l_linestatus, sum(l_quantity), count(*),
+                      avg(l_extendedprice), min(l_discount), max(l_tax)
+               from lineitem group by l_returnflag, l_linestatus
+               order by l_returnflag, l_linestatus""",
+        )
+
+    def test_global_agg(self, streaming, local):
+        check(
+            streaming,
+            local,
+            "select count(*), sum(l_quantity), min(l_shipdate),"
+            " max(l_shipdate) from lineitem",
+        )
+
+    def test_filtered_projection_q6(self, streaming, local):
+        check(
+            streaming,
+            local,
+            """select sum(l_extendedprice * l_discount) from lineitem
+               where l_shipdate >= date '1994-01-01'
+                 and l_shipdate < date '1995-01-01'
+                 and l_discount between decimal '0.05' and decimal '0.07'
+                 and l_quantity < 24""",
+        )
+
+    def test_partial_final_split_across_exchange(self, streaming, local):
+        # grouped agg whose partial side streams, final side combines
+        check(
+            streaming,
+            local,
+            """select o_orderpriority, count(*) from orders
+               where o_orderdate >= date '1993-07-01'
+               group by o_orderpriority order by o_orderpriority""",
+        )
+
+    def test_string_minmax_across_chunks(self, streaming, local):
+        check(
+            streaming,
+            local,
+            """select l_shipmode, min(l_shipinstruct), max(l_shipinstruct)
+               from lineitem group by l_shipmode order by l_shipmode""",
+        )
+
+    def test_capacity_overflow_retry(self, streaming, local):
+        """Per-shard distinct keys (~60175/8 ≈ 7.5k) exceed the initial
+        4096-group budget, so StreamOverflow MUST fire and the retry must
+        produce correct results with grown capacity."""
+        from trino_tpu.exec import streaming as S
+
+        fired = {"n": 0}
+        orig = S.StreamingAggregator.run
+
+        def counting_run(self):
+            try:
+                return orig(self)
+            except S.StreamOverflow:
+                fired["n"] += 1
+                raise
+
+        S.StreamingAggregator.run = counting_run
+        streaming.session.set("stream_group_budget", 64)
+        try:
+            check(
+                streaming,
+                local,
+                "select o_custkey, count(*) from orders"
+                " group by o_custkey order by o_custkey limit 13",
+            )
+        finally:
+            streaming.session.set("stream_group_budget", 1 << 12)
+            S.StreamingAggregator.run = orig
+        assert fired["n"] >= 1, "overflow retry path never exercised"
+
+    def test_streaming_actually_engaged(self, streaming):
+        """The plan shape must stream (not fall back): watch the step
+        count via the chunk source."""
+        from trino_tpu.exec import streaming as S
+        from trino_tpu.planner import plan as P
+        from trino_tpu.planner.fragmenter import fragment_plan
+
+        plan = streaming.plan(
+            "select l_returnflag, sum(l_quantity) from lineitem"
+            " group by l_returnflag"
+        )
+        sub = fragment_plan(plan)
+        chains = [
+            S.streamable_chain(f.root) for f in sub.all_fragments()
+        ]
+        assert any(c is not None for c in chains)

@@ -64,6 +64,14 @@ class Session:
         # cluster worker tasks: 'fused' compiles the fragment onto the
         # worker's local devices; 'interpreter' forces the CPU fallback
         ("worker_execution", "fused"),
+        # streaming scans (Driver-loop analog): scan->agg fragments whose
+        # table exceeds the threshold run as a chunk loop with carried
+        # accumulators instead of materializing the table on device
+        ("stream_scan_threshold_rows", 1 << 22),
+        ("stream_chunk_rows", 1 << 20),
+        # initial per-shard group budget for streamed aggregation (grows
+        # on overflow)
+        ("stream_group_budget", 1 << 12),
         # distributed mode: compile each plan fragment into one SPMD
         # program (exec/fragments.py); off -> materialized interpreter
         ("fragment_execution", True),

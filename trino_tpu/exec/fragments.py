@@ -165,6 +165,19 @@ class _Meta:
     overflow_names: Optional[list[str]] = None
     output_names: Optional[list[str]] = None
 
+    def capture(self, res: Result, tracer) -> None:
+        self.layout = dict(res.layout)
+        self.column_meta = [
+            (c.type, c.dictionary) for c in res.batch.columns
+        ]
+        self.overflow_names = [nm for nm, _ in tracer.overflows]
+        self._tracer = tracer
+
+    def outputs(self, res: Result):
+        flags = tuple(f for _, f in self._tracer.overflows)
+        data = tuple((c.data, c.valid) for c in res.batch.columns)
+        return data, res.batch.selection_mask(), flags
+
 
 class FragmentedExecutor(DistributedExecutor):
     """Distributed executor that compiles each fragment into one program."""
@@ -221,6 +234,9 @@ class FragmentedExecutor(DistributedExecutor):
         results: dict[int, Result],
         names_holder: dict[int, list[str]],
     ) -> Result:
+        streamed = self._try_streaming(frag, names_holder)
+        if streamed is not None:
+            return streamed
         inputs: dict[str, Batch] = {}
         input_layouts: dict[str, dict[str, int]] = {}
         spill_threshold = (
@@ -245,6 +261,106 @@ class FragmentedExecutor(DistributedExecutor):
                 names_holder[frag.id] = list(n.column_names)
         return self.run_fragment_program(frag, inputs, input_layouts)
 
+    def _try_streaming(
+        self, frag: PlanFragment, names_holder: dict[int, list[str]]
+    ) -> Optional[Result]:
+        """Scan→agg fragments over large tables run as a bounded chunk
+        loop (exec/streaming.py) instead of materializing the table."""
+        from trino_tpu.exec.streaming import (
+            StreamingAggregator,
+            StreamOverflow,
+            streamable_chain,
+        )
+
+        chain = streamable_chain(frag.root)
+        if chain is None:
+            return None
+        agg, scan = chain
+        connector = self.catalogs.get(scan.catalog)
+        est = connector.estimate_rows(scan.schema, scan.table)
+        if est is None or est <= int(
+            self.session.get("stream_scan_threshold_rows")
+        ):
+            return None
+        caps = _Caps()
+        attempts = 0
+        while True:
+            attempts += 1
+            if attempts > 12:
+                raise ExecutionError("streaming capacity retry limit exceeded")
+            try:
+                res = StreamingAggregator(self, frag, agg, scan, caps).run()
+                break
+            except StreamOverflow as e:
+                for nm in e.names:
+                    caps.grow(nm, 4)
+        if isinstance(frag.root, P.Output):
+            names_holder[frag.id] = list(frag.root.column_names)
+            cols = [res.column(s) for s in frag.root.symbols]
+            res = Result(
+                Batch(cols, res.batch.capacity, res.batch.sel),
+                {s.name: i for i, s in enumerate(frag.root.symbols)},
+            )
+        if frag.output_exchange in (None, "single"):
+            return res
+        # apply the fragment's output exchange as its own small program
+
+        def build_post(meta: _Meta):
+            def post(batch):
+                tracer = _FragmentTracer(self, {}, {}, caps)
+                out = tracer.apply_output_exchange(
+                    frag, Result(batch, res.layout)
+                )
+                meta.capture(out, tracer)
+                return meta.outputs(out)
+
+            return post
+
+        return self._retry_traced(caps, build_post, (res.batch,))
+
+    def _retry_traced(
+        self,
+        caps: "_Caps",
+        build_fn,
+        args: tuple,
+        stats_sink: Optional[dict] = None,
+        input_rows: int = 0,
+    ) -> Result:
+        """Run a traced program under the capacity-overflow retry protocol
+        and materialize its Result. ``build_fn(meta)`` returns the function
+        to jit; it must call ``meta.capture`` and return ``meta.outputs``.
+        """
+        import time as _time
+
+        attempts = 0
+        while True:
+            attempts += 1
+            if attempts > 12:
+                raise ExecutionError("capacity retry limit exceeded")
+            meta = _Meta()
+            t0 = _time.perf_counter()
+            data, sel, flags = jax.jit(build_fn(meta))(*args)
+            flags_np = [bool(np.asarray(f)) for f in flags]
+            if stats_sink is not None:
+                jax.block_until_ready(sel)
+                stats_sink.setdefault("attempts", 0)
+                stats_sink["attempts"] += 1
+                stats_sink["last_wall_s"] = _time.perf_counter() - t0
+                stats_sink["input_rows"] = input_rows
+            if not any(flags_np):
+                break
+            for nm, f in zip(meta.overflow_names, flags_np):
+                if f:
+                    caps.grow(nm, 4 if nm.startswith("agg") else 2)
+        cols = [
+            Column(t, d, v, dictionary)
+            for (d, v), (t, dictionary) in zip(data, meta.column_meta)
+        ]
+        # zero-column fragments (count(*) over pruned scans) still carry
+        # row liveness in sel
+        cap = cols[0].data.shape[0] if cols else int(sel.shape[0])
+        return Result(Batch(cols, cap, sel), meta.layout)
+
     def run_fragment_program(
         self,
         frag: PlanFragment,
@@ -261,57 +377,26 @@ class FragmentedExecutor(DistributedExecutor):
         (worker tasks) partition on the host instead. ``stats_sink``
         receives per-fragment compile/run timings when provided.
         """
-        import time as _time
-
         caps = _Caps()
-        attempts = 0
-        while True:
-            attempts += 1
-            if attempts > 12:
-                raise ExecutionError("fragment capacity retry limit exceeded")
-            meta = _Meta()
 
+        def build(meta: _Meta):
             def fn(inp: dict[str, Batch]):
                 tracer = _FragmentTracer(self, inp, input_layouts, caps)
                 res = tracer._exec(frag.root)
                 if apply_exchange:
                     res = tracer.apply_output_exchange(frag, res)
-                meta.layout = dict(res.layout)
-                meta.column_meta = [
-                    (c.type, c.dictionary) for c in res.batch.columns
-                ]
-                meta.overflow_names = [nm for nm, _ in tracer.overflows]
-                flags = tuple(f for _, f in tracer.overflows)
-                data = tuple(
-                    (c.data, c.valid) for c in res.batch.columns
-                )
-                return data, res.batch.selection_mask(), flags
+                meta.capture(res, tracer)
+                return meta.outputs(res)
 
-            t0 = _time.perf_counter()
-            jitted = jax.jit(fn)
-            data, sel, flags = jitted(inputs)
-            flags_np = [bool(np.asarray(f)) for f in flags]
-            if stats_sink is not None:
-                jax.block_until_ready(sel)
-                stats_sink.setdefault("attempts", 0)
-                stats_sink["attempts"] += 1
-                stats_sink["last_wall_s"] = _time.perf_counter() - t0
-                stats_sink["input_rows"] = sum(
-                    b.capacity for b in inputs.values()
-                )
-            if not any(flags_np):
-                break
-            for nm, f in zip(meta.overflow_names, flags_np):
-                if f:
-                    caps.grow(nm, 4 if nm.startswith("agg") else 2)
-        cols = [
-            Column(t, d, v, dictionary)
-            for (d, v), (t, dictionary) in zip(data, meta.column_meta)
-        ]
-        # zero-column fragments (count(*) over pruned scans) still carry
-        # row liveness in sel
-        cap = cols[0].data.shape[0] if cols else int(sel.shape[0])
-        return Result(Batch(cols, cap, sel), meta.layout)
+            return fn
+
+        return self._retry_traced(
+            caps,
+            build,
+            (inputs,),
+            stats_sink=stats_sink,
+            input_rows=sum(b.capacity for b in inputs.values()),
+        )
 
 
 class _FragmentTracer(DistributedExecutor):

@@ -1,0 +1,647 @@
+"""Streaming scan execution: bounded device-resident chunks through one
+compiled step program, with H2D transfer overlapping compute.
+
+Reference: Trino drives scans through the operator pipeline in bounded
+pages (``operator/Driver.java:355-392``,
+``ScanFilterAndProjectOperator.java:64``) so working memory stays bounded
+regardless of table size. The TPU translation: a scan→filter→project→
+aggregate fragment becomes ONE jitted *step* function with carried
+accumulator state
+
+    state' = step(state, chunk)
+
+executed in a host loop over split chunks. Chunk shapes are fixed
+(padded), so the step compiles once; JAX dispatch is asynchronous, so the
+host reads and transfers chunk k+1 while the device reduces chunk k
+(double buffering without explicit streams). Overflow flags are carried
+IN the state and inspected once at the end — no host sync per step; on
+overflow the caller grows capacities and restarts the stream.
+
+Wide-DECIMAL sums stream too: chunk partials produce per-group limb sums
+(ops/decimal128), and limb lanes are independent int64 accumulators, so
+the cross-chunk merge just sums each lane (carry resolution happens once,
+at finalize)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as PS
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column, bucket_capacity
+from trino_tpu.exec.local import Result
+from trino_tpu.ops.aggregation import AggSpec, global_aggregate, group_aggregate
+from trino_tpu.parallel.mesh import AXIS, shard_batch, smap
+from trino_tpu.planner import plan as P
+
+
+class StreamOverflow(Exception):
+    """A capacity overflowed mid-stream; retry with grown caps."""
+
+    def __init__(self, names):
+        super().__init__(f"stream capacity overflow: {names}")
+        self.names = names
+
+
+def streamable_chain(frag_root: P.PlanNode):
+    """If the fragment is Output?→Aggregate→(Filter|Project)*→TableScan,
+    return (agg_node, scan_node); else None."""
+    node = frag_root
+    if isinstance(node, P.Output):
+        node = node.source
+    if not isinstance(node, P.Aggregate):
+        return None
+    agg = node
+    if agg.step == "final":
+        return None
+    if any(fn.distinct for _, fn in agg.aggregates):
+        return None
+    for _, fn in agg.aggregates:
+        if fn.kind not in ("sum", "count", "count_star", "min", "max", "avg"):
+            return None
+    node = agg.source
+    while isinstance(node, (P.Filter, P.Project)):
+        node = node.source
+    if not isinstance(node, P.TableScan):
+        return None
+    return agg, node
+
+
+class StreamingAggregator:
+    """Runs one streamable fragment as a chunk loop with carried state."""
+
+    def __init__(self, executor, frag, agg_node, scan_node, caps):
+        self.executor = executor
+        self.mesh = executor.mesh
+        self.n = self.mesh.devices.size
+        self.frag = frag
+        self.agg = agg_node
+        self.scan = scan_node
+        self.caps = caps
+        self.nkeys = len(agg_node.group_keys)
+        self.G = caps.get(
+            f"agg{id(agg_node)}",
+            int(executor.session.get("stream_group_budget")),
+        )
+
+    # === chunk source ====================================================
+
+    def _chunks(self, chunk_rows: int):
+        """Yield lists of n host part-batches, each padded to a fixed
+        per-shard capacity (decided from the first split)."""
+        connector = self.executor.catalogs.get(self.scan.catalog)
+        est = connector.estimate_rows(self.scan.schema, self.scan.table)
+        target = max(self.n, (est + chunk_rows - 1) // chunk_rows)
+        splits = connector.get_splits(
+            self.scan.schema,
+            self.scan.table,
+            target_splits=target,
+            constraint=self.scan.constraint,
+        )
+        if not splits:
+            return
+        cap: Optional[int] = None
+        proto: Optional[Batch] = None
+        pending: list[Batch] = []
+        for s in splits:
+            b = connector.read_split(
+                self.scan.schema, self.scan.table, self.scan.column_names, s
+            )
+            if cap is None:
+                cap = bucket_capacity(max(1, min(b.num_rows, chunk_rows)))
+                proto = b
+            lo = 0
+            while True:
+                hi = min(lo + cap, b.num_rows)
+                piece = _slice_rows(b, lo, hi) if b.num_rows else b
+                pending.append(piece)
+                if len(pending) == self.n:
+                    yield pending, cap
+                    pending = []
+                lo = hi
+                if lo >= b.num_rows:
+                    break
+        if pending:
+            while len(pending) < self.n:
+                pending.append(_empty_like(proto))
+            yield pending, cap
+
+    # === driver loop =====================================================
+
+    def run(self) -> Result:
+        chunk_rows = int(self.executor.session.get("stream_chunk_rows"))
+        it = self._chunks(chunk_rows)
+        first = next(it, None)
+        if first is None:
+            from trino_tpu.exec.fragments import FusedUnsupported
+
+            raise FusedUnsupported("streaming scan with zero splits")
+        parts, cap = first
+        chunk = _pad_batch(self.mesh, parts, cap)
+        meta = self._collect_meta(chunk)
+        state = self._init_state(meta)
+        step = jax.jit(self._make_step(meta), donate_argnums=(0,))
+        state = step(state, chunk)
+        for parts, cap in it:
+            chunk = _pad_batch(self.mesh, parts, cap)
+            state = step(state, chunk)
+        if bool(np.asarray(state["overflow"]).max()):
+            # the only registered capacity is the group budget
+            raise StreamOverflow([f"agg{id(self.agg)}"])
+        return self._finish(state, meta)
+
+    # === metadata (eager pass over the first chunk) ======================
+
+    def _tracer_for(self, chunk: Batch):
+        from trino_tpu.exec.fragments import _FragmentTracer
+
+        return _FragmentTracer(
+            self.executor,
+            {f"scan{id(self.scan)}": chunk},
+            {
+                f"scan{id(self.scan)}": {
+                    s.name: i for i, s in enumerate(self.scan.symbols)
+                }
+            },
+            self.caps,
+        )
+
+    def _chunk_prep(self, tracer):
+        res = tracer._exec(self.agg.source)
+        sel = res.batch.selection_mask()
+        agg_inputs, specs, string_dicts = tracer._agg_inputs(self.agg, res)
+        keys = [res.pair(k) for k in self.agg.group_keys]
+        key_dicts = [res.column(k).dictionary for k in self.agg.group_keys]
+        return agg_inputs, specs, string_dicts, keys, key_dicts, sel
+
+    def _collect_meta(self, chunk: Batch) -> dict:
+        """Static metadata (specs/widths/dicts) via abstract evaluation —
+        no device compute; the first chunk is only executed by the step."""
+        box = {}
+
+        def probe(ch):
+            tracer = self._tracer_for(ch)
+            agg_inputs, specs, string_dicts, keys, key_dicts, sel = (
+                self._chunk_prep(tracer)
+            )
+            box["specs"] = specs
+            box["string_dicts"] = string_dicts
+            box["key_dicts"] = key_dicts
+            box["key_dtypes"] = [kd.dtype for kd, _ in keys]
+            return sel
+
+        jax.eval_shape(probe, chunk)
+        specs = box["specs"]
+        string_dicts = box["string_dicts"]
+        key_dicts = box["key_dicts"]
+        widths = []
+        for spec in specs:
+            if spec.kind == "sum128":
+                widths.append(3)
+            elif spec.kind == "sum128w":
+                widths.append(5)
+            else:
+                widths.append(1)
+        combine = []
+        for spec in specs:
+            if spec.kind in ("min", "max"):
+                combine.append(spec.kind)
+            else:
+                combine.append("sum")  # counts and (limb) sums add
+        return {
+            "specs": specs,
+            "combine": combine,
+            "widths": widths,
+            "string_dicts": string_dicts,
+            "key_dicts": key_dicts,
+            "key_dtypes": box["key_dtypes"],
+        }
+
+    def _init_state(self, meta: dict) -> dict:
+        rows = self.n * self.G if self.nkeys else self.n
+        sh = NamedSharding(self.mesh, PS(AXIS))
+
+        def zeros(shape, dt):
+            return jax.device_put(jnp.zeros(shape, dtype=dt), sh)
+
+        state: dict = {"overflow": jnp.zeros((), dtype=jnp.int32)}
+        if self.nkeys:
+            state["key_data"] = [
+                zeros((rows,), dt) for dt in meta["key_dtypes"]
+            ]
+            state["key_valid"] = [
+                zeros((rows,), jnp.bool_) for _ in range(self.nkeys)
+            ]
+            state["live"] = zeros((rows,), jnp.bool_)
+        state["values"] = [
+            zeros((rows,) if w == 1 else (rows, w), jnp.int64)
+            for w in meta["widths"]
+        ]
+        state["counts"] = [zeros((rows,), jnp.int64) for _ in meta["specs"]]
+        return state
+
+    # === the compiled step ==============================================
+
+    def _make_step(self, meta: dict):
+        specs = meta["specs"]
+        combine = meta["combine"]
+        widths = meta["widths"]
+        nkeys, G, n = self.nkeys, self.G, self.n
+        nspec = len(specs)
+        sagg = self
+
+        def step(state, chunk: Batch):
+            tracer = sagg._tracer_for(chunk)
+            agg_inputs, _specs, _sd, keys, _kd, sel = sagg._chunk_prep(tracer)
+            if nkeys == 0:
+                return sagg._step_global(
+                    state, sel, agg_inputs, specs, combine, widths
+                )
+            return sagg._step_grouped(
+                state, keys, sel, agg_inputs, specs, combine, widths
+            )
+
+        return step
+
+    def _step_grouped(self, state, keys, sel, agg_inputs, specs, combine, widths):
+        nkeys, G, n = self.nkeys, self.G, self.n
+        nspec = len(specs)
+        Gc = G  # chunk groups bounded by the same budget
+
+        flat = []
+        for kd, kv in keys:
+            flat.extend([kd, kv])
+        flat.append(sel)
+        has_input = [p is not None for p in agg_inputs]
+        for p in agg_inputs:
+            if p is not None:
+                flat.extend([p[0], p[1]])
+        flat.extend(state["key_data"])
+        flat.extend(state["key_valid"])
+        flat.append(state["live"])
+        flat.extend(state["values"])
+        flat.extend(state["counts"])
+
+        def shard_step(*ops):
+            i = 0
+            lkeys = []
+            for _ in range(nkeys):
+                lkeys.append((ops[i], ops[i + 1]))
+                i += 2
+            lsel = ops[i]; i += 1
+            linputs = []
+            for h in has_input:
+                if h:
+                    linputs.append((ops[i], ops[i + 1]))
+                    i += 2
+                else:
+                    linputs.append(None)
+            skd = list(ops[i : i + nkeys]); i += nkeys
+            skv = list(ops[i : i + nkeys]); i += nkeys
+            slive = ops[i]; i += 1
+            svals = list(ops[i : i + nspec]); i += nspec
+            scnts = list(ops[i : i + nspec]); i += nspec
+
+            # 1) chunk partial: raw rows -> chunk groups
+            (ckd, ckv), craw, cng, covf = group_aggregate(
+                lkeys, lsel, linputs, specs, Gc
+            )
+            clive = jnp.arange(Gc) < cng
+            cvals, ccnts = [], []
+            for spec, r in zip(specs, craw):
+                if spec.kind in ("count", "count_star"):
+                    v = r.astype(jnp.int64)
+                    cvals.append(v)
+                    ccnts.append(v)
+                else:
+                    cvals.append(r[0])
+                    ccnts.append(r[1].astype(jnp.int64))
+
+            # 2) merge state + chunk groups (lane-expanded for limb sums)
+            mkeys = [
+                (
+                    jnp.concatenate([skd[k], ckd[k].astype(skd[k].dtype)]),
+                    jnp.concatenate([skv[k], ckv[k]]),
+                )
+                for k in range(nkeys)
+            ]
+            msel = jnp.concatenate([slive, clive])
+            ones = jnp.ones_like(msel)
+            minputs, mspecs, mplan = [], [], []
+            for j in range(nspec):
+                sv, cv = svals[j], cvals[j]
+                sc, cc = scnts[j], ccnts[j]
+                if widths[j] == 1:
+                    mv = jnp.concatenate([sv, cv.astype(jnp.int64)])
+                    if combine[j] in ("min", "max"):
+                        valid = jnp.concatenate([sc > 0, cc > 0])
+                    else:
+                        valid = ones
+                    minputs.append((mv, valid))
+                    mspecs.append(AggSpec(combine[j]))
+                    mplan.append(("v", j, 0))
+                else:
+                    for lane in range(widths[j]):
+                        mv = jnp.concatenate([sv[:, lane], cv[:, lane]])
+                        minputs.append((mv, ones))
+                        mspecs.append(AggSpec("sum"))
+                        mplan.append(("v", j, lane))
+                minputs.append((jnp.concatenate([sc, cc]), ones))
+                mspecs.append(AggSpec("sum"))
+                mplan.append(("c", j, 0))
+            (nkd, nkv), nraw, nng, novf = group_aggregate(
+                mkeys, msel, minputs, mspecs, G
+            )
+            nlive = jnp.arange(G) < nng
+            nvals = [None] * nspec
+            ncnts = [None] * nspec
+            lanes: dict[int, list] = {}
+            for (kind, j, lane), r in zip(mplan, nraw):
+                val = r[0]
+                if kind == "c":
+                    ncnts[j] = val.astype(jnp.int64)
+                elif widths[j] == 1:
+                    nvals[j] = val
+                else:
+                    lanes.setdefault(j, [None] * widths[j])[lane] = val
+            for j, ln in lanes.items():
+                nvals[j] = jnp.stack(ln, axis=1)
+            ovf = jax.lax.pmax((covf | novf).astype(jnp.int32), AXIS)
+            return (
+                tuple(nkd), tuple(nkv), nlive,
+                tuple(nvals), tuple(ncnts), ovf,
+            )
+
+        out_specs = (
+            tuple(PS(AXIS) for _ in range(nkeys)),
+            tuple(PS(AXIS) for _ in range(nkeys)),
+            PS(AXIS),
+            tuple(PS(AXIS) for _ in range(nspec)),
+            tuple(PS(AXIS) for _ in range(nspec)),
+            PS(),
+        )
+        mapped = smap(
+            shard_step,
+            mesh=self.mesh,
+            in_specs=(PS(AXIS),) * len(flat),
+            out_specs=out_specs,
+        )
+        nkd, nkv, nlive, nvals, ncnts, ovf = mapped(*flat)
+        return {
+            "key_data": list(nkd),
+            "key_valid": list(nkv),
+            "live": nlive,
+            "values": list(nvals),
+            "counts": list(ncnts),
+            "overflow": jnp.maximum(state["overflow"], ovf.astype(jnp.int32)),
+        }
+
+    def _step_global(self, state, sel, agg_inputs, specs, combine, widths):
+        nspec = len(specs)
+        flat = [sel]
+        has_input = [p is not None for p in agg_inputs]
+        for p in agg_inputs:
+            if p is not None:
+                flat.extend([p[0], p[1]])
+        flat.extend(state["values"])
+        flat.extend(state["counts"])
+
+        def shard_step(*ops):
+            lsel = ops[0]
+            i = 1
+            linputs = []
+            for h in has_input:
+                if h:
+                    linputs.append((ops[i], ops[i + 1]))
+                    i += 2
+                else:
+                    linputs.append(None)
+            svals = list(ops[i : i + nspec]); i += nspec
+            scnts = list(ops[i : i + nspec]); i += nspec
+            raw = global_aggregate(lsel, linputs, specs)
+            outs_v, outs_c = [], []
+            for j, (spec, r) in enumerate(zip(specs, raw)):
+                if spec.kind in ("count", "count_star"):
+                    cv = jnp.reshape(r.astype(jnp.int64), (1,))
+                    cc = cv
+                else:
+                    cv = r[0]
+                    cv = cv if getattr(cv, "ndim", 0) == 2 else jnp.reshape(cv, (1,))
+                    cc = jnp.reshape(r[1].astype(jnp.int64), (1,))
+                sv, sc = svals[j], scnts[j]
+                if combine[j] == "min":
+                    nv = jnp.where(
+                        sc == 0, cv, jnp.where(cc == 0, sv, jnp.minimum(sv, cv))
+                    )
+                elif combine[j] == "max":
+                    nv = jnp.where(
+                        sc == 0, cv, jnp.where(cc == 0, sv, jnp.maximum(sv, cv))
+                    )
+                else:
+                    nv = sv + jnp.reshape(cv, sv.shape)
+                outs_v.append(jnp.reshape(nv, sv.shape))
+                outs_c.append(sc + cc)
+            return tuple(outs_v), tuple(outs_c)
+
+        mapped = smap(
+            shard_step,
+            mesh=self.mesh,
+            in_specs=(PS(AXIS),) * len(flat),
+            out_specs=(
+                tuple(PS(AXIS) for _ in range(nspec)),
+                tuple(PS(AXIS) for _ in range(nspec)),
+            ),
+        )
+        nvals, ncnts = mapped(*flat)
+        return {
+            "values": list(nvals),
+            "counts": list(ncnts),
+            "overflow": state["overflow"],
+        }
+
+    # === result assembly =================================================
+
+    def _finish(self, state, meta) -> Result:
+        if self.agg.step == "partial":
+            return self._finish_partial(state, meta)
+        return self._finish_single(state, meta)
+
+    def _acc_value_column(self, vsym, spec, sdict, v, c):
+        """Accumulator wire representation (mirrors _agg_partial)."""
+        from trino_tpu.ops import decimal128 as D128
+
+        val = v
+        if getattr(val, "ndim", 1) == 2 and val.shape[1] in (3, 5):
+            hi, lo = D128.limb_sums_to_pair(val)
+            val = jnp.stack([hi, lo], axis=1)
+        elif sdict is not None:
+            order = np.argsort(sdict.ranks(), kind="stable")
+            if len(order):
+                val = jnp.asarray(order)[
+                    jnp.clip(val, 0, len(order) - 1)
+                ].astype(jnp.int32)
+            else:
+                val = jnp.full(val.shape, -1, dtype=jnp.int32)
+        return Column(vsym.type, val, None, sdict)
+
+    def _finish_partial(self, state, meta) -> Result:
+        agg = self.agg
+        cols: list[Column] = []
+        layout: dict[str, int] = {}
+        if self.nkeys:
+            for i, ksym in enumerate(agg.group_keys):
+                cols.append(
+                    Column(
+                        ksym.type,
+                        state["key_data"][i].astype(ksym.type.storage_dtype),
+                        state["key_valid"][i],
+                        meta["key_dicts"][i],
+                    )
+                )
+                layout[ksym.name] = len(cols) - 1
+            live = state["live"]
+            total = self.n * self.G
+        else:
+            live = jnp.ones(self.n, dtype=jnp.bool_)
+            total = self.n
+        for (vsym, csym), spec, sdict, v, c in zip(
+            agg.acc_symbols,
+            meta["specs"],
+            meta["string_dicts"],
+            state["values"],
+            state["counts"],
+        ):
+            if spec.kind in ("count", "count_star"):
+                cols.append(
+                    Column(T.BIGINT, v.reshape(-1).astype(jnp.int64), None)
+                )
+                layout[vsym.name] = len(cols) - 1
+                continue
+            cols.append(self._acc_value_column(vsym, spec, sdict, v, c))
+            layout[vsym.name] = len(cols) - 1
+            cols.append(Column(T.BIGINT, c.astype(jnp.int64), None))
+            layout[csym.name] = len(cols) - 1
+        return Result(Batch(cols, total, live), layout)
+
+    def _finish_single(self, state, meta) -> Result:
+        from trino_tpu.exec.fragments import _FragmentTracer
+
+        agg = self.agg
+        tracer = _FragmentTracer(self.executor, {}, {}, self.caps)
+        if self.nkeys:
+            results = []
+            for spec, v, c in zip(
+                meta["specs"], state["values"], state["counts"]
+            ):
+                if spec.kind in ("count", "count_star"):
+                    results.append(v.reshape(-1))
+                else:
+                    results.append((v, c))
+            total = self.n * self.G
+            cols = []
+            for i, ksym in enumerate(agg.group_keys):
+                cols.append(
+                    Column(
+                        ksym.type,
+                        state["key_data"][i].astype(ksym.type.storage_dtype),
+                        state["key_valid"][i],
+                        meta["key_dicts"][i],
+                    )
+                )
+            cols.extend(
+                tracer._finalize_traced(
+                    agg, results, meta["string_dicts"], total
+                )
+            )
+            layout = {s.name: i for i, s in enumerate(agg.output_symbols)}
+            return Result(Batch(cols, total, state["live"]), layout)
+        # global: fold the n per-shard accumulators on host (n rows)
+        results = []
+        for spec, v, c in zip(meta["specs"], state["values"], state["counts"]):
+            vn = np.asarray(v)
+            cn = np.asarray(c)
+            if spec.kind in ("count", "count_star"):
+                results.append(jnp.asarray([int(vn.sum())]))
+            elif spec.kind in ("min", "max"):
+                valid = cn > 0
+                if valid.any():
+                    vv = vn[valid]
+                    val = int(vv.min() if spec.kind == "min" else vv.max())
+                else:
+                    val = 0
+                results.append(
+                    (jnp.asarray([val]), jnp.asarray([int(cn.sum())]))
+                )
+            else:
+                ssum = vn.sum(axis=0)
+                ssum = ssum[None] if ssum.ndim else np.asarray([ssum])
+                results.append(
+                    (jnp.asarray(ssum), jnp.asarray([int(cn.sum())]))
+                )
+        cols = tracer._finalize_traced(agg, results, meta["string_dicts"], 1)
+        layout = {s.name: i for i, s in enumerate(agg.output_symbols)}
+        return Result(Batch(cols, 1, jnp.ones(1, dtype=jnp.bool_)), layout)
+
+
+# === host-side batch helpers ================================================
+
+
+def _slice_rows(b: Batch, lo: int, hi: int) -> Batch:
+    cols = []
+    for c in b.columns:
+        data, valid = c.to_numpy()
+        v = valid[lo:hi]
+        cols.append(
+            Column(c.type, data[lo:hi], None if v.all() else v, c.dictionary)
+        )
+    out = Batch(cols, hi - lo)
+    if b.sel is not None:
+        sel = np.asarray(b.sel)[lo:hi]
+        out = Batch(cols, hi - lo, sel)
+    return out
+
+
+def _empty_like(b: Batch) -> Batch:
+    cols = [
+        Column(
+            c.type,
+            np.zeros(
+                (0,) + np.asarray(c.data).shape[1:],
+                dtype=np.asarray(c.data).dtype,
+            ),
+            None,
+            c.dictionary,
+        )
+        for c in b.columns
+    ]
+    return Batch(cols, 0)
+
+
+def _pad_batch(mesh, parts: list[Batch], cap: int) -> Batch:
+    """shard_batch with every part padded to exactly ``cap`` rows so each
+    step shares one compiled shape."""
+    padded = []
+    for p in parts:
+        if p.capacity == cap and p.sel is None and p.num_rows == cap:
+            padded.append(p)
+            continue
+        cols = []
+        for c in p.columns:
+            data, valid = c.to_numpy()
+            pad = cap - data.shape[0]
+            if pad:
+                data = np.concatenate(
+                    [data, np.zeros((pad,) + data.shape[1:], dtype=data.dtype)]
+                )
+                valid = np.concatenate([valid, np.zeros(pad, dtype=np.bool_)])
+            cols.append(Column(c.type, data, valid, c.dictionary))
+        sel = np.zeros(cap, dtype=np.bool_)
+        sel[: p.num_rows] = True
+        if p.sel is not None:
+            sel[: p.capacity] &= np.asarray(p.sel)
+        padded.append(Batch(cols, cap, sel))
+    return shard_batch(mesh, padded)
