@@ -441,6 +441,241 @@ int64_t tt_parquet_rle_encode(const int32_t* values, int64_t n,
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
+// ORC integer decoders (hot path of lib/trino-orc's RunLengthIntegerReaderV2,
+// reimplemented from the public ORC spec).
+
+namespace orc_rle {
+
+static inline int fbw(int code) {
+    if (code <= 23) return code + 1;
+    static const int tail[] = {26, 28, 30, 32, 40, 48, 56, 64};
+    return tail[code - 24];
+}
+
+static inline int closest_fixed_bits(int n) {
+    if (n <= 24) return n < 1 ? 1 : n;
+    if (n <= 26) return 26;
+    if (n <= 28) return 28;
+    if (n <= 30) return 30;
+    if (n <= 32) return 32;
+    if (n <= 40) return 40;
+    if (n <= 48) return 48;
+    if (n <= 56) return 56;
+    return 64;
+}
+
+struct BitReader {
+    const uint8_t* buf;
+    int64_t pos;        // byte position
+    int64_t end;        // buffer length (reads past it set `bad`)
+    int bit = 0;        // bits consumed within current byte
+    bool bad = false;
+    uint64_t take(int width) {
+        uint64_t v = 0;
+        int need = width;
+        while (need > 0) {
+            if (pos >= end) { bad = true; return 0; }
+            int avail = 8 - bit;
+            int n = need < avail ? need : avail;
+            int shift = avail - n;
+            v = (v << n) | (uint64_t)((buf[pos] >> shift) & ((1u << n) - 1));
+            bit += n;
+            need -= n;
+            if (bit == 8) { bit = 0; pos++; }
+        }
+        return v;
+    }
+    void align() { if (bit) { bit = 0; pos++; } }
+};
+
+// Bounds- and shift-checked varint (mirrors tt_varint_decode's guards).
+static inline bool read_varint(const uint8_t* buf, int64_t* pos, int64_t end,
+                               uint64_t* out) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (true) {
+        if (*pos >= end || shift > 63) return false;
+        uint8_t b = buf[(*pos)++];
+        v |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) { *out = v; return true; }
+        shift += 7;
+    }
+}
+
+}  // namespace orc_rle
+
+extern "C" {
+
+// Decode `count` RLEv2 integers; returns bytes consumed or -1.
+int64_t tt_orc_rle2(const uint8_t* buf, int64_t buf_len, int64_t count,
+                    int32_t is_signed, int64_t* out) {
+    using namespace orc_rle;
+    int64_t pos = 0, filled = 0;
+    while (filled < count) {
+        if (pos >= buf_len) return -1;
+        uint8_t first = buf[pos];
+        int enc = first >> 6;
+        if (enc == 0) {  // SHORT_REPEAT
+            int width = ((first >> 3) & 0x7) + 1;
+            int repeat = (first & 0x7) + 3;
+            pos += 1;
+            if (pos + width > buf_len) return -1;
+            uint64_t u = 0;
+            for (int i = 0; i < width; i++) u = (u << 8) | buf[pos++];
+            int64_t val = is_signed ? unzigzag(u) : (int64_t)u;
+            for (int i = 0; i < repeat && filled < count; i++) out[filled++] = val;
+        } else if (enc == 1) {  // DIRECT
+            if (pos + 1 >= buf_len) return -1;
+            int width = fbw((first >> 1) & 0x1F);
+            int length = (((int)(first & 1) << 8) | buf[pos + 1]) + 1;
+            BitReader br{buf, pos + 2, buf_len};
+            for (int i = 0; i < length && filled < count; i++) {
+                uint64_t u = br.take(width);
+                out[filled++] = is_signed ? unzigzag(u) : (int64_t)u;
+            }
+            if (br.bad) return -1;
+            br.align();
+            pos = br.pos;
+            continue;
+        } else if (enc == 3) {  // DELTA
+            if (pos + 1 >= buf_len) return -1;
+            int wcode = (first >> 1) & 0x1F;
+            int width = wcode == 0 ? 0 : fbw(wcode);
+            int length = (((int)(first & 1) << 8) | buf[pos + 1]) + 1;
+            pos += 2;
+            uint64_t bu, du;
+            if (!read_varint(buf, &pos, buf_len, &bu)) return -1;
+            int64_t base = is_signed ? unzigzag(bu) : (int64_t)bu;
+            if (!read_varint(buf, &pos, buf_len, &du)) return -1;
+            int64_t d0 = unzigzag(du);
+            out[filled++] = base;
+            int64_t cur = base;
+            if (length > 1 && filled < count) {
+                cur += d0;
+                out[filled++] = cur;
+                if (width == 0) {
+                    for (int i = 2; i < length && filled < count; i++) {
+                        cur += d0;
+                        out[filled++] = cur;
+                    }
+                } else {
+                    int64_t sign = d0 >= 0 ? 1 : -1;
+                    BitReader br{buf, pos, buf_len};
+                    for (int i = 2; i < length && filled < count; i++) {
+                        cur += sign * (int64_t)br.take(width);
+                        out[filled++] = cur;
+                    }
+                    if (br.bad) return -1;
+                    br.align();
+                    pos = br.pos;
+                }
+            }
+            continue;
+        } else {  // PATCHED_BASE
+            if (pos + 3 >= buf_len) return -1;
+            int width = fbw((first >> 1) & 0x1F);
+            int length = (((int)(first & 1) << 8) | buf[pos + 1]) + 1;
+            uint8_t third = buf[pos + 2], fourth = buf[pos + 3];
+            int base_width = ((third >> 5) & 0x7) + 1;
+            int patch_width = fbw(third & 0x1F);
+            int gap_width = ((fourth >> 5) & 0x7) + 1;
+            int patch_count = fourth & 0x1F;
+            pos += 4;
+            if (pos + base_width > buf_len) return -1;
+            if (filled + length > count) return -1;  // run exceeds request
+            uint64_t braw = 0;
+            for (int i = 0; i < base_width; i++) braw = (braw << 8) | buf[pos++];
+            uint64_t msb = 1ULL << (base_width * 8 - 1);
+            int64_t base = (braw & msb) ? -(int64_t)(braw & ~msb) : (int64_t)braw;
+            BitReader br{buf, pos, buf_len};
+            int64_t start = filled;
+            for (int i = 0; i < length; i++) out[filled++] = (int64_t)br.take(width);
+            br.align();
+            int pbits = closest_fixed_bits(patch_width + gap_width);
+            int64_t idx = 0;
+            for (int i = 0; i < patch_count; i++) {
+                uint64_t p = br.take(pbits);
+                int64_t gap = (int64_t)(p >> patch_width);
+                uint64_t patch = p & ((patch_width == 64) ? ~0ULL
+                                     : ((1ULL << patch_width) - 1));
+                idx += gap;
+                if (start + idx >= filled) return -1;  // corrupt patch gap
+                out[start + idx] |= (int64_t)(patch << width);
+            }
+            if (br.bad) return -1;
+            br.align();
+            pos = br.pos;
+            for (int64_t i = start; i < filled; i++) out[i] += base;
+            continue;
+        }
+    }
+    return pos;
+}
+
+// Decode `count` RLEv1 integers; returns bytes consumed or -1.
+int64_t tt_orc_rle1(const uint8_t* buf, int64_t buf_len, int64_t count,
+                    int32_t is_signed, int64_t* out) {
+    using namespace orc_rle;
+    int64_t pos = 0, filled = 0;
+    while (filled < count) {
+        if (pos >= buf_len) return -1;
+        uint8_t ctrl = buf[pos++];
+        if (ctrl < 128) {
+            int run = ctrl + 3;
+            if (pos >= buf_len) return -1;
+            int8_t delta = (int8_t)buf[pos++];
+            uint64_t bu;
+            if (!read_varint(buf, &pos, buf_len, &bu)) return -1;
+            int64_t base = is_signed ? unzigzag(bu) : (int64_t)bu;
+            for (int i = 0; i < run && filled < count; i++)
+                out[filled++] = base + (int64_t)i * delta;
+        } else {
+            int lit = 256 - ctrl;
+            for (int i = 0; i < lit && filled < count; i++) {
+                uint64_t u;
+                if (!read_varint(buf, &pos, buf_len, &u)) return -1;
+                out[filled++] = is_signed ? unzigzag(u) : (int64_t)u;
+            }
+        }
+    }
+    return pos;
+}
+
+// Byte-RLE (present/boolean framing); returns bytes consumed or -1.
+int64_t tt_orc_byte_rle(const uint8_t* buf, int64_t buf_len, int64_t count,
+                        uint8_t* out) {
+    int64_t pos = 0, filled = 0;
+    while (filled < count) {
+        if (pos >= buf_len) return -1;
+        uint8_t ctrl = buf[pos++];
+        if (ctrl < 128) {
+            int run = ctrl + 3;
+            uint8_t v = buf[pos++];
+            for (int i = 0; i < run && filled < count; i++) out[filled++] = v;
+        } else {
+            int lit = 256 - ctrl;
+            for (int i = 0; i < lit && filled < count; i++) out[filled++] = buf[pos++];
+        }
+    }
+    return pos;
+}
+
+// Decimal DATA: `count` zigzag unbounded varints.
+int64_t tt_orc_decimal64(const uint8_t* buf, int64_t buf_len, int64_t count,
+                         int64_t* out) {
+    using namespace orc_rle;
+    int64_t pos = 0;
+    for (int64_t i = 0; i < count; i++) {
+        uint64_t u;
+        if (!read_varint(buf, &pos, buf_len, &u)) return -1;
+        out[i] = unzigzag(u);
+    }
+    return pos;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
 // TPC-H dbgen text pool: grammar-driven sentence stream from weighted word
 // distributions, drawn from one Lehmer stream (seed' = seed*16807 mod 2^31-1).
 // The distribution tables arrive serialized from Python so the word lists

@@ -28,7 +28,7 @@ class TestFileConnector:
         )
         runner.assert_query(
             "select count(*), min(o_orderkey), max(o_orderkey) from file.default.orders_copy",
-            [(15000, 1, 15000)],
+            [(15000, 1, 60000)],
         )
         base, _ = runner.execute(
             "select o_orderpriority, count(*), sum(o_totalprice) from tpch.tiny.orders group by 1"

@@ -1,0 +1,142 @@
+"""ORC connector: directory-of-files tables (hive-style layout), read
+path only.
+
+Reference: ``plugin/trino-hive`` selecting ``lib/trino-orc`` readers
+(``OrcReader.java:66,251``); splits are (file, stripe) pairs and stripe
+statistics drive TupleDomain split pruning
+(``TupleDomainOrcPredicate.java:74``). Layout:
+``<root>/<schema>/<table>/*.orc``; the table schema is read from the
+first file's footer.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch
+from trino_tpu.connectors.api import ColumnSchema, Connector, Split, TableSchema
+from trino_tpu.formats import orc as ORC
+
+
+class OrcConnector(Connector):
+    name = "orc"
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._file_cache: dict[tuple[str, float], ORC.OrcFile] = {}
+
+    # --- layout -----------------------------------------------------------
+
+    def _table_dir(self, schema: str, table: str) -> str:
+        return os.path.join(self.root, schema, table)
+
+    def _files(self, schema: str, table: str) -> list[str]:
+        d = self._table_dir(schema, table)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            os.path.join(d, f) for f in os.listdir(d) if f.endswith(".orc")
+        )
+
+    def _file(self, path: str) -> ORC.OrcFile:
+        mtime = os.path.getmtime(path)
+        key = (path, mtime)
+        f = self._file_cache.get(key)
+        if f is None:
+            with open(path, "rb") as fh:
+                f = ORC.OrcFile(fh.read())
+            self._file_cache[key] = f
+        return f
+
+    # --- metadata ---------------------------------------------------------
+
+    def list_schemas(self) -> list[str]:
+        if not os.path.isdir(self.root):
+            return []
+        return sorted(
+            d
+            for d in os.listdir(self.root)
+            if os.path.isdir(os.path.join(self.root, d))
+        )
+
+    def list_tables(self, schema: str) -> list[str]:
+        d = os.path.join(self.root, schema)
+        if not os.path.isdir(d):
+            return []
+        return sorted(
+            t for t in os.listdir(d) if os.path.isdir(os.path.join(d, t))
+        )
+
+    def get_table(self, schema: str, table: str) -> Optional[TableSchema]:
+        files = self._files(schema, table)
+        if not files:
+            return None
+        f = self._file(files[0])
+        cols = []
+        for name, type_id in zip(f.column_names, f.column_type_ids):
+            cols.append(ColumnSchema(name, f.types[type_id].sql_type()))
+        return TableSchema(table, tuple(cols))
+
+    # --- splits: one per (file, stripe) -----------------------------------
+
+    def get_splits(self, schema, table, target_splits, constraint=None):
+        pairs = []
+        for path in self._files(schema, table):
+            f = self._file(path)
+            for si in range(len(f.stripes)):
+                pairs.append((path, si))
+        splits = [
+            Split(table, i, len(pairs), info=pair)
+            for i, pair in enumerate(pairs)
+        ]
+        return self.prune_splits(schema, table, splits, constraint)
+
+    def split_stats(self, schema, table, split):
+        """Stripe column stats -> (min, max, has_null) per column name for
+        the split pruner (reference TupleDomainOrcPredicate)."""
+        path, si = split.info
+        f = self._file(path)
+        stats = f.stripe_stats(si)
+        if not stats:
+            return None
+        out = {}
+        for name, type_id in zip(f.column_names, f.column_type_ids):
+            s = stats.get(type_id)
+            if s is None or s.min_value is None:
+                continue
+            mn, mx = s.min_value, s.max_value
+            t = f.types[type_id]
+            if t.kind == ORC.KIND_DECIMAL and isinstance(mn, str):
+                scale = t.scale
+                mn = int(round(float(mn) * 10**scale))
+                mx = int(round(float(mx) * 10**scale))
+            out[name] = (mn, mx, s.has_null)
+        return out or None
+
+    def read_split(
+        self, schema, table, columns: Sequence[str], split
+    ) -> Batch:
+        path, si = split.info
+        f = self._file(path)
+        cols = f.read_stripe(f.stripes[si], set(columns))
+        out = [cols[c] for c in columns]
+        n = f.stripes[si].num_rows
+        return Batch(out, n)
+
+    def estimate_rows(self, schema, table) -> Optional[int]:
+        files = self._files(schema, table)
+        if not files:
+            return None
+        return sum(self._file(p).num_rows for p in files)
+
+    # --- writes: not supported (reader-only tier) -------------------------
+
+    def create_table(self, schema, table, schema_def) -> None:
+        raise NotImplementedError(
+            "the orc connector is read-only; CTAS via the parquet connector"
+        )

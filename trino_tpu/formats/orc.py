@@ -1,0 +1,682 @@
+"""From-scratch ORC reader feeding device-ready numpy columns.
+
+Reference: ``lib/trino-orc`` (``orc/OrcReader.java:66,251`` tail/footer
+parsing, ``OrcRecordReader.java:376`` stripe iteration,
+``TupleDomainOrcPredicate.java:74`` stats pruning) — reimplemented from
+the public ORC v1 specification, not translated: the hot decoders
+(RLEv1/RLEv2, bit-unpack, byte-RLE) vectorize into numpy and the column
+assembly produces the engine's null-mask/dictionary columnar layout
+directly.
+
+Format essentials (ORC spec):
+- file tail: ...stripes | metadata | footer | postscript | ps_length(1B)
+- protobuf messages throughout (hand-rolled tag/varint parser below)
+- every compressed region is framed in chunks with a 3-byte header
+  ``(length << 1) | is_original`` (little-endian)
+- integers use RLEv1 (runs + literals of varints) or RLEv2 (SHORT_REPEAT
+  / DIRECT / PATCHED_BASE / DELTA sub-encodings, bit-packed)
+- nulls ride PRESENT streams (bit-per-value, byte-RLE framed)
+- strings are DIRECT (bytes + lengths) or DICTIONARY (codes + dict)
+
+Verified against pyarrow's ORC writer in both directions
+(tests/test_orc.py): none/zlib/snappy compression, all engine scalar
+types, null patterns, multi-stripe files, and stripe-stats pruning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column, Dictionary
+
+# --- minimal protobuf ------------------------------------------------------
+
+
+def _varint(buf: bytes, pos: int) -> tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _proto(buf: bytes) -> dict[int, list]:
+    """Parse one protobuf message into {field: [values]}; length-delimited
+    values stay bytes, varints stay ints."""
+    out: dict[int, list] = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, pos = _varint(buf, pos)
+        elif wire == 1:
+            v = buf[pos : pos + 8]
+            pos += 8
+        elif wire == 2:
+            ln, pos = _varint(buf, pos)
+            v = buf[pos : pos + ln]
+            pos += ln
+        elif wire == 5:
+            v = buf[pos : pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+def _first(msg: dict, field: int, default=None):
+    vals = msg.get(field)
+    return vals[0] if vals else default
+
+
+def _uints(msg: dict, field: int) -> list[int]:
+    """Repeated uint field: entries may be plain varints or PACKED bytes."""
+    out: list[int] = []
+    for v in msg.get(field, []):
+        if isinstance(v, int):
+            out.append(v)
+        else:
+            pos = 0
+            while pos < len(v):
+                u, pos = _varint(v, pos)
+                out.append(u)
+    return out
+
+
+def _zigzag(u: np.ndarray) -> np.ndarray:
+    return (u >> 1) ^ -(u & 1)
+
+
+# --- compression framing ---------------------------------------------------
+
+COMPRESSION_NONE = 0
+COMPRESSION_ZLIB = 1
+COMPRESSION_SNAPPY = 2
+COMPRESSION_ZSTD = 5
+
+
+def _decompress(data: bytes, kind: int) -> bytes:
+    if kind == COMPRESSION_NONE:
+        return data
+    out = bytearray()
+    pos = 0
+    while pos + 3 <= len(data):
+        header = data[pos] | (data[pos + 1] << 8) | (data[pos + 2] << 16)
+        pos += 3
+        length = header >> 1
+        original = header & 1
+        chunk = data[pos : pos + length]
+        pos += length
+        if original:
+            out.extend(chunk)
+        elif kind == COMPRESSION_ZLIB:
+            out.extend(zlib.decompress(chunk, -15))  # raw deflate
+        elif kind == COMPRESSION_SNAPPY:
+            from trino_tpu.native import snappy_decompress
+
+            # snappy block: leading varint = uncompressed length
+            ulen, p = _varint(chunk, 0)
+            out.extend(snappy_decompress(chunk, ulen))
+        elif kind == COMPRESSION_ZSTD:
+            raise ValueError("zstd-compressed ORC is not supported")
+        else:
+            raise ValueError(f"unknown ORC compression kind {kind}")
+    return bytes(out)
+
+
+# --- integer decoders ------------------------------------------------------
+
+
+def _read_varints(buf: bytes, count: int, pos: int = 0):
+    out = np.empty(count, dtype=np.uint64)
+    for i in range(count):
+        v, pos = _varint(buf, pos)
+        out[i] = v & 0xFFFFFFFFFFFFFFFF
+    return out, pos
+
+
+def _rle_v1(buf: bytes, count: int, signed: bool) -> np.ndarray:
+    from trino_tpu import native
+
+    fast = native.orc_rle1(buf, count, signed)
+    if fast is not None:
+        return fast
+    out = np.empty(count, dtype=np.int64)
+    pos = 0
+    filled = 0
+    while filled < count:
+        ctrl = buf[pos]
+        pos += 1
+        if ctrl < 128:  # run
+            run = ctrl + 3
+            delta = buf[pos]
+            delta = delta - 256 if delta >= 128 else delta
+            pos += 1
+            base, pos = _varint(buf, pos)
+            base = int(_zigzag(np.int64(base))) if signed else base
+            out[filled : filled + run] = base + delta * np.arange(run)
+            filled += run
+        else:  # literals
+            lit = 256 - ctrl
+            vals, pos = _read_varints(buf, lit, pos)
+            v = vals.astype(np.int64)
+            if signed:
+                v = _zigzag(v)
+            out[filled : filled + lit] = v
+            filled += lit
+    return out
+
+
+_RLE2_WIDTHS = [
+    1, 2, 4, 8, 16, 24, 32, 40, 48, 56, 64,
+    72, 80, 88, 96, 104, 112, 120, 128,
+]  # 5-bit code -> bits (codes 0..4 are 1,2,4,8,16? spec: deprecated map)
+
+
+def _fbw(code: int) -> int:
+    """Decode the 5-bit "fixed bit width" code (ORC spec table)."""
+    if code <= 23:
+        return code + 1
+    return {24: 26, 25: 28, 26: 30, 27: 32, 28: 40, 29: 48, 30: 56, 31: 64}[code]
+
+
+_FIXED_WIDTHS = list(range(1, 25)) + [26, 28, 30, 32, 40, 48, 56, 64]
+
+
+def _closest_fixed_bits(n: int) -> int:
+    """Round up to the nearest encodable width (patch entries pack at
+    closestFixedBits(gapWidth + patchWidth))."""
+    for w in _FIXED_WIDTHS:
+        if w >= n:
+            return w
+    return 64
+
+
+def _unpack_bits(buf: bytes, count: int, width: int, pos: int):
+    """Big-endian bit-unpack `count` values of `width` bits."""
+    nbits = count * width
+    nbytes = (nbits + 7) // 8
+    raw = np.frombuffer(buf, dtype=np.uint8, count=nbytes, offset=pos)
+    bits = np.unpackbits(raw)[: count * width].reshape(count, width)
+    weights = (1 << np.arange(width - 1, -1, -1, dtype=np.uint64))
+    vals = (bits.astype(np.uint64) * weights).sum(axis=1)
+    return vals, pos + nbytes
+
+
+def _rle_v2(buf: bytes, count: int, signed: bool) -> np.ndarray:
+    from trino_tpu import native
+
+    fast = native.orc_rle2(buf, count, signed)
+    if fast is not None:
+        return fast
+    out = np.empty(count, dtype=np.int64)
+    pos = 0
+    filled = 0
+    while filled < count:
+        first = buf[pos]
+        enc = first >> 6
+        if enc == 0:  # SHORT_REPEAT
+            width = ((first >> 3) & 0x7) + 1
+            repeat = (first & 0x7) + 3
+            pos += 1
+            val = int.from_bytes(buf[pos : pos + width], "big")
+            pos += width
+            if signed:
+                val = int(_zigzag(np.int64(val)))
+            out[filled : filled + repeat] = val
+            filled += repeat
+        elif enc == 1:  # DIRECT
+            width = _fbw((first >> 1) & 0x1F)
+            length = ((first & 1) << 8 | buf[pos + 1]) + 1
+            pos += 2
+            vals, pos = _unpack_bits(buf, length, width, pos)
+            v = vals.astype(np.int64)
+            if signed:
+                v = _zigzag(v)
+            out[filled : filled + length] = v
+            filled += length
+        elif enc == 3:  # DELTA
+            width_code = (first >> 1) & 0x1F
+            width = 0 if width_code == 0 else _fbw(width_code)
+            length = ((first & 1) << 8 | buf[pos + 1]) + 1
+            pos += 2
+            base, pos = _varint(buf, pos)
+            base = int(_zigzag(np.int64(base))) if signed else base
+            delta0, pos = _varint(buf, pos)
+            delta0 = int(_zigzag(np.int64(delta0)))
+            seq = np.empty(length, dtype=np.int64)
+            seq[0] = base
+            if length > 1:
+                if width == 0:
+                    deltas = np.full(length - 1, delta0, dtype=np.int64)
+                else:
+                    rest, pos = _unpack_bits(buf, length - 2, width, pos)
+                    deltas = np.empty(length - 1, dtype=np.int64)
+                    deltas[0] = delta0
+                    sign = 1 if delta0 >= 0 else -1
+                    deltas[1:] = sign * rest.astype(np.int64)
+                seq[1:] = base + np.cumsum(deltas)
+            out[filled : filled + length] = seq
+            filled += length
+        else:  # PATCHED_BASE
+            width = _fbw((first >> 1) & 0x1F)
+            length = ((first & 1) << 8 | buf[pos + 1]) + 1
+            third, fourth = buf[pos + 2], buf[pos + 3]
+            base_width = ((third >> 5) & 0x7) + 1
+            patch_width = _fbw(third & 0x1F)
+            patch_gap_width = ((fourth >> 5) & 0x7) + 1
+            patch_count = fourth & 0x1F
+            pos += 4
+            base_raw = int.from_bytes(buf[pos : pos + base_width], "big")
+            pos += base_width
+            msb = 1 << (base_width * 8 - 1)
+            base = -(base_raw & ~msb) if base_raw & msb else base_raw
+            vals, pos = _unpack_bits(buf, length, width, pos)
+            patch_bits = _closest_fixed_bits(patch_width + patch_gap_width)
+            patches, pos = _unpack_bits(buf, patch_count, patch_bits, pos)
+            vals = vals.astype(np.int64)
+            idx = 0
+            for p in patches:
+                gap = int(p) >> patch_width
+                patch = int(p) & ((1 << patch_width) - 1)
+                idx += gap
+                vals[idx] |= patch << width
+            out[filled : filled + length] = base + vals
+            filled += length
+    return out
+
+
+def _byte_rle(buf: bytes, count: int) -> np.ndarray:
+    from trino_tpu import native
+
+    fast = native.orc_byte_rle(buf, count)
+    if fast is not None:
+        return fast
+    out = np.empty(count, dtype=np.uint8)
+    pos = 0
+    filled = 0
+    while filled < count:
+        ctrl = buf[pos]
+        pos += 1
+        if ctrl < 128:
+            run = ctrl + 3
+            out[filled : filled + run] = buf[pos]
+            pos += 1
+            filled += run
+        else:
+            lit = 256 - ctrl
+            out[filled : filled + lit] = np.frombuffer(
+                buf, dtype=np.uint8, count=lit, offset=pos
+            )
+            pos += lit
+            filled += lit
+    return out
+
+
+def _bool_rle(buf: bytes, count: int) -> np.ndarray:
+    nbytes = (count + 7) // 8
+    b = _byte_rle(buf, nbytes)
+    return np.unpackbits(b)[:count].astype(bool)
+
+
+def _decimal_varints(buf: bytes, count: int) -> np.ndarray:
+    """Decimal DATA: unbounded zigzag varints (values beyond int64 raise —
+    wide decimal ORC columns arrive via the (hi, lo) path)."""
+    from trino_tpu import native
+
+    fast = native.orc_decimal64(buf, count)
+    if fast is not None:
+        return fast
+    out = np.empty(count, dtype=np.int64)
+    pos = 0
+    for i in range(count):
+        v, pos = _varint(buf, pos)
+        out[i] = int(_zigzag(np.int64(v & 0xFFFFFFFFFFFFFFFF)))
+    return out
+
+
+# --- schema ---------------------------------------------------------------
+
+KIND_BOOLEAN = 0
+KIND_BYTE = 1
+KIND_SHORT = 2
+KIND_INT = 3
+KIND_LONG = 4
+KIND_FLOAT = 5
+KIND_DOUBLE = 6
+KIND_STRING = 7
+KIND_BINARY = 8
+KIND_TIMESTAMP = 9
+KIND_LIST = 10
+KIND_MAP = 11
+KIND_STRUCT = 12
+KIND_UNION = 13
+KIND_DECIMAL = 14
+KIND_DATE = 15
+KIND_VARCHAR = 16
+KIND_CHAR = 17
+
+STREAM_PRESENT = 0
+STREAM_DATA = 1
+STREAM_LENGTH = 2
+STREAM_DICTIONARY_DATA = 3
+STREAM_SECONDARY = 5
+STREAM_ROW_INDEX = 6
+
+ENC_DIRECT = 0
+ENC_DICTIONARY = 1
+ENC_DIRECT_V2 = 2
+ENC_DICTIONARY_V2 = 3
+
+
+@dataclasses.dataclass
+class OrcType:
+    kind: int
+    subtypes: list[int]
+    field_names: list[str]
+    precision: int = 0
+    scale: int = 0
+
+    def sql_type(self):
+        if self.kind in (KIND_BOOLEAN,):
+            return T.BOOLEAN
+        if self.kind in (KIND_BYTE, KIND_SHORT, KIND_INT, KIND_LONG):
+            return T.BIGINT
+        if self.kind in (KIND_FLOAT, KIND_DOUBLE):
+            return T.DOUBLE
+        if self.kind in (KIND_STRING, KIND_VARCHAR, KIND_CHAR):
+            return T.VARCHAR
+        if self.kind == KIND_DATE:
+            return T.DATE
+        if self.kind == KIND_DECIMAL:
+            return T.decimal(self.precision or 38, self.scale)
+        raise ValueError(f"unsupported ORC type kind {self.kind}")
+
+
+@dataclasses.dataclass
+class StripeInfo:
+    offset: int
+    index_length: int
+    data_length: int
+    footer_length: int
+    num_rows: int
+
+
+@dataclasses.dataclass
+class ColumnStats:
+    num_values: Optional[int]
+    has_null: bool
+    min_value: Optional[object]
+    max_value: Optional[object]
+
+
+class OrcFile:
+    """Parsed tail + stripe directory of one ORC file."""
+
+    MAGIC = b"ORC"
+
+    def __init__(self, data: bytes):
+        self.data = data
+        if len(data) < 16:
+            raise ValueError("not an ORC file (too short)")
+        ps_len = data[-1]
+        ps = _proto(data[-1 - ps_len : -1])
+        self.compression = _first(ps, 2, 0)
+        footer_len = _first(ps, 1, 0)
+        meta_len = _first(ps, 5, 0)
+        magic = _first(ps, 8000, b"")
+        if magic != self.MAGIC and not data.startswith(self.MAGIC):
+            raise ValueError("not an ORC file (missing magic)")
+        tail = len(data) - 1 - ps_len
+        footer = _proto(_decompress(data[tail - footer_len : tail], self.compression))
+        meta_buf = data[tail - footer_len - meta_len : tail - footer_len]
+        self.metadata = (
+            _proto(_decompress(meta_buf, self.compression)) if meta_len else {}
+        )
+        self.num_rows = _first(footer, 6, 0)
+        self.types: list[OrcType] = []
+        for tbytes in footer.get(4, []):
+            m = _proto(tbytes)
+            self.types.append(
+                OrcType(
+                    kind=_first(m, 1, 0),
+                    subtypes=_uints(m, 2),
+                    field_names=[v.decode() for v in m.get(3, [])],
+                    precision=_first(m, 5, 0),
+                    scale=_first(m, 6, 0),
+                )
+            )
+        self.stripes: list[StripeInfo] = []
+        for sbytes in footer.get(3, []):
+            m = _proto(sbytes)
+            self.stripes.append(
+                StripeInfo(
+                    offset=_first(m, 1, 0),
+                    index_length=_first(m, 2, 0),
+                    data_length=_first(m, 3, 0),
+                    footer_length=_first(m, 4, 0),
+                    num_rows=_first(m, 5, 0),
+                )
+            )
+        # column order: root struct's children
+        root = self.types[0]
+        if root.kind != KIND_STRUCT:
+            raise ValueError("ORC root type must be a struct")
+        self.column_names = root.field_names
+        self.column_type_ids = root.subtypes
+
+    # --- statistics ------------------------------------------------------
+
+    def stripe_stats(self, stripe_index: int) -> dict[int, ColumnStats]:
+        """Per-type-id stats for one stripe (Metadata.stripeStats)."""
+        entries = self.metadata.get(1, [])
+        if stripe_index >= len(entries):
+            return {}
+        per_col = _proto(entries[stripe_index]).get(1, [])
+        out = {}
+        for type_id, cbytes in enumerate(per_col):
+            out[type_id] = _parse_col_stats(
+                cbytes, self.types[type_id] if type_id < len(self.types) else None
+            )
+        return out
+
+    # --- stripe reading --------------------------------------------------
+
+    def read_stripe(
+        self, stripe: StripeInfo, want: Optional[set[str]] = None
+    ) -> dict[str, Column]:
+        sf_off = stripe.offset + stripe.index_length + stripe.data_length
+        sfooter = _proto(
+            _decompress(
+                self.data[sf_off : sf_off + stripe.footer_length],
+                self.compression,
+            )
+        )
+        streams = []
+        pos = stripe.offset
+        for sbytes in sfooter.get(1, []):
+            m = _proto(sbytes)
+            kind = _first(m, 1, 0)
+            column = _first(m, 2, 0)
+            length = _first(m, 3, 0)
+            streams.append((kind, column, pos, length))
+            pos += length
+        encodings = [
+            (_first(_proto(e), 1, 0), _first(_proto(e), 2, 0))
+            for e in sfooter.get(2, [])
+        ]
+
+        def stream(col_id: int, kind: int) -> Optional[bytes]:
+            for k, c, off, ln in streams:
+                if c == col_id and k == kind:
+                    return _decompress(
+                        self.data[off : off + ln], self.compression
+                    )
+            return None
+
+        out: dict[str, Column] = {}
+        for name, type_id in zip(self.column_names, self.column_type_ids):
+            if want is not None and name not in want:
+                continue
+            out[name] = self._read_column(
+                type_id, stripe.num_rows, stream, encodings
+            )
+        return out
+
+    def _read_column(self, type_id, num_rows, stream, encodings) -> Column:
+        t = self.types[type_id]
+        enc = encodings[type_id][0] if type_id < len(encodings) else ENC_DIRECT
+        present = stream(type_id, STREAM_PRESENT)
+        if present is not None:
+            valid = _bool_rle(present, num_rows)
+            n_present = int(valid.sum())
+        else:
+            valid = None
+            n_present = num_rows
+
+        def expand(vals: np.ndarray, fill=0) -> np.ndarray:
+            if valid is None:
+                return vals
+            out = np.full(num_rows, fill, dtype=vals.dtype)
+            out[valid] = vals
+            return out
+
+        data = stream(type_id, STREAM_DATA)
+        v2 = enc in (ENC_DIRECT_V2, ENC_DICTIONARY_V2)
+        rle = _rle_v2 if v2 else _rle_v1
+
+        if t.kind in (KIND_SHORT, KIND_INT, KIND_LONG):
+            vals = rle(data, n_present, signed=True)
+            return Column(T.BIGINT, expand(vals), valid)
+        if t.kind == KIND_DATE:
+            vals = rle(data, n_present, signed=True)
+            return Column(T.DATE, expand(vals).astype(np.int32), valid)
+        if t.kind == KIND_BYTE:
+            vals = _byte_rle(data, n_present).astype(np.int8).astype(np.int64)
+            return Column(T.BIGINT, expand(vals), valid)
+        if t.kind == KIND_BOOLEAN:
+            vals = _bool_rle(data, n_present)
+            return Column(T.BOOLEAN, expand(vals), valid)
+        if t.kind in (KIND_FLOAT, KIND_DOUBLE):
+            width = 4 if t.kind == KIND_FLOAT else 8
+            dt = np.float32 if t.kind == KIND_FLOAT else np.float64
+            vals = np.frombuffer(data, dtype=np.dtype(dt).newbyteorder("<"),
+                                 count=n_present).astype(np.float64)
+            return Column(T.DOUBLE, expand(vals), valid)
+        if t.kind == KIND_DECIMAL:
+            vals = _decimal_varints(data, n_present)
+            secondary = stream(type_id, STREAM_SECONDARY)
+            scales = rle(secondary, n_present, signed=True)
+            target = t.scale
+            diff = target - scales
+            # normalize to declared scale (writers emit per-value scales)
+            vals = np.where(
+                diff >= 0,
+                vals * (10 ** np.clip(diff, 0, None)),
+                vals // (10 ** np.clip(-diff, 0, None)),
+            )
+            return Column(t.sql_type(), expand(vals), valid)
+        if t.kind in (KIND_STRING, KIND_VARCHAR, KIND_CHAR):
+            if enc in (ENC_DICTIONARY, ENC_DICTIONARY_V2):
+                codes = rle(data, n_present, signed=False)
+                dict_data = stream(type_id, STREAM_DICTIONARY_DATA) or b""
+                lengths = rle(
+                    stream(type_id, STREAM_LENGTH), encodings[type_id][1],
+                    signed=False,
+                )
+                offs = np.concatenate([[0], np.cumsum(lengths)])
+                values = [
+                    dict_data[offs[i] : offs[i + 1]].decode("utf-8")
+                    for i in range(len(lengths))
+                ]
+                d = Dictionary(values)
+                out_codes = expand(codes.astype(np.int32), fill=-1)
+                return Column(T.VARCHAR, out_codes, valid, d)
+            lengths = rle(stream(type_id, STREAM_LENGTH), n_present, signed=False)
+            offs = np.concatenate([[0], np.cumsum(lengths)])
+            strings = [
+                data[offs[i] : offs[i + 1]].decode("utf-8")
+                for i in range(n_present)
+            ]
+            d, codes = Dictionary.from_strings(strings)
+            return Column(T.VARCHAR, expand(codes, fill=-1), valid, d)
+        raise ValueError(f"unsupported ORC column kind {t.kind}")
+
+
+def _parse_col_stats(cbytes: bytes, t: Optional[OrcType]) -> ColumnStats:
+    m = _proto(cbytes)
+    num = _first(m, 1)
+    has_null = bool(_first(m, 10, 0))
+    mn = mx = None
+    if 2 in m:  # integers
+        s = _proto(m[2][0])
+        mn = _signed_varint(_first(s, 1))
+        mx = _signed_varint(_first(s, 2))
+    elif 7 in m:  # date
+        s = _proto(m[7][0])
+        mn = _signed_varint(_first(s, 1))
+        mx = _signed_varint(_first(s, 2))
+    elif 4 in m:  # string
+        s = _proto(m[4][0])
+        mn = _first(s, 1)
+        mx = _first(s, 2)
+        mn = mn.decode() if mn is not None else None
+        mx = mx.decode() if mx is not None else None
+    elif 3 in m:  # double
+        s = _proto(m[3][0])
+        mn = _f64(_first(s, 1))
+        mx = _f64(_first(s, 2))
+    elif 6 in m:  # decimal (strings)
+        s = _proto(m[6][0])
+        mn = _first(s, 1)
+        mx = _first(s, 2)
+        mn = mn.decode() if mn is not None else None
+        mx = mx.decode() if mx is not None else None
+    return ColumnStats(num, has_null, mn, mx)
+
+
+def _signed_varint(v):
+    """sint64 fields arrive zigzag-encoded by protobuf."""
+    if v is None:
+        return None
+    return int(_zigzag(np.int64(v)))
+
+
+def _f64(v):
+    if v is None:
+        return None
+    return float(np.frombuffer(v, dtype="<f8")[0])
+
+
+def read_orc(path: str, columns: Optional[list[str]] = None) -> Batch:
+    """Read a whole ORC file into one Batch (column subset optional)."""
+    from trino_tpu.columnar import concat_batches
+
+    with open(path, "rb") as f:
+        data = f.read()
+    f = OrcFile(data)
+    want = set(columns) if columns is not None else None
+    names = [
+        n for n in f.column_names if want is None or n in want
+    ]
+    batches = []
+    for stripe in f.stripes:
+        cols = f.read_stripe(stripe, want)
+        batches.append(
+            Batch([cols[n] for n in names], stripe.num_rows)
+        )
+    if not batches:
+        return Batch([], 0)
+    return concat_batches(batches) if len(batches) > 1 else batches[0]

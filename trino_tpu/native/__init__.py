@@ -77,6 +77,14 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.tt_snappy_decompress.argtypes = [u8p, i64, u8p, i64]
     lib.tt_tpch_textpool.restype = i64
     lib.tt_tpch_textpool.argtypes = [u8p, i64, u8p, i64, i64]
+    lib.tt_orc_rle2.restype = i64
+    lib.tt_orc_rle2.argtypes = [u8p, i64, i64, ctypes.c_int32, i64p]
+    lib.tt_orc_rle1.restype = i64
+    lib.tt_orc_rle1.argtypes = [u8p, i64, i64, ctypes.c_int32, i64p]
+    lib.tt_orc_byte_rle.restype = i64
+    lib.tt_orc_byte_rle.argtypes = [u8p, i64, i64, u8p]
+    lib.tt_orc_decimal64.restype = i64
+    lib.tt_orc_decimal64.argtypes = [u8p, i64, i64, i64p]
     lib.tt_snappy_compress.restype = i64
     lib.tt_snappy_compress.argtypes = [u8p, i64, u8p]
     lib.tt_parquet_rle_decode.restype = i64
@@ -520,3 +528,58 @@ def tpch_textpool(size: int, dists_blob: bytes, seed: int) -> np.ndarray:
     from trino_tpu.connectors.dbgen import textpool_python
 
     return textpool_python(size, dists_blob, seed)
+
+
+def orc_rle2(data: bytes, count: int, signed: bool) -> Optional[np.ndarray]:
+    """ORC RLEv2 integer decode (None -> caller uses the Python path)."""
+    if _LIB is None or count == 0:
+        return None if _LIB is None else np.zeros(0, dtype=np.int64)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(count, dtype=np.int64)
+    rc = _LIB.tt_orc_rle2(
+        _ptr(buf, ctypes.c_uint8), len(buf), count, int(signed),
+        _ptr(out, ctypes.c_int64),
+    )
+    if rc < 0:
+        raise ValueError("corrupt ORC RLEv2 stream")
+    return out
+
+
+def orc_rle1(data: bytes, count: int, signed: bool) -> Optional[np.ndarray]:
+    if _LIB is None or count == 0:
+        return None if _LIB is None else np.zeros(0, dtype=np.int64)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(count, dtype=np.int64)
+    rc = _LIB.tt_orc_rle1(
+        _ptr(buf, ctypes.c_uint8), len(buf), count, int(signed),
+        _ptr(out, ctypes.c_int64),
+    )
+    if rc < 0:
+        raise ValueError("corrupt ORC RLEv1 stream")
+    return out
+
+
+def orc_byte_rle(data: bytes, count: int) -> Optional[np.ndarray]:
+    if _LIB is None or count == 0:
+        return None if _LIB is None else np.zeros(0, dtype=np.uint8)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(count, dtype=np.uint8)
+    rc = _LIB.tt_orc_byte_rle(
+        _ptr(buf, ctypes.c_uint8), len(buf), count, _ptr(out, ctypes.c_uint8)
+    )
+    if rc < 0:
+        raise ValueError("corrupt ORC byte-RLE stream")
+    return out
+
+
+def orc_decimal64(data: bytes, count: int) -> Optional[np.ndarray]:
+    if _LIB is None or count == 0:
+        return None if _LIB is None else np.zeros(0, dtype=np.int64)
+    buf = np.frombuffer(data, dtype=np.uint8)
+    out = np.empty(count, dtype=np.int64)
+    rc = _LIB.tt_orc_decimal64(
+        _ptr(buf, ctypes.c_uint8), len(buf), count, _ptr(out, ctypes.c_int64)
+    )
+    if rc < 0:
+        raise ValueError("corrupt ORC decimal stream")
+    return out
