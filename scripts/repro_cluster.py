@@ -22,9 +22,12 @@ Q3 = """select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
 
 
 def dump_tasks(runner):
+    from trino_tpu.server import auth
+
     for uri in [runner.coordinator_uri] + runner.worker_uris:
         try:
-            with urllib.request.urlopen(f"{uri}/v1/task", timeout=5) as r:
+            req = urllib.request.Request(f"{uri}/v1/task", headers=auth.headers())
+            with urllib.request.urlopen(req, timeout=5) as r:
                 tasks = json.loads(r.read().decode())
             print(f"--- {uri}")
             for t in tasks:

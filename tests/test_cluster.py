@@ -116,10 +116,15 @@ class TestWorkerDeviceExecution:
         import json
         import urllib.request
 
+        from trino_tpu.server import auth
+
         def task_map():
             out = {}
             for uri in cluster.worker_uris:
-                with urllib.request.urlopen(f"{uri}/v1/task") as r:
+                req = urllib.request.Request(
+                    f"{uri}/v1/task", headers=auth.headers()
+                )
+                with urllib.request.urlopen(req) as r:
                     for t in json.loads(r.read().decode()):
                         out[t["taskId"]] = t
             return out
@@ -177,3 +182,44 @@ class TestClusterMembership:
         else:
             pytest.fail("failure detector never flagged the killed worker")
         check(cluster, local, "select count(*), sum(o_totalprice) from orders")
+
+
+class TestInternalAuth:
+    def test_unauthenticated_task_post_rejected(self, cluster):
+        """Task/announce/spmd endpoints demand the shared secret
+        (reference InternalAuthenticationManager)."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        body = json.dumps({"fragment": {}}).encode()
+        for path in ("/v1/task/evil.1.0", "/v1/announce"):
+            uri = cluster.worker_uris[0] + path
+            method = "POST" if "task" in path else "PUT"
+            req = urllib.request.Request(uri, data=body, method=method)
+            try:
+                urllib.request.urlopen(req, timeout=10)
+                raise AssertionError(f"{path} accepted an unauthenticated call")
+            except urllib.error.HTTPError as e:
+                assert e.code == 401, (path, e.code)
+
+    def test_wrong_secret_rejected(self, cluster):
+        import json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            cluster.worker_uris[0] + "/v1/task/evil.2.0",
+            data=json.dumps({}).encode(),
+            method="POST",
+            headers={"Authorization": "Bearer wrong-secret"},
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("wrong secret accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+
+    def test_client_statement_endpoint_stays_open(self, cluster, local):
+        # external protocol surface must NOT require the internal secret
+        check(cluster, local, "select count(*) from region")

@@ -114,3 +114,20 @@ class TestSystemTables:
             assert rows[0][0].startswith("http://")
         finally:
             s.stop()
+
+
+class TestFusedExplainAnalyze:
+    def test_fragment_stats_without_fallback(self):
+        """EXPLAIN ANALYZE on a fused query reports per-fragment compile/
+        run stats instead of switching to the interpreter (VERDICT r2)."""
+        from trino_tpu.testing import DistributedQueryRunner
+
+        r = DistributedQueryRunner()
+        rows, _ = r.execute(
+            "explain analyze select l_returnflag, sum(l_quantity)"
+            " from lineitem group by l_returnflag"
+        )
+        text = "\n".join(row[0] for row in rows)
+        assert "Fragments (fused single-program execution):" in text
+        assert "mode=fused" in text or "mode=streamed" in text
+        assert "compile_attempts=" in text or "wall=" in text

@@ -90,8 +90,13 @@ class TestSpmdQueries:
         check(
             spmd_cluster, local, "select count(*), sum(l_quantity) from lineitem"
         )
+        from trino_tpu.server import auth
+
         for uri in spmd_cluster.worker_uris:
-            with urllib.request.urlopen(f"{uri}/v1/task") as r:
+            req = urllib.request.Request(
+                f"{uri}/v1/task", headers=auth.headers()
+            )
+            with urllib.request.urlopen(req) as r:
                 tasks = json.loads(r.read().decode())
             assert tasks == [], f"worker {uri} unexpectedly ran tasks: {tasks}"
 

@@ -366,6 +366,10 @@ class Engine:
             plan = self.plan(inner, session)
             res = self._execute_query_plan(plan, session, collector=collector)
             text = render_plan_with_stats(plan, collector)
+            if collector.fragments:
+                from trino_tpu.stats import render_fragment_stats
+
+                text += "\n\n" + render_fragment_stats(collector.fragments)
             text += (
                 f"\n\npeak memory: {res.peak_memory_bytes} bytes"
                 f"\ndynamic filters: {res.dynamic_filters}"

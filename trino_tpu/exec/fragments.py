@@ -183,9 +183,6 @@ class FragmentedExecutor(DistributedExecutor):
     """Distributed executor that compiles each fragment into one program."""
 
     def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
-        if self.stats_collector is not None:
-            # per-operator stats need the materialized interpreter
-            return super().execute(node)
         sub = fragment_plan(node)
         if not query_fusable(sub):
             return super().execute(node)
@@ -234,8 +231,22 @@ class FragmentedExecutor(DistributedExecutor):
         results: dict[int, Result],
         names_holder: dict[int, list[str]],
     ) -> Result:
+        import time as _time
+
+        t0 = _time.perf_counter()
         streamed = self._try_streaming(frag, names_holder)
         if streamed is not None:
+            if self.stats_collector is not None:
+                self.stats_collector.record_fragment(
+                    frag.id,
+                    {
+                        "mode": "streamed",
+                        "wall_s": _time.perf_counter() - t0,
+                        "output_rows": int(
+                            np.asarray(streamed.batch.selection_mask()).sum()
+                        ),
+                    },
+                )
             return streamed
         inputs: dict[str, Batch] = {}
         input_layouts: dict[str, dict[str, int]] = {}
@@ -259,7 +270,24 @@ class FragmentedExecutor(DistributedExecutor):
                 input_layouts[f"remote{n.fragment_id}"] = res.layout
             elif isinstance(n, P.Output):
                 names_holder[frag.id] = list(n.column_names)
-        return self.run_fragment_program(frag, inputs, input_layouts)
+        sink = {} if self.stats_collector is not None else None
+        out = self.run_fragment_program(
+            frag, inputs, input_layouts, stats_sink=sink
+        )
+        if self.stats_collector is not None:
+            self.stats_collector.record_fragment(
+                frag.id,
+                {
+                    "mode": "fused",
+                    "wall_s": _time.perf_counter() - t0,
+                    "attempts": sink.get("attempts", 1),
+                    "input_rows": sink.get("input_rows", 0),
+                    "output_rows": int(
+                        np.asarray(out.batch.selection_mask()).sum()
+                    ),
+                },
+            )
+        return out
 
     def _try_streaming(
         self, frag: PlanFragment, names_holder: dict[int, list[str]]

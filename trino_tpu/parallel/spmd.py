@@ -135,8 +135,13 @@ class SpmdRunner:
             threads = []
 
             def post(uri: str):
+                from trino_tpu.server import auth
+
                 req = urllib.request.Request(
-                    f"{uri}/v1/spmd", data=payload, method="POST"
+                    f"{uri}/v1/spmd",
+                    data=payload,
+                    method="POST",
+                    headers=auth.headers(),
                 )
                 req.add_header("Content-Type", "application/json")
                 try:

@@ -121,19 +121,30 @@ class HttpRemoteTask:
         self.uri = f"{node.uri}/v1/task/{task_id}"
 
     def start(self) -> None:
+        from trino_tpu.server import auth
+
         body = json.dumps(self.payload).encode()
-        req = urllib.request.Request(self.uri, data=body, method="POST")
+        req = urllib.request.Request(
+            self.uri, data=body, method="POST", headers=auth.headers()
+        )
         req.add_header("Content-Type", "application/json")
         with urllib.request.urlopen(req, timeout=30) as r:
             json.loads(r.read().decode())
 
     def status(self, max_wait: float = 0.0) -> dict:
+        from trino_tpu.server import auth
+
         uri = self.uri + (f"?maxWait={max_wait}" if max_wait else "")
-        with urllib.request.urlopen(uri, timeout=max(30, max_wait + 10)) as r:
+        req = urllib.request.Request(uri, headers=auth.headers())
+        with urllib.request.urlopen(req, timeout=max(30, max_wait + 10)) as r:
             return json.loads(r.read().decode())
 
     def cancel(self) -> None:
-        req = urllib.request.Request(self.uri, method="DELETE")
+        from trino_tpu.server import auth
+
+        req = urllib.request.Request(
+            self.uri, method="DELETE", headers=auth.headers()
+        )
         try:
             urllib.request.urlopen(req, timeout=10)
         except Exception:  # noqa: BLE001 - best-effort

@@ -125,11 +125,16 @@ class TrinoTpuServer:
         while self.state == "ACTIVE":
             if self.discovery_uri and not self.discovery_uri.startswith("@"):
                 try:
+                    from trino_tpu.server import auth
+
                     body = json.dumps(
                         {"nodeId": self.node_id, "uri": self.base_uri}
                     ).encode()
                     req = _rq.Request(
-                        f"{self.discovery_uri}/v1/announce", data=body, method="PUT"
+                        f"{self.discovery_uri}/v1/announce",
+                        data=body,
+                        method="PUT",
+                        headers=auth.headers(),
                     )
                     _rq.urlopen(req, timeout=10)
                 except Exception:  # noqa: BLE001 — coordinator may not be up yet
@@ -265,6 +270,15 @@ def _make_handler(server: TrinoTpuServer):
         def _error(self, status: int, message: str):
             self._send_json({"error": message}, status)
 
+        def _check_internal_auth(self) -> bool:
+            from trino_tpu.server import auth
+
+            path = urllib.parse.urlparse(self.path).path
+            if auth.is_internal_path(path) and not auth.authorized(self.headers):
+                self._error(401, "missing or invalid internal credential")
+                return False
+            return True
+
         def _send_no_content(self):
             # 204 must carry no body (RFC 9110); body bytes would desync
             # keep-alive connections
@@ -307,6 +321,8 @@ def _make_handler(server: TrinoTpuServer):
         # --- routes ------------------------------------------------------
 
         def do_POST(self):
+            if not self._check_internal_auth():
+                return None
             path = urllib.parse.urlparse(self.path).path
             if path == "/v1/statement":
                 if server.state != "ACTIVE":
@@ -339,6 +355,8 @@ def _make_handler(server: TrinoTpuServer):
             return self._error(404, f"unknown path: {path}")
 
         def do_GET(self):
+            if not self._check_internal_auth():
+                return None
             path = urllib.parse.urlparse(self.path).path
             parts = [p for p in path.split("/") if p]
             if path == "/v1/info":
@@ -480,6 +498,8 @@ def _make_handler(server: TrinoTpuServer):
             return self._error(404, f"unknown path: {path}")
 
         def do_DELETE(self):
+            if not self._check_internal_auth():
+                return None
             path = urllib.parse.urlparse(self.path).path
             parts = [p for p in path.split("/") if p]
             if len(parts) >= 5 and parts[:2] == ["v1", "statement"]:
@@ -500,6 +520,8 @@ def _make_handler(server: TrinoTpuServer):
             return self._error(404, f"unknown path: {path}")
 
         def do_PUT(self):
+            if not self._check_internal_auth():
+                return None
             path = urllib.parse.urlparse(self.path).path
             if path == "/v1/discovery":
                 # late discovery injection (SPMD boot: the coordinator's

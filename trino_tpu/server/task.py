@@ -134,6 +134,8 @@ class ExchangeClient:
         lock = threading.Lock()
 
         def pull(loc: str):
+            from trino_tpu.server import auth
+
             try:
                 token = 0
                 deadline = time.time() + self.timeout
@@ -142,8 +144,9 @@ class ExchangeClient:
                         f"{loc}/results/{self.partition}/{token}"
                         f"?maxWait={self.poll_wait}"
                     )
+                    req = urllib.request.Request(uri, headers=auth.headers())
                     with urllib.request.urlopen(
-                        uri, timeout=self.poll_wait + 30
+                        req, timeout=self.poll_wait + 30
                     ) as r:
                         payload = json.loads(r.read().decode())
                     for b64 in payload["pages"]:
@@ -156,7 +159,12 @@ class ExchangeClient:
                         # the producer (nothing re-reads a complete buffer)
                         try:
                             ack = f"{loc}/results/{self.partition}/{token}?maxWait=0"
-                            urllib.request.urlopen(ack, timeout=5).close()
+                            urllib.request.urlopen(
+                                urllib.request.Request(
+                                    ack, headers=auth.headers()
+                                ),
+                                timeout=5,
+                            ).close()
                         except Exception:  # noqa: BLE001 - best-effort
                             pass
                         return

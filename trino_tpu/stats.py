@@ -36,6 +36,12 @@ class StatsCollector:
         self.by_node: dict[int, NodeStats] = {}
         self._inclusive: dict[int, float] = {}
         self._keep: list = []  # retain node refs so id() stays valid
+        # fused execution reports per-FRAGMENT stats (one compiled program
+        # per fragment has no per-operator boundaries to time)
+        self.fragments: list[dict] = []
+
+    def record_fragment(self, fragment_id, info: dict) -> None:
+        self.fragments.append({"fragment": fragment_id, **info})
 
     def record(self, node, wall: float, rows: int, bytes_: int, detail: str = ""):
         """``wall`` is inclusive of children (the executor times the whole
@@ -49,7 +55,30 @@ class StatsCollector:
         )
 
     def total_wall(self) -> float:
-        return sum(s.wall_seconds for s in self.by_node.values())
+        if self.by_node:
+            return sum(s.wall_seconds for s in self.by_node.values())
+        return sum(f.get("wall_s", 0.0) for f in self.fragments)
+
+
+def render_fragment_stats(fragments: list[dict]) -> str:
+    """EXPLAIN ANALYZE section for fused execution: one compiled program
+    per fragment (ref ExplainAnalyzeOperator.java:34 — here the unit of
+    profiling matches the unit of compilation)."""
+    lines = ["Fragments (fused single-program execution):"]
+    for f in fragments:
+        parts = [
+            f"  fragment {f['fragment']}: mode={f.get('mode', 'fused')}",
+            f"wall={f.get('wall_s', 0.0) * 1000:.1f}ms",
+        ]
+        # only report what was actually measured (streamed fragments have
+        # no single compile attempt count or static input size)
+        if "attempts" in f:
+            parts.append(f"compile_attempts={f['attempts']}")
+        if "input_rows" in f:
+            parts.append(f"input_rows={f['input_rows']:,}")
+        parts.append(f"output_rows={f.get('output_rows', 0):,}")
+        lines.append(" ".join(parts))
+    return "\n".join(lines)
 
 
 def render_plan_with_stats(

@@ -85,9 +85,18 @@ class MultiProcessQueryRunner:
         import time
         import urllib.request
 
+        import secrets as _secrets
+
         self._procs: list[subprocess.Popen] = []
         self.spmd = spmd
         env = dict(os.environ)
+        # one internal credential per PROCESS (not per cluster): rotating
+        # it would 401 the parent's calls to an older still-live cluster
+        from trino_tpu.server.auth import ENV_VAR as _AUTH_ENV
+
+        if not os.environ.get(_AUTH_ENV):
+            os.environ[_AUTH_ENV] = _secrets.token_hex(16)
+        env[_AUTH_ENV] = os.environ[_AUTH_ENV]
         env.pop("PALLAS_AXON_POOL_IPS", None)  # workers run CPU-only
         env["JAX_PLATFORMS"] = platform
         # share the parent's persistent compile cache: a cold worker cache
@@ -188,6 +197,8 @@ class MultiProcessQueryRunner:
             # late discovery: tell each worker where the coordinator is
             import json as _json
 
+            from trino_tpu.server import auth as _auth
+
             for uri in self.worker_uris:
                 req = urllib.request.Request(
                     f"{uri}/v1/discovery",
@@ -195,6 +206,7 @@ class MultiProcessQueryRunner:
                         {"uri": self.coordinator_uri}
                     ).encode(),
                     method="PUT",
+                    headers=_auth.headers(),
                 )
                 urllib.request.urlopen(req, timeout=10)
         else:
