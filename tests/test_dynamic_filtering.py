@@ -165,3 +165,59 @@ def _session_without_df() -> Session:
     s = Session()
     s.set("enable_dynamic_filtering", False)
     return s
+
+
+class TestFusedDynamicFiltering:
+    """DF in the fused fragment path (VERDICT r2 item: zero references in
+    exec/fragments.py) — build fragments prune probe scans/rows before
+    the probe fragment's program materializes its inputs."""
+
+    @pytest.fixture(scope="class")
+    def fused(self):
+        return DistributedQueryRunner()
+
+    @pytest.fixture(scope="class")
+    def runner(self):
+        return LocalQueryRunner()
+
+    def test_q3_shape_correct_and_filters_collected(self, fused, runner):
+        sql = """select l_orderkey, sum(l_extendedprice * (1 - l_discount)),
+                        o_orderdate, o_shippriority
+                 from customer, orders, lineitem
+                 where c_mktsegment = 'BUILDING'
+                   and c_custkey = o_custkey and l_orderkey = o_orderkey
+                   and o_orderdate < date '1995-03-15'
+                   and l_shipdate > date '1995-03-15'
+                 group by l_orderkey, o_orderdate, o_shippriority
+                 order by 2 desc, o_orderdate limit 10"""
+        got, _ = fused.execute(sql)
+        want, _ = runner.execute(sql)
+        assert got == want
+        rows, _ = fused.execute("explain analyze " + sql)
+        text = "\n".join(r[0] for r in rows)
+        import re
+
+        m = re.search(r"dynamic filters: (\d+)", text)
+        assert m and int(m.group(1)) >= 1, text[-400:]
+
+    def test_disabled_still_correct(self, fused, runner):
+        fused.session.set("enable_dynamic_filtering", False)
+        try:
+            sql = (
+                "select count(*) from orders join customer"
+                " on o_custkey = c_custkey where c_mktsegment = 'BUILDING'"
+            )
+            got, _ = fused.execute(sql)
+            want, _ = runner.execute(sql)
+            assert got == want
+        finally:
+            fused.session.set("enable_dynamic_filtering", True)
+
+    def test_empty_build_prunes_probe_completely(self, fused, runner):
+        sql = (
+            "select count(*) from lineitem join orders on l_orderkey = o_orderkey"
+            " where o_totalprice < 0"
+        )
+        got, _ = fused.execute(sql)
+        want, _ = runner.execute(sql)
+        assert got == want == [(0,)]

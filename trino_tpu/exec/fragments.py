@@ -248,6 +248,34 @@ class FragmentedExecutor(DistributedExecutor):
                     },
                 )
             return streamed
+        # dynamic filtering: completed build fragments prune this
+        # fragment's probe scans before any input materializes
+        from trino_tpu.dynfilter import fragment_dynamic_filters
+
+        def build_lookup(fid):
+            res = results.get(fid)
+            if res is None:
+                return None
+            if jax.process_count() > 1:
+                # intermediate fragment results are sharded across hosts;
+                # host-side domains would need a collective — skip
+                return None
+            sel = np.asarray(res.batch.selection_mask())
+
+            def get_column(name):
+                idx = res.layout.get(name)
+                if idx is None:
+                    return None
+                c = res.batch.columns[idx]
+                return c.data, np.asarray(c.valid_mask()) & sel
+
+            return get_column, int(sel.sum())
+
+        root = fragment_dynamic_filters(
+            frag.root, build_lookup, self.session, self.dynamic_filters
+        )
+        frag = dataclasses.replace(frag, root=root)
+
         inputs: dict[str, Batch] = {}
         input_layouts: dict[str, dict[str, int]] = {}
         spill_threshold = (

@@ -1195,12 +1195,7 @@ class LocalExecutor:
         """Collect build-side key domains and push them into the probe plan
         (reference: DynamicFilterSourceOperator -> DynamicFilterService ->
         probe scans; here synchronous since the build is materialized)."""
-        from trino_tpu.dynfilter import (
-            DynamicFilterStats,
-            convert_domain,
-            domain_from_build,
-            push_probe_domain,
-        )
+        from trino_tpu.dynfilter import collect_and_push
 
         left_plan = node.left
         if (
@@ -1215,23 +1210,14 @@ class LocalExecutor:
         sel = np.asarray(build.batch.selection_mask())
         for lsym, rsym in node.criteria:
             col = build.column(rsym)
+            data = np.asarray(col.data)
+            if data.ndim != 1:
+                continue
             valid = np.asarray(col.valid_mask()) & sel
-            domain = domain_from_build(np.asarray(col.data), valid, col.type)
-            if domain is None or domain.is_all():
-                continue
-            domain = convert_domain(domain, col.type, lsym.type)
-            if domain is None or domain.is_all():
-                continue
-            dv = domain.values.discrete_values()
-            self.dynamic_filters.append(
-                DynamicFilterStats(
-                    lsym.name,
-                    "none" if domain.is_none() else ("discrete" if dv is not None else "range"),
-                    len(dv) if dv else 0,
-                    build_rows,
-                )
+            left_plan = collect_and_push(
+                left_plan, lsym, rsym, data, valid, build_rows,
+                self.dynamic_filters,
             )
-            left_plan = push_probe_domain(left_plan, lsym, domain)
         return left_plan
 
     def _join_result(self, node: P.Join, left: Result, right: Result) -> Result:

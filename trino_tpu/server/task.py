@@ -296,6 +296,54 @@ class FusedWorkerRunner:
             if self.executor.session.get("spill_enabled")
             else None
         )
+        # dynamic filtering: prefetched build pages prune this task's
+        # probe splits and rows (sound under hash partitioning — probe
+        # rows are co-partitioned with their build rows)
+        from trino_tpu.dynfilter import fragment_dynamic_filters
+
+        by_fid = {
+            n.fragment_id: n
+            for n in P.walk_plan(self.fragment.root)
+            if isinstance(n, P.RemoteSource)
+        }
+
+        def build_lookup(fid):
+            node = by_fid.get(fid)
+            batches = source_batches.get(fid)
+            if node is None or batches is None:
+                return None
+            nonempty = [b for b in batches if b.num_rows > 0]
+            pos = {s.name: i for i, s in enumerate(node.symbols)}
+            if not nonempty:
+                def get_empty(name):
+                    if name not in pos:
+                        return None
+                    return np.zeros(0, dtype=np.int64), None
+
+                return get_empty, 0
+            merged = (
+                concat_batches(nonempty) if len(nonempty) > 1 else nonempty[0]
+            ).compact()
+
+            def get_column(name):
+                i = pos.get(name)
+                if i is None:
+                    return None
+                return merged.columns[i].to_numpy()
+
+            return get_column, merged.num_rows
+
+        root = fragment_dynamic_filters(
+            self.fragment.root,
+            build_lookup,
+            self.executor.session,
+            self.executor.dynamic_filters,
+        )
+        import dataclasses as _dc
+
+        fragment = _dc.replace(self.fragment, root=root)
+        self.fragment = fragment
+
         inputs: dict[str, Batch] = {}
         layouts: dict[str, dict[str, int]] = {}
         for node in P.walk_plan(self.fragment.root):
@@ -303,6 +351,22 @@ class FusedWorkerRunner:
                 key = f"{node.catalog}.{node.schema}.{node.table}"
                 assigned = splits.get(key, [])
                 connector = self.executor.catalogs.get(node.catalog)
+                if node.constraint is not None and assigned:
+                    # dynamic-filter (and pushed) constraints drop whole
+                    # splits before any read
+                    objs = [
+                        Split(d["table"], d["index"], d["total"], d.get("info"))
+                        for d in assigned
+                    ]
+                    kept = connector.prune_splits(
+                        node.schema, node.table, objs, node.constraint
+                    )
+                    kept_ids = {(s.index, s.total) for s in kept}
+                    assigned = [
+                        d
+                        for d in assigned
+                        if (d["index"], d["total"]) in kept_ids
+                    ]
                 parts: list[list[Batch]] = [[] for _ in range(self.n)]
                 for i, d in enumerate(assigned):
                     parts[i % self.n].append(
@@ -533,6 +597,9 @@ class SqlTask:
                 self.splits, prefetched, source_meta, stats_sink=self.stats
             )
             self.execution_path = "fused"
+            self.stats["dynamic_filters"] = len(
+                runner.executor.dynamic_filters
+            )
             return result
         except (FusedUnsupported, jax.errors.TracerArrayConversionError):
             return None
