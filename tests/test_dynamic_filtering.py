@@ -92,9 +92,10 @@ class TestPushProbeDomain:
         scan_r = P.TableScan("tpch", "tiny", "customer", [sym_r], ["c_custkey"])
         join = P.Join("LEFT", scan_l, scan_r, [(sym_l, sym_r)])
         out = push_probe_domain(join, sym_r, Domain.of_values([5]))
-        # right side of LEFT join is null-extended: must NOT get a constraint
-        assert isinstance(out, P.Filter)  # filter applied above instead
-        assert out.source is join or isinstance(out.source, P.Join)
+        # right side of LEFT join is null-extended: must NOT get a
+        # constraint below NOR a NOT-NULL filter above (it would drop the
+        # null-extended rows the outer join exists to keep)
+        assert out is join
         assert join.right.constraint is None
 
 

@@ -168,6 +168,12 @@ def push_probe_domain(
             return _replace_join_sides(
                 node, node.left, push_probe_domain(node.right, symbol, domain)
             )
+        if name in right_names and node.join_type in ("LEFT", "FULL"):
+            # null-extended side: a NOT-NULL domain filter above the outer
+            # join would drop the very rows the join exists to keep
+            return node
+        if name in left_names and node.join_type in ("RIGHT", "FULL"):
+            return node
         return _filter_above(node, symbol, domain)
 
     if isinstance(node, P.Aggregate):
@@ -216,6 +222,9 @@ def collect_and_push(
     """Shared per-criteria DF core used by the interpreter join and the
     fragment-level paths: build domain -> coerce to the probe type ->
     record stats -> push into the probe plan."""
+    data = np.asarray(data)
+    if data.ndim != 1:
+        return plan_node  # wide-decimal (hi, lo) lanes: no host domain
     domain = domain_from_build(data, valid, build_sym.type)
     if domain is None or domain.is_all():
         return plan_node
@@ -284,9 +293,6 @@ def fragment_dynamic_filters(
             if pair is None:
                 continue
             data, valid = pair
-            data = np.asarray(data)
-            if data.ndim != 1:
-                continue  # wide-decimal lanes: no host domain in v1
             new_root = collect_and_push(
                 new_root, probe_sym, build_sym, data, valid,
                 int(n_rows), stats_out,

@@ -66,6 +66,7 @@ _FUSABLE_NODES = (
     P.Limit,
     P.Sort,
     P.Output,
+    P.Values,
 )
 
 
@@ -101,6 +102,15 @@ def fragment_fusable(frag: PlanFragment) -> bool:
         if not isinstance(n, _FUSABLE_NODES):
             return False
         if isinstance(n, P.Join):
+            if n.join_type in ("SEMI", "ANTI"):
+                # membership marks trace (hash lookup + scatter); residual
+                # correlated filters still interpret
+                if n.filter is not None or any(
+                    _is_wide_type(a.type) or _is_wide_type(b.type)
+                    for a, b in n.criteria
+                ):
+                    return False
+                continue
             if (
                 n.join_type not in ("INNER", "LEFT")
                 or not n.criteria
@@ -1037,6 +1047,8 @@ class _FragmentTracer(DistributedExecutor):
     # --- joins -----------------------------------------------------------
 
     def _exec_join(self, node: P.Join) -> Result:
+        if node.join_type in ("SEMI", "ANTI"):
+            return self._exec_semi_join_traced(node)
         if node.join_type not in ("INNER", "LEFT") or not node.criteria:
             raise FusedUnsupported(f"join {node.join_type}")
         right = self._exec(node.right)
@@ -1044,9 +1056,13 @@ class _FragmentTracer(DistributedExecutor):
         lkeys, rkeys = self._join_keys(left, right, node.criteria)
         ph, _pv = J.hash_keys(lkeys)
         bh, _bv = J.hash_keys(rkeys)
-        build_sharded = not (
+        # per-shard probing needs key-co-partitioned sides, which only a
+        # hash exchange guarantees; any other placement (broadcast, single,
+        # same-fragment subtree) probes a replicated build — XLA inserts
+        # the gather when the value isn't replicated already
+        build_sharded = (
             isinstance(node.right, P.RemoteSource)
-            and node.right.exchange_type == "broadcast"
+            and node.right.exchange_type == "hash"
         )
         probe_cols, probe_schema = [], []
         for s in node.left.output_symbols:
@@ -1109,6 +1125,92 @@ class _FragmentTracer(DistributedExecutor):
             mask = ExprCompiler(work).predicate_mask(expr)
             result = Result(Batch(result.batch.columns, total, mask & out_sel), layout)
         return result
+
+    def _exec_semi_join_traced(self, node: P.Join) -> Result:
+        """SEMI/ANTI as a traced membership mark: probe key rows carry only
+        their global row id through the hash-partitioned lookup, matches
+        scatter back into a boolean mark column (reference:
+        ``HashSemiJoinOperator.java`` — mark semantics incl. 3-valued IN).
+        """
+        left = self._exec(node.left)
+        right = self._exec(node.right)
+        cap = left.batch.capacity
+        lsel = left.batch.selection_mask()
+        bsel = right.batch.selection_mask()
+
+        if not node.criteria:
+            if node.filter is not None:
+                raise FusedUnsupported("uncorrelated EXISTS with filter")
+            nonempty = bsel.any()
+            mark = jnp.broadcast_to(
+                nonempty if node.join_type == "SEMI" else ~nonempty, (cap,)
+            )
+            cols = list(left.batch.columns) + [Column(T.BOOLEAN, mark, None)]
+            layout = dict(left.layout)
+            layout[node.mark_symbol.name] = len(cols) - 1
+            return Result(Batch(cols, cap, left.batch.sel), layout)
+
+        lkeys, rkeys = self._join_keys(left, right, node.criteria)
+        ph, _ = J.hash_keys(lkeys)
+        bh, bv_all = J.hash_keys(rkeys)
+        build_sharded = (
+            isinstance(node.right, P.RemoteSource)
+            and node.right.exchange_type == "hash"
+        )
+        row_ids = jnp.arange(cap, dtype=jnp.int64)
+        probe_cols = [row_ids, jnp.ones(cap, dtype=jnp.bool_)]
+        probe_keys = []
+        for kd, kv in lkeys:
+            probe_keys.extend([kd, kv])
+        build_keys = []
+        for kd, kv in rkeys:
+            build_keys.extend([kd, kv])
+        out_cap = self.caps.get(
+            f"semi{id(node)}",
+            bucket_capacity(max(1024, 2 * cap // max(self.n, 1))),
+        )
+        out_cols, out_sel, ovf = _sharded_probe(
+            self.mesh,
+            probe_cols,
+            probe_keys,
+            ph,
+            lsel,
+            [],  # no build payload — membership only
+            build_keys,
+            bh,
+            bsel,
+            out_cap,
+            "INNER",
+            len(lkeys),
+            build_sharded=build_sharded,
+        )
+        self.overflows.append((f"semi{id(node)}", ovf))
+        match_ids = out_cols[0]
+        matched = (
+            jnp.zeros(cap, dtype=jnp.bool_)
+            .at[jnp.where(out_sel, match_ids, cap)]
+            .set(True, mode="drop")
+        )
+        # 3-valued IN: NULL probe key (or build NULLs without a match)
+        # yields NULL; EXISTS semantics are strict TRUE/FALSE
+        pv = jnp.ones(cap, dtype=jnp.bool_)
+        for _, kv in lkeys:
+            pv = pv & kv
+        build_nonempty = bsel.any()
+        any_null_build = ((~bv_all) & bsel).any()
+        if node.null_aware:
+            valid = jnp.where(
+                build_nonempty,
+                matched | (pv & ~any_null_build),
+                jnp.ones(cap, dtype=jnp.bool_),
+            )
+        else:
+            valid = jnp.ones(cap, dtype=jnp.bool_)
+        value = matched if node.join_type == "SEMI" else ~matched
+        cols = list(left.batch.columns) + [Column(T.BOOLEAN, value, valid)]
+        layout = dict(left.layout)
+        layout[node.mark_symbol.name] = len(cols) - 1
+        return Result(Batch(cols, cap, left.batch.sel), layout)
 
     # --- output exchange --------------------------------------------------
 

@@ -1211,8 +1211,6 @@ class LocalExecutor:
         for lsym, rsym in node.criteria:
             col = build.column(rsym)
             data = np.asarray(col.data)
-            if data.ndim != 1:
-                continue
             valid = np.asarray(col.valid_mask()) & sel
             left_plan = collect_and_push(
                 left_plan, lsym, rsym, data, valid, build_rows,

@@ -84,6 +84,34 @@ def probe_join(
       overflow: bool — total > out_capacity.
     """
     use = probe_valid & probe_sel
+    if probe_hash.shape[0] == 0:
+        # statically empty probe: nothing to emit
+        return (
+            jnp.zeros(out_capacity, dtype=jnp.int32),
+            jnp.full(out_capacity, MISSING, dtype=jnp.int32),
+            jnp.zeros(out_capacity, dtype=jnp.bool_),
+            jnp.int32(0),
+            jnp.asarray(False),
+        )
+    if sorted_build_idx.shape[0] == 0:
+        # statically empty build: no matches; LEFT still emits probe rows
+        n = probe_hash.shape[0]
+        if join_type == "left":
+            ends0 = jnp.cumsum(probe_sel.astype(jnp.int32))
+            t0 = jnp.arange(out_capacity, dtype=jnp.int32)
+            ppos = jnp.searchsorted(ends0, t0, side="right").astype(jnp.int32)
+            ppos = jnp.minimum(ppos, n - 1)  # probe nonempty (guard above)
+            total0 = ends0[-1]
+            osel = t0 < total0
+            bpos = jnp.full(out_capacity, MISSING, dtype=jnp.int32)
+            return ppos, bpos, osel, total0, total0 > out_capacity
+        return (
+            jnp.zeros(out_capacity, dtype=jnp.int32),
+            jnp.full(out_capacity, MISSING, dtype=jnp.int32),
+            jnp.zeros(out_capacity, dtype=jnp.bool_),
+            jnp.int32(0),
+            jnp.asarray(False),
+        )
     maxv = jnp.iinfo(jnp.int64).max
     keys = jnp.where(use, probe_hash, maxv - 1)  # never matches sentinel maxv
     lo = jnp.searchsorted(sorted_build_keys, keys, side="left")
@@ -128,6 +156,9 @@ def verify_equal(probe_keys, build_keys, probe_pos, build_pos, out_sel):
     is_outer = build_pos == MISSING
     safe_build = jnp.where(is_outer, 0, build_pos)
     for (pd, pv), (bd, bv) in zip(probe_keys, build_keys):
+        if pd.shape[0] == 0 or bd.shape[0] == 0:
+            # statically empty side: no equality can hold
+            return out_sel & is_outer
         p_d = pd[probe_pos]
         p_v = pv[probe_pos]
         b_d = bd[safe_build]

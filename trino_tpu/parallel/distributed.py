@@ -609,7 +609,10 @@ def _as_global(mesh: Mesh, arr) -> jax.Array:
     n = mesh.devices.size
     pad = (-a.shape[0]) % n
     if pad:
-        a = jnp.concatenate([a, jnp.zeros((pad,), dtype=a.dtype)])
+        # wide DECIMAL columns carry (N, 2) hi/lo lanes: pad rows only
+        a = jnp.concatenate(
+            [a, jnp.zeros((pad,) + a.shape[1:], dtype=a.dtype)]
+        )
     return jax.device_put(a, row_sharding(mesh))
 
 
@@ -630,6 +633,32 @@ def _sharded_probe(
 ):
     """Per-shard join: build local table from (replicated or co-partitioned)
     build side, probe local rows, expand into fixed capacity."""
+    n = mesh.devices.size
+
+    def pad_side(cols, keys, h, sel):
+        """Kernels reject 0-capacity arrays; pad an empty relation to n
+        unselected rows (one per shard)."""
+        if h.shape[0] > 0:
+            return cols, keys, h, sel
+        cols = [
+            jnp.zeros((n,) + c.shape[1:], dtype=c.dtype) for c in cols
+        ]
+        keys = [
+            jnp.zeros((n,) + k.shape[1:], dtype=k.dtype) for k in keys
+        ]
+        return (
+            cols,
+            keys,
+            jnp.zeros((n,), dtype=h.dtype),
+            jnp.zeros((n,), dtype=jnp.bool_),
+        )
+
+    probe_cols, probe_keys, ph, probe_sel = pad_side(
+        probe_cols, probe_keys, ph, probe_sel
+    )
+    build_cols, build_keys, bh, build_sel = pad_side(
+        build_cols, build_keys, bh, build_sel
+    )
     n_probe = len(probe_cols)
     n_build = len(build_cols)
     build_spec = PS(AXIS) if build_sharded else PS()
