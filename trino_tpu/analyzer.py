@@ -1618,6 +1618,33 @@ class Analyzer:
                 type=T.ArrayType(element=et),
                 value=tuple(it.value for it in coerced),
             )
+        if isinstance(e, t.Subscript):
+            base = rw(e.base)
+            idx = _fold(rw(e.index))
+            bt = base.type
+            if isinstance(bt, T.ArrayType):
+                return call(
+                    "element_at", bt.element, base, _coerce_to(idx, T.BIGINT)
+                )
+            if isinstance(bt, T.MapType):
+                return call(
+                    "map_element_at", bt.value, base, _coerce_to(idx, bt.key)
+                )
+            if isinstance(bt, T.RowType):
+                if not isinstance(idx, Constant) or idx.value is None:
+                    raise SemanticError("ROW subscript must be a constant")
+                i = int(idx.value)
+                if not 1 <= i <= len(bt.fields):
+                    raise SemanticError(
+                        f"ROW subscript {i} out of range 1..{len(bt.fields)}"
+                    )
+                return call(
+                    "row_field", bt.fields[i - 1][1], base,
+                    Constant(type=T.BIGINT, value=i),
+                )
+            raise SemanticError(
+                f"subscript requires ARRAY, MAP, or ROW (got {bt})"
+            )
         if isinstance(e, t.IntervalLiteral):
             return Constant(type=T.UNKNOWN, value=e)  # consumed by date arith
         if isinstance(e, t.UnaryOp):
@@ -1798,15 +1825,66 @@ class Analyzer:
         if name in ("json_extract_scalar", "json_extract"):
             return call(name, T.VARCHAR, *args)
         if name == "cardinality":
+            if isinstance(args[0].type, T.MapType):
+                return call("map_cardinality", T.BIGINT, args[0])
             if not isinstance(args[0].type, T.ArrayType):
-                raise SemanticError("cardinality requires an ARRAY argument")
+                raise SemanticError(
+                    "cardinality requires an ARRAY or MAP argument"
+                )
             return call("cardinality", T.BIGINT, args[0])
         if name == "element_at":
+            if isinstance(args[0].type, T.MapType):
+                mt = args[0].type
+                return call(
+                    "map_element_at", mt.value, args[0],
+                    _coerce_to(args[1], mt.key),
+                )
             if not isinstance(args[0].type, T.ArrayType):
-                raise SemanticError("element_at requires an ARRAY argument")
+                raise SemanticError(
+                    "element_at requires an ARRAY or MAP argument"
+                )
             return call(
                 "element_at", args[0].type.element, args[0],
                 _coerce_to(args[1], T.BIGINT),
+            )
+        if name == "map":
+            # MAP(ARRAY[k...], ARRAY[v...]) constructor (constant v1, like
+            # the ARRAY constructor) -> pool-coded MAP constant
+            if len(args) != 2 or not all(
+                isinstance(a, Constant) and isinstance(a.type, T.ArrayType)
+                for a in args
+            ):
+                raise SemanticError(
+                    "map() requires two constant ARRAY arguments (v1)"
+                )
+            karr, varr = args
+            if karr.value is None or varr.value is None:
+                raise SemanticError("map() arrays must be non-null")
+            if len(karr.value) != len(varr.value):
+                raise SemanticError("map() key/value arrays differ in length")
+            if any(k is None for k in karr.value):
+                raise SemanticError("map key cannot be null")
+            if len(set(karr.value)) != len(karr.value):
+                raise SemanticError("Duplicate map keys are not allowed")
+            # canonical key order: equality/grouping compare pool codes, so
+            # equal maps must pool identically regardless of build order
+            pairs = tuple(sorted(zip(karr.value, varr.value), key=lambda p: p[0]))
+            return Constant(
+                type=T.MapType(key=karr.type.element, value=varr.type.element),
+                value=pairs,
+            )
+        if name == "map_keys" or name == "map_values":
+            # producing a NEW pool column from an expression needs the
+            # projection-level pool plumbing; not wired yet
+            raise SemanticError(f"{name} is not supported yet")
+        if name == "row":
+            if not all(isinstance(a, Constant) for a in args):
+                raise SemanticError("row() fields must be constant (v1)")
+            return Constant(
+                type=T.RowType(
+                    fields=tuple((None, a.type) for a in args)
+                ),
+                value=tuple(a.value for a in args),
             )
         if name == "contains":
             if not isinstance(args[0].type, T.ArrayType):

@@ -482,6 +482,8 @@ class ExprCompiler:
             return self._string_table(expr)
         if name in ("cardinality", "element_at", "array_contains"):
             return self._array_table(expr)
+        if name in ("map_cardinality", "map_element_at", "row_field"):
+            return self._map_row_table(expr)
         if name == "substr_pred":  # reserved for host-eval string predicates
             raise NotImplementedError
         if name == "sqrt":
@@ -766,10 +768,9 @@ class ExprCompiler:
             return _rescale(r, s, rs), valid & (bn != 0)
         raise AssertionError(name)
 
-    def _array_table(self, expr: Call) -> Pair:
-        """Array functions over pool-coded arrays: per-code host lookup
-        tables gathered on device (the dictionary-function pattern —
-        reference scalars: ArrayFunctions / spi/block/ArrayBlock)."""
+    def _pool_for_arg(self, expr: Call):
+        """Shared constant/column scaffolding for pool-coded values
+        (ARRAY/MAP/ROW): returns (codes, valid, pool)."""
         col_e = expr.args[0]
         if isinstance(col_e, Constant):
             from trino_tpu.columnar import Dictionary
@@ -781,13 +782,23 @@ class ExprCompiler:
             d, v = self._eval(col_e)
             pool = self._arg_dictionary(col_e)
         if pool is None:
-            raise ValueError(f"{expr.name} on array column without value pool")
+            raise ValueError(f"{expr.name} on column without value pool")
+        return d, v, pool
+
+    def _pool_length_table(self, entries, d, v) -> Pair:
+        table = np.asarray([len(e) for e in entries] + [0], dtype=np.int64)
+        out = jnp.asarray(table)[jnp.clip(d, 0, len(table) - 1)]
+        return out, v
+
+    def _array_table(self, expr: Call) -> Pair:
+        """Array functions over pool-coded arrays: per-code host lookup
+        tables gathered on device (the dictionary-function pattern —
+        reference scalars: ArrayFunctions / spi/block/ArrayBlock)."""
+        d, v, pool = self._pool_for_arg(expr)
         tuples = pool.values
         name = expr.name
         if name == "cardinality":
-            table = np.asarray([len(t_) for t_ in tuples] + [0], dtype=np.int64)
-            out = jnp.asarray(table)[jnp.clip(d, 0, len(table) - 1)]
-            return out, v
+            return self._pool_length_table(tuples, d, v)
         if name == "element_at":
             idx_e = expr.args[1]
             if not isinstance(idx_e, Constant) or idx_e.value is None:
@@ -809,6 +820,36 @@ class ExprCompiler:
         )
         out = jnp.asarray(table)[jnp.clip(d, 0, len(table) - 1)]
         return out, v
+
+    def _map_row_table(self, expr: Call) -> Pair:
+        """MAP/ROW functions over pool-coded values: per-code host lookup
+        tables gathered on device (the same dictionary-function pattern as
+        arrays — reference: MapBlock/RowBlock accessors)."""
+        d, v, pool = self._pool_for_arg(expr)
+        entries = pool.values
+        name = expr.name
+        if name == "map_cardinality":
+            return self._pool_length_table(entries, d, v)
+        if name == "map_element_at":
+            key_e = expr.args[1]
+            if not isinstance(key_e, Constant) or key_e.value is None:
+                raise NotImplementedError("map subscript key must be a literal")
+            needle = key_e.value
+            vals = []
+            for e in entries:
+                hit = None
+                for k, val in e:
+                    if k == needle:
+                        hit = val
+                        break
+                vals.append(hit)
+            return _pool_values_pair(expr.type, vals, d, v, self)
+        # row_field: 1-based constant index
+        i = int(expr.args[1].value)
+        vals = [
+            (e[i - 1] if 0 < i <= len(e) else None) for e in entries
+        ]
+        return _pool_values_pair(expr.type, vals, d, v, self)
 
     def _compare(self, expr: Call) -> Pair:
         a_e, b_e = expr.args

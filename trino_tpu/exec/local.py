@@ -228,7 +228,7 @@ class LocalExecutor:
             if isinstance(bound, InputRef):
                 cols.append(work_cols[bound.channel])
                 continue
-            if isinstance(sym.type, T.ArrayType):
+            if isinstance(sym.type, (T.ArrayType, T.MapType, T.RowType)):
                 if isinstance(bound, Constant):
                     n = res.batch.capacity
                     if bound.value is None:
@@ -251,7 +251,7 @@ class LocalExecutor:
                         )
                     continue
                 raise ExecutionError(
-                    "computed ARRAY expressions are not supported yet"
+                    "computed ARRAY/MAP/ROW expressions are not supported yet"
                 )
             if T.is_string(sym.type):
                 if isinstance(bound, Constant):

@@ -39,7 +39,7 @@ PAGES_MAGIC = 0xFEA4F001
 # format version: bumped when the per-column layout changes (v2 added the
 # wide-DECIMAL lane flag); readers reject other versions loudly instead of
 # misparsing persisted part files
-PAGES_VERSION = 2
+PAGES_VERSION = 3  # v3: typed dictionary values (ARRAY pools over the wire)
 _CODEC_LZ = 0  # native/columnar.cpp tt_lz_*
 _CODEC_ZLIB = 1
 
@@ -48,6 +48,57 @@ _ENC_PLAIN, _ENC_VARINT, _ENC_RLE, _ENC_BOOL = 0, 1, 2, 3
 
 def _pack_bytes(b: bytes) -> bytes:
     return struct.pack("<q", len(b)) + b
+
+
+# --- typed dictionary values (strings AND array pools) ----------------------
+# ARRAY columns pool distinct array VALUES (python tuples of scalars/None)
+# exactly like varchar pools strings; the wire must carry both
+# (reference: ArrayBlock offsets+values — here pool + codes).
+
+
+def _enc_value(v) -> bytes:
+    if v is None:
+        return b"\x00"
+    if isinstance(v, bool):
+        return b"\x01" + (b"\x01" if v else b"\x00")
+    if isinstance(v, (int, np.integer)):
+        return b"\x02" + struct.pack("<q", int(v))
+    if isinstance(v, (float, np.floating)):
+        return b"\x03" + struct.pack("<d", float(v))
+    if isinstance(v, str):
+        b = v.encode("utf-8", "surrogatepass")
+        return b"\x04" + struct.pack("<i", len(b)) + b
+    if isinstance(v, tuple):
+        return b"\x05" + struct.pack("<i", len(v)) + b"".join(
+            _enc_value(e) for e in v
+        )
+    raise ValueError(f"unsupported dictionary value type {type(v)!r}")
+
+
+def _dec_value(buf: bytes, pos: int):
+    tag = buf[pos]
+    pos += 1
+    if tag == 0:
+        return None, pos
+    if tag == 1:
+        return buf[pos] == 1, pos + 1
+    if tag == 2:
+        return struct.unpack_from("<q", buf, pos)[0], pos + 8
+    if tag == 3:
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if tag == 4:
+        (ln,) = struct.unpack_from("<i", buf, pos)
+        pos += 4
+        return buf[pos : pos + ln].decode("utf-8", "surrogatepass"), pos + ln
+    if tag == 5:
+        (ln,) = struct.unpack_from("<i", buf, pos)
+        pos += 4
+        out = []
+        for _ in range(ln):
+            v, pos = _dec_value(buf, pos)
+            out.append(v)
+        return tuple(out), pos
+    raise ValueError(f"corrupt dictionary value tag {tag}")
 
 
 class _Reader:
@@ -99,10 +150,8 @@ def serialize_batch(batch: Batch, compress: bool = True) -> bytes:
         if has_valid:
             parts.append(_pack_bytes(bitpack_encode(valid.astype(np.uint64), 1)))
         if has_dict:
-            # length-prefix each value: SQL strings may contain NUL
-            vals = [v.encode("utf-8", "surrogatepass") for v in c.dictionary.values]
-            blob = b"".join(struct.pack("<i", len(v)) + v for v in vals)
-            parts.append(struct.pack("<q", len(vals)))
+            blob = b"".join(_enc_value(v) for v in c.dictionary.values)
+            parts.append(struct.pack("<q", len(c.dictionary.values)))
             parts.append(_pack_bytes(blob))
         lanes = [data[:, 0], data[:, 1]] if is_wide else [data]
         for lane in lanes:
@@ -169,10 +218,8 @@ def deserialize_batch(data: bytes) -> Batch:
             values = []
             pos = 0
             for _ in range(dict_len):
-                (vlen,) = struct.unpack_from("<i", blob, pos)
-                pos += 4
-                values.append(blob[pos : pos + vlen].decode("utf-8", "surrogatepass"))
-                pos += vlen
+                v, pos = _dec_value(blob, pos)
+                values.append(v)
             dictionary = Dictionary(values)
         dtype = ty.storage_dtype
         lanes = []

@@ -652,7 +652,15 @@ class Parser:
         if self.at_op("-", "+"):
             op = self.next().text
             return t.UnaryOp(op, self._unary())
-        return self._primary()
+        out = self._primary()
+        # postfix subscript: base[index] (ARRAY element / MAP value / ROW
+        # field — reference SqlBase.g4 subscript rule)
+        while self.at_op("["):
+            self.next()
+            idx = self.expression()
+            self.expect_op("]")
+            out = t.Subscript(out, idx)
+        return out
 
     def _primary(self) -> t.Node:
         tok = self.peek()

@@ -204,6 +204,14 @@ class ArrayLiteral(Node):
     items: tuple[Node, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class Subscript(Node):
+    """``base[index]`` — ARRAY element, MAP value, or ROW field access."""
+
+    base: Node
+    index: Node
+
+
 # --- query structure -------------------------------------------------------
 
 

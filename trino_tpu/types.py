@@ -240,6 +240,77 @@ class ArrayType(SqlType):
 
 
 @dataclasses.dataclass(frozen=True)
+class MapType(SqlType):
+    """MAP(key, value). Pool-coded like ARRAY: int32 codes into a host
+    pool of distinct map VALUES, each a tuple of (key, value) pairs in
+    insertion order. Equality/grouping/joining work on codes.
+    Reference: ``spi/block/MapBlock.java`` (offsets + key/value blocks)."""
+
+    key: SqlType = None  # type: ignore[assignment]
+    value: SqlType = None  # type: ignore[assignment]
+    name: str = ""
+
+    def __post_init__(self):
+        object.__setattr__(self, "name", f"map({self.key}, {self.value})")
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+    def to_python(self, v, dictionary=None):
+        if dictionary is None:
+            raise ValueError("map column without value pool")
+        pairs = dictionary.decode(int(v))
+        if pairs is None:
+            return None
+        out = {}
+        for k, val in pairs:
+            kk = k if isinstance(k, str) else self.key.to_python(k, None)
+            vv = (
+                None
+                if val is None
+                else (val if isinstance(val, str) else self.value.to_python(val, None))
+            )
+            out[kk] = vv
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class RowType(SqlType):
+    """ROW(f0, f1, ...). Pool-coded: int32 codes into a host pool of
+    distinct row VALUES (tuples of field storage scalars).
+    Reference: ``spi/block/RowBlock.java`` (parallel field blocks)."""
+
+    fields: tuple = ()  # tuple[(name or None, SqlType), ...]
+    name: str = ""
+
+    def __post_init__(self):
+        inner = ", ".join(
+            f"{n} {t}" if n else str(t) for n, t in self.fields
+        )
+        object.__setattr__(self, "name", f"row({inner})")
+
+    @property
+    def storage_dtype(self):
+        return np.dtype(np.int32)
+
+    def to_python(self, v, dictionary=None):
+        if dictionary is None:
+            raise ValueError("row column without value pool")
+        tup = dictionary.decode(int(v))
+        if tup is None:
+            return None
+        out = []
+        for (fname, ft), e in zip(self.fields, tup):
+            out.append(
+                None
+                if e is None
+                else (e if isinstance(e, str) else ft.to_python(e, None))
+            )
+        return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
 class UnknownType(SqlType):
     """The type of a bare NULL literal (reference: ``spi/type/UnknownType``)."""
 
@@ -343,6 +414,7 @@ def parse_type(text: str) -> SqlType:
         "date": DATE,
         "timestamp": TIMESTAMP,
         "varchar": VARCHAR,
+        "unknown": UNKNOWN,  # NULL-typed fields inside row(...) on the wire
     }
     if t in simple:
         return simple[t]
@@ -356,4 +428,44 @@ def parse_type(text: str) -> SqlType:
     if t.startswith("char"):
         inner = t[t.index("(") + 1 : t.index(")")]
         return char(int(inner))
+    if t.startswith("array(") and t.endswith(")"):
+        return ArrayType(element=parse_type(t[6:-1]))
+    if t.startswith("map(") and t.endswith(")"):
+        k, v = _split_top(t[4:-1])
+        return MapType(key=parse_type(k), value=parse_type(v))
+    if t.startswith("row(") and t.endswith(")"):
+        fields = []
+        for part in _split_all_top(t[4:-1]):
+            part = part.strip()
+            bits = part.split(" ", 1)
+            if len(bits) == 2 and not bits[0].endswith(","):
+                try:
+                    fields.append((bits[0], parse_type(bits[1])))
+                    continue
+                except ValueError:
+                    pass
+            fields.append((None, parse_type(part)))
+        return RowType(fields=tuple(fields))
     raise ValueError(f"cannot parse type: {text!r}")
+
+
+def _split_all_top(s: str) -> list[str]:
+    """Split on commas at paren depth 0."""
+    out, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(s[start:i])
+            start = i + 1
+    out.append(s[start:])
+    return out
+
+
+def _split_top(s: str) -> tuple[str, str]:
+    parts = _split_all_top(s)
+    if len(parts) != 2:
+        raise ValueError(f"expected two type arguments in {s!r}")
+    return parts[0], parts[1]
