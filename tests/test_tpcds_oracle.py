@@ -47,6 +47,12 @@ def harness():
             if rows:
                 ph = ", ".join("?" * len(names))
                 db.executemany(f"insert into {table} values ({ph})", rows)
+        # index every *_sk column: sqlite's nested-loop joins otherwise
+        # turn the 5-table disjunctive-join queries (Q48 family) into
+        # minutes of oracle time per query
+        for c in names:
+            if c.endswith("_sk") or c.endswith("_number"):
+                db.execute(f"create index idx_{table}_{c} on {table} ({c})")
     db.commit()
     return runner, db
 
