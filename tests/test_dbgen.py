@@ -291,3 +291,43 @@ class TestEngineParity:
         ]}
         for key in ctr:
             assert got[key] == (qsum[key], ctr[key])
+
+
+@pytest.mark.skipif(
+    not os.environ.get("TRINO_TPU_SF1_ENGINE"),
+    reason="SF1 engine run takes minutes; set TRINO_TPU_SF1_ENGINE=1",
+)
+class TestSf1ThroughEngine:
+    def test_q1_matches_published_answer_set(self):
+        """Parse -> plan -> fragment -> streamed fused execution over SF1
+        must reproduce the published TPC-H Q1 answers exactly (verified
+        interactively on the TPU; opt-in for suite time)."""
+        from decimal import Decimal
+
+        from trino_tpu.testing import LocalQueryRunner
+
+        r = LocalQueryRunner()
+        r.session.set("execution_mode", "distributed")
+        r.session.set("stream_group_budget", 1 << 14)
+        rows, _ = r.execute(
+            """select l_returnflag, l_linestatus, sum(l_quantity),
+                      sum(l_extendedprice),
+                      sum(l_extendedprice * (1 - l_discount)), count(*)
+               from tpch.sf1.lineitem
+               where l_shipdate <= date '1998-09-02'
+               group by l_returnflag, l_linestatus
+               order by l_returnflag, l_linestatus"""
+        )
+        want = {
+            ("A", "F"): (37734107, "56586554400.73", "53758257134.8700", 1478493),
+            ("N", "F"): (991417, "1487504710.38", "1413082168.0541", 38854),
+            ("N", "O"): (74476040, "111701729697.74", "106118230307.6056", 2920374),
+            ("R", "F"): (37719753, "56568041380.90", "53741292684.6040", 1478870),
+        }
+        assert len(rows) == 4
+        for row in rows:
+            w = want[(row[0], row[1])]
+            assert int(row[2]) == w[0]
+            assert row[3] == Decimal(w[1])
+            assert row[4] == Decimal(w[2])
+            assert row[5] == w[3]
