@@ -1,4 +1,4 @@
-"""Group-by aggregation via sort + segment-reduce.
+"""Group-by aggregation via sort + sorted-segment reductions.
 
 Reference semantics: ``operator/HashAggregationOperator.java:49`` +
 ``operator/MultiChannelGroupByHash.java:55`` (open-addressing hash group-by)
@@ -8,8 +8,22 @@ and the aggregation function triple input/combine/output
 TPU-first design: instead of a linear-probing hash table (scatter-heavy,
 serial), we lexicographically sort rows by the group keys with ``lax.sort``
 (TPU has a fast bitonic sort), mark group boundaries, assign dense group ids
-with a cumulative sum, and reduce with ``jax.ops.segment_sum``-family ops —
-all MXU/VPU-friendly, fully static shapes.
+with a cumulative sum, and reduce over the *sorted* segments — all
+MXU/VPU-friendly, fully static shapes.
+
+Scatter-free: XLA scatter (``segment_sum`` / ``.at[].set``) lowers to a
+serialized update loop on TPU (~80ms per 1M rows measured vs ~1ms for a
+cumsum). Because rows are already sorted by group, every reduction is
+expressible without scatter:
+- segment boundary positions compact to the front of one cheap
+  ``(bool, int32)`` sort (see :class:`_SortedSegments`);
+- integer sums are exclusive-cumsum differences at the boundaries (exact:
+  int64 wraparound is modular, so boundary differences recover any
+  segment sum that itself fits in 64 bits);
+- min/max re-sort ``(group_id, masked value)`` — bitonic sort is ~40x
+  cheaper than scatter here — and gather the first/last row per segment;
+- group keys gather the first row of each segment.
+Float sums keep ``segment_sum`` (a global cumsum would change rounding).
 
 Partial/final split: the same kernel serves both; COUNT partials re-aggregate
 with SUM, AVG decomposes into SUM+COUNT (exactly Trino's
@@ -98,36 +112,61 @@ def group_aggregate(
             ops.append(jnp.where(valid, data, jnp.zeros_like(data)))
         key_pos.append((vi, di))
     num_keys = len(ops)
-    sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=num_keys)
-    perm = sorted_ops[-1]
+    # aggregate inputs ride the sort as payload operands: bitonic payload
+    # moves are near-contiguous vector ops, ~17x cheaper here than the
+    # random 1M-row gathers a post-sort ``data[perm]`` would need
+    payload: list = []
+    payload_pos: dict[tuple, tuple] = {}
+    for pair in agg_inputs:
+        if pair is None:
+            continue
+        pid = (id(pair[0]), id(pair[1]))
+        if pid in payload_pos:
+            continue
+        data, valid = pair
+        base = num_keys + len(payload)
+        if getattr(data, "ndim", 1) == 2:
+            payload.extend([data[:, 0], data[:, 1], valid])
+            payload_pos[pid] = (base, base + 1, base + 2)
+        else:
+            payload.extend([data, valid])
+            payload_pos[pid] = (base, base + 1)
+    sorted_ops = jax.lax.sort(tuple(ops) + tuple(payload), num_keys=num_keys)
     s_sel = ~sorted_ops[0]
 
+    def _sorted_pair(pair):
+        pos = payload_pos[(id(pair[0]), id(pair[1]))]
+        if len(pos) == 3:
+            return (
+                jnp.stack([sorted_ops[pos[0]], sorted_ops[pos[1]]], axis=1),
+                sorted_ops[pos[2]],
+            )
+        return sorted_ops[pos[0]], sorted_ops[pos[1]]
+
     # boundary: first row, or any sort key changed vs previous row
-    changed = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+    changed = idx == 0
     for k in sorted_ops[:num_keys]:
         prev = jnp.concatenate([k[:1], k[:-1]])
         changed = changed | (k != prev)
     changed = changed & s_sel
     group_id = jnp.cumsum(changed.astype(jnp.int32)) - 1
-    # unselected rows -> out-of-range id (dropped by segment ops/'drop' mode)
+    # unselected rows sort past selected ones -> monotonic out-of-range id
     group_id = jnp.where(s_sel, group_id, max_groups)
     num_groups = jnp.sum(changed.astype(jnp.int32))
     overflow = num_groups > max_groups
 
-    # group key output: scatter first-row-of-group values
+    seg = _SortedSegments(changed, s_sel, group_id, num_groups, max_groups, n)
+
+    # group key output: gather the first row of each segment
     out_key_data, out_key_valid = [], []
     for (data, valid), (vi, di) in zip(keys, key_pos):
         s_valid = ~sorted_ops[vi]
-        kv = jnp.zeros((max_groups,), dtype=jnp.bool_).at[group_id].set(
-            s_valid, mode="drop"
-        )
+        kv = seg.first(s_valid) & seg.nonempty
         lanes_out = []
         for d_idx in di:
             s_data = sorted_ops[d_idx]
             lanes_out.append(
-                jnp.zeros((max_groups,), dtype=s_data.dtype).at[group_id].set(
-                    s_data, mode="drop"
-                )
+                jnp.where(seg.nonempty, seg.first(s_data), jnp.zeros((), s_data.dtype))
             )
         if len(lanes_out) == 2:
             out_key_data.append(jnp.stack(lanes_out, axis=1).astype(data.dtype))
@@ -138,71 +177,119 @@ def group_aggregate(
     results = []
     for spec, pair in zip(agg_specs, agg_inputs):
         if spec.kind == "count_star":
-            ones = jnp.ones(n, dtype=jnp.int64)
-            results.append(
-                jax.ops.segment_sum(ones, group_id, num_segments=max_groups)
-            )
+            results.append(seg.sizes.astype(jnp.int64))
             continue
-        data, valid = pair
-        s_data = data[perm]
-        s_valid = valid[perm]
+        s_data, s_valid = _sorted_pair(pair)
         if spec.kind in ("sum128", "sum128w"):
             from trino_tpu.ops import decimal128 as D
 
-            cnt = jax.ops.segment_sum(
-                s_valid.astype(jnp.int64), group_id, num_segments=max_groups
-            )
+            cnt = seg.sum(s_valid.astype(jnp.int64))
             if spec.kind == "sum128":
-                limbs = D.narrow_limb_sums(s_data, s_valid, group_id, max_groups)
+                limbs = D.narrow_limb_sums(s_data, s_valid, seg.sum)
             else:
                 limbs = D.wide_limb_sums(
-                    s_data[:, 0], s_data[:, 1], s_valid, group_id, max_groups
+                    s_data[:, 0], s_data[:, 1], s_valid, seg.sum
                 )
             results.append((limbs, cnt))
             continue
         if spec.kind == "count":
-            results.append(
-                jax.ops.segment_sum(
-                    s_valid.astype(jnp.int64), group_id, num_segments=max_groups
-                )
-            )
+            results.append(seg.sum(s_valid.astype(jnp.int64)))
         elif spec.kind in ("sum", "avg"):
             contrib = jnp.where(s_valid, s_data, jnp.zeros_like(s_data))
-            ssum = jax.ops.segment_sum(contrib, group_id, num_segments=max_groups)
-            if spec.kind == "sum":
-                cnt = jax.ops.segment_sum(
-                    s_valid.astype(jnp.int64), group_id, num_segments=max_groups
-                )
-                # SQL: sum over empty/all-null group is NULL — caller uses cnt
-                results.append((ssum, cnt))
-            else:
-                cnt = jax.ops.segment_sum(
-                    s_valid.astype(jnp.int64), group_id, num_segments=max_groups
-                )
-                results.append((ssum, cnt))
+            ssum = seg.sum(contrib)
+            cnt = seg.sum(s_valid.astype(jnp.int64))
+            # SQL: sum over empty/all-null group is NULL — caller uses cnt
+            results.append((ssum, cnt))
         elif spec.kind in ("min", "max"):
-            cnt = jax.ops.segment_sum(
-                s_valid.astype(jnp.int64), group_id, num_segments=max_groups
-            )
+            cnt = seg.sum(s_valid.astype(jnp.int64))
             if getattr(s_data, "ndim", 1) == 2:
-                from trino_tpu.ops.decimal128 import segment_minmax_wide
+                from trino_tpu.ops.decimal128 import sort_operands_wide
 
-                bh, bl = segment_minmax_wide(
-                    s_data[:, 0], s_data[:, 1], s_valid, group_id,
-                    max_groups, spec.kind,
-                )
-                results.append((jnp.stack([bh, bl], axis=1), cnt))
-            elif spec.kind == "min":
-                masked = jnp.where(s_valid, s_data, _max_ident(s_data.dtype))
-                m = jax.ops.segment_min(masked, group_id, num_segments=max_groups)
-                results.append((m, cnt))
+                hi, lo = s_data[:, 0], s_data[:, 1]
+                ident = _max_ident(hi.dtype) if spec.kind == "min" else _min_ident(hi.dtype)
+                hk, lk = sort_operands_wide(hi, lo)
+                hk = jnp.where(s_valid, hk, ident)
+                lk = jnp.where(s_valid, lk, ident)
+                bh, blk = seg.extreme2(hk, lk, spec.kind)
+                from trino_tpu.ops.decimal128 import _SIGNBIT
+
+                results.append((jnp.stack([bh, blk ^ _SIGNBIT], axis=1), cnt))
             else:
-                masked = jnp.where(s_valid, s_data, _min_ident(s_data.dtype))
-                m = jax.ops.segment_max(masked, group_id, num_segments=max_groups)
-                results.append((m, cnt))
+                ident = (
+                    _max_ident(s_data.dtype)
+                    if spec.kind == "min"
+                    else _min_ident(s_data.dtype)
+                )
+                masked = jnp.where(s_valid, s_data, ident)
+                results.append((seg.extreme(masked, spec.kind), cnt))
         else:
             raise NotImplementedError(spec.kind)
     return (out_key_data, out_key_valid), results, num_groups, overflow
+
+
+class _SortedSegments:
+    """Scatter-free reductions over rows sorted by a monotonic group id.
+
+    ``starts[g]`` is the first sorted-row index of group ``g``; every
+    reduction is then a cumsum difference or a boundary gather. Boundary
+    positions come from one cheap ``(bool, int32)`` sort — stably sorting
+    row indices by "is not a group boundary" compacts the boundary
+    positions to the front (a ``searchsorted`` over the 1M-row group-id
+    array costs ~5x more here: its binary-search rounds serialize, while
+    one more bitonic sort rides the same fast path the main sort uses).
+    """
+
+    def __init__(self, changed, s_sel, group_id_sorted, num_groups,
+                 max_groups: int, n: int):
+        idx = jnp.arange(n, dtype=jnp.int32)
+        g = min(max_groups + 1, n)
+        _, pos = jax.lax.sort((~changed, idx), num_keys=1)
+        pos = pos[:g]
+        if g < max_groups + 1:  # tiny batch: fewer rows than groups
+            pos = jnp.concatenate(
+                [pos, jnp.zeros(max_groups + 1 - g, dtype=jnp.int32)]
+            )
+        n_sel = jnp.sum(s_sel.astype(jnp.int32))
+        live = jnp.arange(max_groups + 1, dtype=jnp.int32) < num_groups
+        self.starts = jnp.where(live, pos, n_sel)
+        self.sizes = self.starts[1:] - self.starts[:-1]
+        self.nonempty = self.sizes > 0
+        self._gid = group_id_sorted
+        self._max_groups = max_groups
+        hi = max(n - 1, 0)
+        self._first_idx = jnp.clip(self.starts[:-1], 0, hi)
+        self._last_idx = jnp.clip(self.starts[1:] - 1, 0, hi)
+
+    def first(self, x):
+        """x gathered at each segment's first row (junk for empty segs)."""
+        return x[self._first_idx]
+
+    def sum(self, x):
+        """Per-segment sum via exclusive-cumsum boundary differences.
+
+        Exact for integers (modular wraparound cancels); floats keep the
+        scatter path so per-segment rounding stays left-to-right instead
+        of accumulating across the whole chunk.
+        """
+        import numpy as np
+
+        if not np.issubdtype(np.dtype(x.dtype), np.integer):
+            return jax.ops.segment_sum(
+                x, self._gid, num_segments=self._max_groups
+            )
+        csz = jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)])
+        return csz[self.starts[1:]] - csz[self.starts[:-1]]
+
+    def extreme(self, masked, kind: str):
+        """Per-segment min/max of pre-masked values via one extra sort."""
+        _, sv = jax.lax.sort((self._gid, masked), num_keys=2)
+        return sv[self._first_idx] if kind == "min" else sv[self._last_idx]
+
+    def extreme2(self, k1, k2, kind: str):
+        """Lexicographic two-lane min/max (wide DECIMAL) via one sort."""
+        _, s1, s2 = jax.lax.sort((self._gid, k1, k2), num_keys=3)
+        i = self._first_idx if kind == "min" else self._last_idx
+        return s1[i], s2[i]
 
 
 def distinct_first_mask(
@@ -215,8 +302,8 @@ def distinct_first_mask(
     (reference: ``MarkDistinctOperator.java`` / distinct accumulators).
 
     Sort-based: lexicographically sort (sel, keys..., value), mark rows where
-    any component differs from the previous row, and scatter the marks back
-    through the permutation.
+    any component differs from the previous row, and restore original row
+    order with a second (scatter-free) sort on the permutation.
     """
     n = sel.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
@@ -225,12 +312,15 @@ def distinct_first_mask(
     sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=num_keys)
     perm = sorted_ops[-1]
     s_sel = ~sorted_ops[0]
-    changed = jnp.zeros(n, dtype=jnp.bool_).at[0].set(True)
+    changed = idx == 0
     for k in sorted_ops[:num_keys]:
         prev = jnp.concatenate([k[:1], k[:-1]])
         changed = changed | (k != prev)
     first_sorted = changed & s_sel
-    return jnp.zeros(n, dtype=jnp.bool_).at[perm].set(first_sorted)
+    # invert the permutation with a second sort (scatter-free): sorting
+    # (perm, mask) by perm restores original row order for the mask
+    _, out = jax.lax.sort((perm, first_sorted), num_keys=1)
+    return out
 
 
 def global_aggregate(
@@ -250,11 +340,11 @@ def global_aggregate(
         if spec.kind in ("sum128", "sum128w"):
             from trino_tpu.ops import decimal128 as D
 
-            gid = jnp.zeros(sel.shape[0], dtype=jnp.int32)
+            total = lambda x: jnp.reshape(jnp.sum(x), (1,))  # noqa: E731
             if spec.kind == "sum128":
-                limbs = D.narrow_limb_sums(data, use, gid, 1)
+                limbs = D.narrow_limb_sums(data, use, total)
             else:
-                limbs = D.wide_limb_sums(data[:, 0], data[:, 1], use, gid, 1)
+                limbs = D.wide_limb_sums(data[:, 0], data[:, 1], use, total)
             results.append((limbs, cnt))
             continue
         if spec.kind == "count":

@@ -178,8 +178,12 @@ def _limbs32_from_i64(v):
     ).astype(jnp.int64)
 
 
-def narrow_limb_sums(data, weights_valid, group_id, max_groups):
+def narrow_limb_sums(data, weights_valid, seg_sum):
     """Per-group exact sums of int64 values via 32-bit limb accumulation.
+
+    ``seg_sum(x) -> (G,)`` performs the per-group reduction (the caller
+    owns the grouping strategy — sorted-segment cumsum differences in
+    :mod:`trino_tpu.ops.aggregation`, a plain ``jnp.sum`` for globals).
 
     Returns (G, 3) int64: [limb0_sum, limb1_sum, neg_count] where the true
     per-group sum = limb0 + limb1*2^32 - neg_count*2^64 (two's complement
@@ -189,16 +193,14 @@ def narrow_limb_sums(data, weights_valid, group_id, max_groups):
     l0 = jnp.where(weights_valid, l0, z)
     l1 = jnp.where(weights_valid, l1, z)
     neg = jnp.where(weights_valid & (data < 0), jnp.ones_like(data), z)
-    s0 = jax.ops.segment_sum(l0, group_id, num_segments=max_groups)
-    s1 = jax.ops.segment_sum(l1, group_id, num_segments=max_groups)
-    sn = jax.ops.segment_sum(neg, group_id, num_segments=max_groups)
-    return jnp.stack([s0, s1, sn], axis=1)
+    return jnp.stack([seg_sum(l0), seg_sum(l1), seg_sum(neg)], axis=1)
 
 
-def wide_limb_sums(hi, lo, weights_valid, group_id, max_groups):
+def wide_limb_sums(hi, lo, weights_valid, seg_sum):
     """Per-group sums of (hi, lo) wide values as 5 limb columns:
     [lo0, lo1, hi0, hi1, hi_neg]; true sum = lo0 + lo1*2^32 +
-    (hi0 + hi1*2^32 - hi_neg*2^64)*2^64 (exact in Python)."""
+    (hi0 + hi1*2^32 - hi_neg*2^64)*2^64 (exact in Python).
+    ``seg_sum`` as in :func:`narrow_limb_sums`."""
     lo0, lo1 = _limbs32_from_i64(lo)
     hi0, hi1 = _limbs32_from_i64(hi)
     z = jnp.zeros_like(lo)
@@ -207,11 +209,9 @@ def wide_limb_sums(hi, lo, weights_valid, group_id, max_groups):
     hi0 = jnp.where(weights_valid, hi0, z)
     hi1 = jnp.where(weights_valid, hi1, z)
     neg = jnp.where(weights_valid & (hi < 0), jnp.ones_like(lo), z)
-    cols = [
-        jax.ops.segment_sum(c, group_id, num_segments=max_groups)
-        for c in (lo0, lo1, hi0, hi1, neg)
-    ]
-    return jnp.stack(cols, axis=1)
+    return jnp.stack(
+        [seg_sum(c) for c in (lo0, lo1, hi0, hi1, neg)], axis=1
+    )
 
 
 def _shl32_128(v):
@@ -259,31 +259,22 @@ def rescale_up_wide(hi, lo, digits: int):
     return hi, lo
 
 
-def segment_minmax_wide(hi, lo, use, group_id, max_groups, kind: str):
-    """Per-group min/max of (hi, lo) wide values: lexicographic two-pass —
-    extreme of the signed hi lane, then extreme of the unsigned lo lane
-    among rows tied on hi. Returns (hi_out, lo_out) of shape (G,)."""
+def global_minmax_wide(hi, lo, use, kind: str):
+    """min/max of (hi, lo) wide values over selected rows: lexicographic
+    two-pass — extreme of the signed hi lane, then extreme of the unsigned
+    lo lane among rows tied on hi. Returns scalar-shaped (hi, lo)."""
     i64 = jnp.int64
     if kind == "max":
-        ident_hi = jnp.asarray(np.iinfo(np.int64).min, dtype=i64)
-        seg = jax.ops.segment_max
+        ident = jnp.asarray(np.iinfo(np.int64).min, dtype=i64)
+        red = jnp.max
     else:
-        ident_hi = jnp.asarray(np.iinfo(np.int64).max, dtype=i64)
-        seg = jax.ops.segment_min
-    h = jnp.where(use, hi, ident_hi)
-    best_hi = seg(h, group_id, num_segments=max_groups)
-    tied = use & (hi == best_hi[jnp.clip(group_id, 0, max_groups - 1)])
+        ident = jnp.asarray(np.iinfo(np.int64).max, dtype=i64)
+        red = jnp.min
+    best_hi = red(jnp.where(use, hi, ident))
+    tied = use & (hi == best_hi)
     lo_key = lo ^ _SIGNBIT  # unsigned order in signed lanes
-    l = jnp.where(tied, lo_key, ident_hi)
-    best_lo_key = seg(l, group_id, num_segments=max_groups)
-    return best_hi, best_lo_key ^ _SIGNBIT
-
-
-def global_minmax_wide(hi, lo, use, kind: str):
-    bh, bl = segment_minmax_wide(
-        hi, lo, use, jnp.zeros(hi.shape[0], dtype=jnp.int32), 1, kind
-    )
-    return bh, bl
+    best_lo_key = red(jnp.where(tied, lo_key, ident))
+    return jnp.reshape(best_hi, (1,)), jnp.reshape(best_lo_key ^ _SIGNBIT, (1,))
 
 
 def narrow_sums_to_ints(sums: np.ndarray) -> list[int]:
