@@ -650,11 +650,15 @@ int64_t tt_orc_byte_rle(const uint8_t* buf, int64_t buf_len, int64_t count,
         uint8_t ctrl = buf[pos++];
         if (ctrl < 128) {
             int run = ctrl + 3;
+            if (pos >= buf_len) return -1;
             uint8_t v = buf[pos++];
             for (int i = 0; i < run && filled < count; i++) out[filled++] = v;
         } else {
             int lit = 256 - ctrl;
-            for (int i = 0; i < lit && filled < count; i++) out[filled++] = buf[pos++];
+            for (int i = 0; i < lit && filled < count; i++) {
+                if (pos >= buf_len) return -1;
+                out[filled++] = buf[pos++];
+            }
         }
     }
     return pos;

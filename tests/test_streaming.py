@@ -125,3 +125,43 @@ class TestStreamingAggregation:
             S.streamable_chain(f.root) for f in sub.all_fragments()
         ]
         assert any(c is not None for c in chains)
+
+
+class TestStreamingSplitDictionaries:
+    """Per-split string dictionaries must not corrupt streamed group keys
+    or min/max state (advisor round-3 high finding): every split gets its
+    own Dictionary, so the stream remaps codes onto one running dictionary
+    (or falls back when the trace embedded rank tables that growth would
+    invalidate). Both paths must equal the interpreter."""
+
+    @pytest.fixture(scope="class")
+    def split_streaming(self):
+        from trino_tpu.connectors.tpch import TpchConnector
+
+        r = DistributedQueryRunner()
+        r.engine.catalogs.register("tpchsplit", TpchConnector(split_rows=2048))
+        r.session.set("stream_scan_threshold_rows", 1000)
+        r.session.set("stream_chunk_rows", 4096)
+        return r
+
+    @pytest.fixture(scope="class")
+    def split_local(self, split_streaming):
+        # share the engine so both runners see the same generated data
+        r = LocalQueryRunner(engine=split_streaming.engine)
+        return r
+
+    def test_group_by_string_across_splits(self, split_streaming, split_local):
+        sql = """select o_clerk, count(*), sum(o_totalprice)
+                 from tpchsplit.tiny.orders group by o_clerk
+                 order by o_clerk limit 20"""
+        got, _ = split_streaming.execute(sql)
+        want, _ = split_local.execute(sql)
+        assert got == want
+
+    def test_minmax_string_across_splits(self, split_streaming, split_local):
+        sql = """select o_orderpriority, min(o_comment), max(o_comment)
+                 from tpchsplit.tiny.orders group by o_orderpriority
+                 order by o_orderpriority"""
+        got, _ = split_streaming.execute(sql)
+        want, _ = split_local.execute(sql)
+        assert got == want

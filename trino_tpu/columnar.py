@@ -17,6 +17,7 @@ Design (TPU-first):
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Iterable, Sequence
 
 import jax
@@ -25,15 +26,38 @@ import numpy as np
 
 from trino_tpu import types as T
 
+_trace_tls = threading.local()
+
 
 class Dictionary:
     """Host-side string dictionary. Code i <-> string values[i].
 
     Codes are dense int32. ``sorted_ranks`` supports order comparisons on
     codes (rank[code] preserves lexicographic order) without device strings.
+
+    A *trace log* (opened per-thread via :meth:`begin_trace_log`, since
+    jax traces on the calling thread and worker tasks trace concurrently)
+    records which dictionaries contributed *growth-sensitive* constants to
+    a trace: rank tables, and equality encodes that missed. Streaming uses
+    this to decide whether appending values to a dictionary mid-stream
+    would invalidate an already-compiled step (see ``exec/streaming.py``).
     """
 
     __slots__ = ("values", "_index", "_ranks")
+
+    @staticmethod
+    def begin_trace_log():
+        """Open a fresh per-thread log; returns the previous one to restore."""
+        prev = getattr(_trace_tls, "log", None)
+        _trace_tls.log = {}
+        return prev
+
+    @staticmethod
+    def end_trace_log(prev) -> dict:
+        """Close the current per-thread log (restoring ``prev``) and return it."""
+        log = getattr(_trace_tls, "log", None)
+        _trace_tls.log = prev
+        return log or {}
 
     def __init__(self, values: Sequence[str]):
         self.values: list[str] = list(values)
@@ -55,16 +79,55 @@ class Dictionary:
 
     def encode(self, value: str) -> int:
         """Code for value, or -1 if absent (useful for predicates)."""
-        return self.index().get(value, -1)
+        code = self.index().get(value, -1)
+        log = getattr(_trace_tls, "log", None)
+        if code < 0 and log is not None:
+            # a miss traced as the constant -1 stops being correct if this
+            # dictionary later absorbs the value
+            log.setdefault("growth_sensitive", set()).add(id(self))
+        return code
 
     def ranks(self) -> np.ndarray:
         """rank[code] gives the lexicographic rank of each dictionary entry."""
+        log = getattr(_trace_tls, "log", None)
+        if log is not None:
+            log.setdefault("growth_sensitive", set()).add(id(self))
         if self._ranks is None:
             order = np.argsort(np.asarray(self.values, dtype=object), kind="stable")
             ranks = np.empty(len(self.values), dtype=np.int32)
             ranks[order] = np.arange(len(self.values), dtype=np.int32)
             self._ranks = ranks
         return self._ranks
+
+    def absorb(self, other: "Dictionary") -> tuple[np.ndarray | None, bool]:
+        """Merge ``other``'s values into *this* dictionary in place
+        (append-only: existing codes stay valid, so programs already traced
+        against this object keep working unless they embedded
+        growth-sensitive constants — see ``trace_log``).
+
+        Returns (remap, grew): ``remap[other_code] -> my code`` (None when
+        the dictionaries already agree code-for-code), and whether new
+        values were appended (invalidates cached ranks)."""
+        if other is self:
+            return None, False
+        index = self.index()
+        remap = np.empty(len(other.values), dtype=np.int32)
+        grew = False
+        identical = len(other.values) <= len(self.values)
+        for i, v in enumerate(other.values):
+            code = index.get(v)
+            if code is None:
+                code = len(self.values)
+                self.values.append(v)
+                index[v] = code
+                grew = True
+                identical = False
+            elif code != i:
+                identical = False
+            remap[i] = code
+        if grew:
+            self._ranks = None
+        return (None if identical else remap), grew
 
     @staticmethod
     def from_strings(strings: Iterable[str]) -> tuple["Dictionary", np.ndarray]:

@@ -112,9 +112,16 @@ class OrcConnector(Connector):
             mn, mx = s.min_value, s.max_value
             t = f.types[type_id]
             if t.kind == ORC.KIND_DECIMAL and isinstance(mn, str):
+                import decimal
+
+                # exact: float64 loses digits past ~15 significant figures
+                # (and the default Decimal context rounds past 28), which
+                # could prune a split that still contains matches
                 scale = t.scale
-                mn = int(round(float(mn) * 10**scale))
-                mx = int(round(float(mx) * 10**scale))
+                with decimal.localcontext() as ctx:
+                    ctx.prec = 60
+                    mn = int(decimal.Decimal(mn).scaleb(scale).to_integral_value())
+                    mx = int(decimal.Decimal(mx).scaleb(scale).to_integral_value())
             out[name] = (mn, mx, s.has_null)
         return out or None
 

@@ -87,8 +87,58 @@ class StreamingAggregator:
             f"agg{id(agg_node)}",
             int(executor.session.get("stream_group_budget")),
         )
+        # running per-column dictionaries for the chunk stream; ids of
+        # dictionaries whose growth would invalidate the traced step
+        self._running_dicts: Optional[list] = None
+        self._sensitive_dicts: set[int] = set()
 
     # === chunk source ====================================================
+
+    def _canonicalize_dicts(self, b: Batch) -> Batch:
+        """Remap every string column of a split batch onto the stream's
+        *running* dictionaries (one stable object per column, grown
+        append-only via ``Dictionary.absorb``).
+
+        Two reasons (both bite on any multi-split table):
+        - correctness: per-split dictionaries assign unrelated codes to
+          the same strings, so carried group keys / min-max state would
+          compare garbage across chunks;
+        - jit stability: ``Dictionary`` objects are static aux data of the
+          chunk pytree, so a fresh dictionary per chunk would retrace and
+          recompile the step every chunk.
+
+        If a dictionary grows after the step was traced AND the trace
+        embedded growth-sensitive constants from it (rank tables, missed
+        equality encodes — see ``Dictionary.trace_log``), the compiled
+        step is stale: raise and let the executor fall back."""
+        from trino_tpu.exec.fragments import FusedUnsupported
+
+        if not any(c.dictionary is not None for c in b.columns):
+            return b
+        if self._running_dicts is None:
+            self._running_dicts = [None] * b.width
+        cols = list(b.columns)
+        for j, c in enumerate(cols):
+            if c.dictionary is None:
+                continue
+            running = self._running_dicts[j]
+            if running is None:
+                self._running_dicts[j] = c.dictionary
+                continue
+            remap, grew = running.absorb(c.dictionary)
+            if grew and id(running) in self._sensitive_dicts:
+                raise FusedUnsupported(
+                    "split dictionary grew under a rank-dependent trace"
+                )
+            if remap is not None:
+                data = np.asarray(c.data)
+                data = np.where(
+                    data >= 0, remap[np.maximum(data, 0)], -1
+                ).astype(np.int32)
+                cols[j] = Column(c.type, data, c.valid, running)
+            elif c.dictionary is not running:
+                cols[j] = Column(c.type, c.data, c.valid, running)
+        return Batch(cols, b.num_rows, b.sel)
 
     def _chunks(self, chunk_rows: int):
         """Yield lists of n host part-batches, each padded to a fixed
@@ -111,6 +161,7 @@ class StreamingAggregator:
             b = connector.read_split(
                 self.scan.schema, self.scan.table, self.scan.column_names, s
             )
+            b = self._canonicalize_dicts(b)
             if cap is None:
                 cap = bucket_capacity(max(1, min(b.num_rows, chunk_rows)))
                 proto = b
@@ -145,7 +196,17 @@ class StreamingAggregator:
         meta = self._collect_meta(chunk)
         state = self._init_state(meta)
         step = jax.jit(self._make_step(meta), donate_argnums=(0,))
-        state = step(state, chunk)
+        # the real trace happens on this first call — log dictionary
+        # accesses here too (eval_shape in _collect_meta covers the same
+        # path, but belt-and-braces keeps the invalidation set complete)
+        from trino_tpu.columnar import Dictionary
+
+        prev_log = Dictionary.begin_trace_log()
+        try:
+            state = step(state, chunk)
+        finally:
+            log = Dictionary.end_trace_log(prev_log)
+        self._sensitive_dicts |= set(log.get("growth_sensitive", ()))
         for parts, cap in it:
             chunk = _pad_batch(self.mesh, parts, cap)
             state = step(state, chunk)
@@ -180,7 +241,12 @@ class StreamingAggregator:
 
     def _collect_meta(self, chunk: Batch) -> dict:
         """Static metadata (specs/widths/dicts) via abstract evaluation —
-        no device compute; the first chunk is only executed by the step."""
+        no device compute; the first chunk is only executed by the step.
+        Dictionary accesses that embed growth-sensitive constants (rank
+        tables, missed encodes) are recorded so later chunks know whether
+        growing a dictionary invalidates the step."""
+        from trino_tpu.columnar import Dictionary
+
         box = {}
 
         def probe(ch):
@@ -194,7 +260,12 @@ class StreamingAggregator:
             box["key_dtypes"] = [kd.dtype for kd, _ in keys]
             return sel
 
-        jax.eval_shape(probe, chunk)
+        prev_log = Dictionary.begin_trace_log()
+        try:
+            jax.eval_shape(probe, chunk)
+        finally:
+            log = Dictionary.end_trace_log(prev_log)
+        self._sensitive_dicts = set(log.get("growth_sensitive", ()))
         specs = box["specs"]
         string_dicts = box["string_dicts"]
         key_dicts = box["key_dicts"]

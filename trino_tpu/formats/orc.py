@@ -95,8 +95,17 @@ def _uints(msg: dict, field: int) -> list[int]:
     return out
 
 
-def _zigzag(u: np.ndarray) -> np.ndarray:
+def _zigzag_int(u: int) -> int:
+    """Zigzag-decode one unsigned Python int (exact for any u < 2**64)."""
     return (u >> 1) ^ -(u & 1)
+
+
+def _zigzag_u64(u: np.ndarray) -> np.ndarray:
+    """Zigzag-decode a uint64 array into int64 (bit-exact: the xor runs in
+    unsigned space; going through int64 first would overflow for values
+    >= 2**63 and arithmetic-shift already-negative lanes)."""
+    one = np.uint64(1)
+    return ((u >> one) ^ (np.uint64(0) - (u & one))).view(np.int64)
 
 
 # --- compression framing ---------------------------------------------------
@@ -165,15 +174,13 @@ def _rle_v1(buf: bytes, count: int, signed: bool) -> np.ndarray:
             delta = delta - 256 if delta >= 128 else delta
             pos += 1
             base, pos = _varint(buf, pos)
-            base = int(_zigzag(np.int64(base))) if signed else base
+            base = _zigzag_int(base) if signed else base
             out[filled : filled + run] = base + delta * np.arange(run)
             filled += run
         else:  # literals
             lit = 256 - ctrl
             vals, pos = _read_varints(buf, lit, pos)
-            v = vals.astype(np.int64)
-            if signed:
-                v = _zigzag(v)
+            v = _zigzag_u64(vals) if signed else vals.astype(np.int64)
             out[filled : filled + lit] = v
             filled += lit
     return out
@@ -234,7 +241,7 @@ def _rle_v2(buf: bytes, count: int, signed: bool) -> np.ndarray:
             val = int.from_bytes(buf[pos : pos + width], "big")
             pos += width
             if signed:
-                val = int(_zigzag(np.int64(val)))
+                val = _zigzag_int(val)
             out[filled : filled + repeat] = val
             filled += repeat
         elif enc == 1:  # DIRECT
@@ -242,9 +249,7 @@ def _rle_v2(buf: bytes, count: int, signed: bool) -> np.ndarray:
             length = ((first & 1) << 8 | buf[pos + 1]) + 1
             pos += 2
             vals, pos = _unpack_bits(buf, length, width, pos)
-            v = vals.astype(np.int64)
-            if signed:
-                v = _zigzag(v)
+            v = _zigzag_u64(vals.astype(np.uint64)) if signed else vals.astype(np.int64)
             out[filled : filled + length] = v
             filled += length
         elif enc == 3:  # DELTA
@@ -253,9 +258,9 @@ def _rle_v2(buf: bytes, count: int, signed: bool) -> np.ndarray:
             length = ((first & 1) << 8 | buf[pos + 1]) + 1
             pos += 2
             base, pos = _varint(buf, pos)
-            base = int(_zigzag(np.int64(base))) if signed else base
+            base = _zigzag_int(base) if signed else base
             delta0, pos = _varint(buf, pos)
-            delta0 = int(_zigzag(np.int64(delta0)))
+            delta0 = _zigzag_int(delta0)
             seq = np.empty(length, dtype=np.int64)
             seq[0] = base
             if length > 1:
@@ -343,7 +348,7 @@ def _decimal_varints(buf: bytes, count: int) -> np.ndarray:
     pos = 0
     for i in range(count):
         v, pos = _varint(buf, pos)
-        out[i] = int(_zigzag(np.int64(v & 0xFFFFFFFFFFFFFFFF)))
+        out[i] = _zigzag_int(v & 0xFFFFFFFFFFFFFFFF)
     return out
 
 
@@ -651,7 +656,7 @@ def _signed_varint(v):
     """sint64 fields arrive zigzag-encoded by protobuf."""
     if v is None:
         return None
-    return int(_zigzag(np.int64(v)))
+    return _zigzag_int(v & 0xFFFFFFFFFFFFFFFF)
 
 
 def _f64(v):

@@ -57,6 +57,7 @@ class OutputBuffer:
         self.max_buffered_bytes = max_buffered_bytes
         self._complete = False
         self._aborted = False
+        self.dropped_unacked = False  # abort() discarded undelivered pages
         self._lock = threading.Condition()
 
     def enqueue(self, partition: int, page: bytes) -> None:
@@ -84,6 +85,10 @@ class OutputBuffer:
         with self._lock:
             self._aborted = True
             self._complete = True
+            if any(self._pages):
+                # a consumer re-reading these tokens must not mistake the
+                # truncated stream for a successful empty result
+                self.dropped_unacked = True
             self._pages = [[] for _ in range(self.n)]
             self._buffered = 0
             self._lock.notify_all()
@@ -669,15 +674,19 @@ class SqlTask:
     def results(self, partition: int, token: int, max_wait: float) -> dict:
         pages, next_token, complete = self.buffer.get(partition, token, max_wait)
         # CANCELED counts as failed for consumers: abort() dropped pages, so
-        # truncated output must never read as success
+        # truncated output must never read as success. The same applies to a
+        # FINISHED task whose buffer was aborted with undelivered pages
+        # (cancel raced completion): report failed, not empty success.
+        truncated = self.buffer.dropped_unacked
         return {
             "taskId": self.task_id,
             "pages": [base64.b64encode(p).decode() for p in pages],
             "token": next_token,
-            "complete": complete and self.state == "FINISHED",
-            "failed": self.state in ("FAILED", "CANCELED"),
+            "complete": complete and self.state == "FINISHED" and not truncated,
+            "failed": self.state in ("FAILED", "CANCELED") or truncated,
             "error": self.error or (
-                "task canceled" if self.state == "CANCELED" else None
+                "task canceled" if self.state == "CANCELED" else
+                ("task output aborted with undelivered pages" if truncated else None)
             ),
         }
 
