@@ -30,33 +30,48 @@ def main() -> None:
     batch = jax.device_put(host_batch)
     jax.block_until_ready(batch.columns[0].data)
     jitted = jax.jit(fn)
-    # warmup/compile
-    out = jax.block_until_ready(jitted(batch))
-    t0 = time.time()
-    iters = 5
-    for _ in range(iters):
-        out = jax.block_until_ready(jitted(batch))
-    dt = (time.time() - t0) / iters
-    rows_per_sec = n / dt
+    # warmup/compile (sync via a real device->host pull: on some backends
+    # block_until_ready returns before execution completes)
+    out = jitted(batch)
     (kd, kv), results, ng, ovf = out
-    assert int(ng) >= 1 and not bool(ovf)
+    assert int(np.asarray(ng)) >= 1 and not bool(np.asarray(ovf))
+    # >= 1s of timed iterations, trimmed mean over batches of 8 calls
+    # chained by a result pull (sub-ms kernels are unmeasurable per-call)
+    samples = []
+    t_total = 0.0
+    while t_total < 1.0 or len(samples) < 5:
+        t0 = time.time()
+        for _ in range(8):
+            out = jitted(batch)
+        _ = np.asarray(out[2])  # ng scalar: forces completion
+        dt = time.time() - t0
+        samples.append(dt / 8)
+        t_total += dt
+    samples.sort()
+    trimmed = samples[1:-1] or samples
+    dt = sum(trimmed) / len(trimmed)
+    rows_per_sec = n / dt
     # Secondary: end-to-end including host->device transfer of the batch.
     t0 = time.time()
     for _ in range(3):
         staged = jax.device_put(host_batch)
-        out = jax.block_until_ready(jitted(staged))
+        out = jitted(staged)
+        _ = np.asarray(out[2])
     e2e_rows_per_sec = n / ((time.time() - t0) / 3)
     engine_rows_per_sec = _engine_rate()
     baseline_proxy = 1.0e8  # assumed Java operator rows/s/core (no published number)
+    # headline = SQL text in -> rows out through parser/planner/streaming
+    # executor (the honest engine number); the hand-built kernel rate and
+    # the H2D-included rate ride along as diagnostics
     print(
         json.dumps(
             {
-                "metric": "tpch_q1_pipeline_rows_per_sec_per_chip",
-                "value": round(rows_per_sec),
+                "metric": "engine_groupby_rows_per_sec_per_chip",
+                "value": round(engine_rows_per_sec),
                 "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / baseline_proxy, 3),
-                "end_to_end_rows_per_sec": round(e2e_rows_per_sec),
-                "engine_rows_per_sec": round(engine_rows_per_sec),
+                "vs_baseline": round(engine_rows_per_sec / baseline_proxy, 3),
+                "kernel_rows_per_sec": round(rows_per_sec),
+                "kernel_h2d_rows_per_sec": round(e2e_rows_per_sec),
             }
         )
     )
@@ -99,12 +114,15 @@ def _engine_rate() -> float:
     sql = (
         "select k, sum(v), count(*) from memory.default.bench_groupby group by k"
     )
-    runner.execute(sql)  # warm: compile + caches
-    t0 = time.time()
-    rows, _ = runner.execute(sql)
-    dt = time.time() - t0
-    assert len(rows) == 1 << 12
-    return n / dt
+    runner.execute(sql)  # warm: compile + HBM staging + program cache
+    times = []
+    for _ in range(5):
+        t0 = time.time()
+        rows, _ = runner.execute(sql)
+        times.append(time.time() - t0)
+        assert len(rows) == 1 << 12
+    times.sort()
+    return n / times[len(times) // 2]  # median
 
 
 if __name__ == "__main__":

@@ -260,14 +260,71 @@ class Batch:
             return self.num_rows
         return int(np.asarray(self.selection_mask()).sum())
 
+    def to_host(self, extras: Sequence | None = None):
+        """Pull every device array to host in ONE packed D2H transfer.
+
+        Device→host transfers pay a large fixed latency per transfer (the
+        TPU runtime round-trip dwarfs the bytes for result-sized arrays),
+        so pulling a batch column-by-column costs ``(2·width+1)`` latencies.
+        Instead, every packable array becomes uint32 words (int64 as lo/hi
+        word lanes — TPU x64 rewriting forbids 64-bit bitcasts), one
+        device-side concatenate, one transfer, host views back.
+
+        ``extras`` (optional device arrays, e.g. deferred overflow flags)
+        ride the same transfer; when given, returns (batch, extra_values).
+        """
+        bufs: list = []  # (kind, col_idx) aligned with `arrays`
+        arrays: list = []
+
+        def note(kind, idx, a):
+            if isinstance(a, jax.Array) and _packable(a.dtype):
+                arrays.append(a)
+                bufs.append((kind, idx))
+                return None
+            return np.asarray(a) if isinstance(a, jax.Array) else a
+
+        host_data = [note("data", j, c.data) for j, c in enumerate(self.columns)]
+        host_valid = [
+            None if c.valid is None else note("valid", j, c.valid)
+            for j, c in enumerate(self.columns)
+        ]
+        host_sel = None if self.sel is None else note("sel", -1, self.sel)
+        host_extras = [
+            note("extra", j, a) for j, a in enumerate(extras or ())
+        ]
+        if arrays:
+            views = _unpack_words(np.asarray(_PACK_WORDS(arrays)), arrays)
+            for (kind, idx), v in zip(bufs, views):
+                if kind == "data":
+                    host_data[idx] = v
+                elif kind == "valid":
+                    host_valid[idx] = v
+                elif kind == "extra":
+                    host_extras[idx] = v
+                else:
+                    host_sel = v
+        cols = [
+            Column(c.type, host_data[j], host_valid[j], c.dictionary)
+            for j, c in enumerate(self.columns)
+        ]
+        out = Batch(cols, self.num_rows, host_sel)
+        if extras is None:
+            return out
+        return out, host_extras
+
     def compact(self) -> "Batch":
         """Materialize selection: gather surviving rows to the front (host)."""
         if self.sel is None and all(c.capacity == self.num_rows for c in self.columns):
             return self
-        mask = np.asarray(self.selection_mask())
+        b = self.to_host()
+        # host-side mask: selection_mask() would rebuild it as a device
+        # array and pay another device->host round trip
+        mask = np.arange(b.capacity) < b.num_rows
+        if b.sel is not None:
+            mask &= np.asarray(b.sel)
         idx = np.nonzero(mask)[0]
         cols = []
-        for c in self.columns:
+        for c in b.columns:
             data, valid = c.to_numpy()
             cols.append(
                 Column(c.type, data[idx], None if valid[idx].all() else valid[idx], c.dictionary)
@@ -294,6 +351,57 @@ class Batch:
         for j, (_, t) in enumerate(schema):
             cols.append(Column.from_values(t, [r[j] for r in rows]))
         return Batch(cols, len(rows), None)
+
+
+def _packable(dtype) -> bool:
+    return np.dtype(dtype) in (
+        np.dtype(np.bool_),
+        np.dtype(np.int32),
+        np.dtype(np.uint32),
+        np.dtype(np.float32),
+        np.dtype(np.int64),
+        np.dtype(np.uint64),
+    )
+
+
+def _pack_words(arrays):
+    """Traced: flatten each array into uint32 word lanes and concatenate."""
+    segs = []
+    for a in arrays:
+        x = jnp.ravel(a)
+        dt = np.dtype(a.dtype)
+        if dt == np.dtype(np.bool_):
+            segs.append(x.astype(jnp.uint32))
+        elif dt in (np.dtype(np.int64), np.dtype(np.uint64)):
+            segs.append(x.astype(jnp.uint32))  # low word (mod 2^32)
+            segs.append((x >> 32).astype(jnp.uint32))  # high word
+        else:
+            segs.append(jax.lax.bitcast_convert_type(x, jnp.uint32))
+    return jnp.concatenate(segs) if segs else jnp.zeros(0, jnp.uint32)
+
+
+_PACK_WORDS = jax.jit(_pack_words)
+
+
+def _unpack_words(packed: np.ndarray, arrays) -> list[np.ndarray]:
+    """Rebuild host arrays from the packed uint32 word stream."""
+    out = []
+    off = 0
+    for a in arrays:
+        dt = np.dtype(a.dtype)
+        n = int(np.prod(a.shape, dtype=np.int64))
+        if dt == np.dtype(np.bool_):
+            out.append(packed[off : off + n].astype(np.bool_).reshape(a.shape))
+            off += n
+        elif dt in (np.dtype(np.int64), np.dtype(np.uint64)):
+            lo = packed[off : off + n].astype(np.uint64)
+            hi = packed[off + n : off + 2 * n].astype(np.uint64)
+            out.append(((hi << np.uint64(32)) | lo).view(dt).reshape(a.shape))
+            off += 2 * n
+        else:
+            out.append(packed[off : off + n].view(dt).reshape(a.shape))
+            off += n
+    return out
 
 
 def concat_batches(batches: Sequence[Batch]) -> Batch:

@@ -69,6 +69,13 @@ class Session:
         # accumulators instead of materializing the table on device
         ("stream_scan_threshold_rows", 1 << 22),
         ("stream_chunk_rows", 1 << 20),
+        # device-resident streaming: connectors that can stage a table
+        # into HBM (memory connector) stream it via in-program
+        # dynamic_slice chunks; cap on staged bytes per table
+        ("stream_device_cache_bytes", 4 << 30),
+        # 2M rows: the in-loop int64 cumsum's reduce-window must fit
+        # scoped vmem (16MB on v5e; 4M-row chunks exceed it)
+        ("stream_device_chunk_rows", 1 << 21),
         # initial per-shard group budget for streamed aggregation (grows
         # on overflow)
         ("stream_group_budget", 1 << 12),

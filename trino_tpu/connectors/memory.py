@@ -1,5 +1,10 @@
 """In-memory connector (reference: ``plugin/trino-memory``,
-``MemoryPagesStore.java:41``): CREATE TABLE AS / INSERT / scan."""
+``MemoryPagesStore.java:41``): CREATE TABLE AS / INSERT / scan.
+
+TPU-native twist: where the reference keeps pages pinned in worker JVM
+memory, this connector can additionally stage a table into device HBM
+(:meth:`device_slab`), so repeated scans stream device-resident slabs
+through the step program with zero host->device traffic."""
 
 from __future__ import annotations
 
@@ -16,6 +21,8 @@ class MemoryConnector(Connector):
         self._tables: dict[tuple[str, str], TableSchema] = {}
         self._data: dict[tuple[str, str], list[Batch]] = {}
         self._stats: dict[tuple[str, str], dict[int, dict]] = {}
+        self._version = 0  # bumped on any mutation; keys the device cache
+        self._device: dict[tuple, tuple] = {}
 
     def list_schemas(self):
         return sorted({s for s, _ in self._tables} | {"default"})
@@ -38,7 +45,79 @@ class MemoryConnector(Connector):
         compacted = batch.compact()
         self._data[(schema, table)].append(compacted)
         self._stats.pop((schema, table), None)
+        self._invalidate()
         return compacted.num_rows
+
+    def _invalidate(self):
+        self._version += 1
+        self._device.clear()
+
+    def device_slab(self, schema, table, columns: Sequence[str], cap: int,
+                    max_bytes: int):
+        """Stage the table's requested columns into device HBM as ONE slab
+        padded to a multiple of ``cap`` rows (so a compiled step can
+        ``dynamic_slice`` any chunk without clamping). Returns
+        (slab_batch, num_rows) or None when the table exceeds
+        ``max_bytes`` (the stream then falls back to host chunking).
+
+        Cached per (columns, cap, version): repeated queries pay zero
+        host->device transfer — HBM is this connector's page store."""
+        import numpy as np
+
+        parts = self._data.get((schema, table))
+        if parts is None:
+            return None
+        key = (schema, table, tuple(columns), cap, self._version)
+        hit = self._device.get(key)
+        if hit is not None:
+            return hit
+        total_rows = sum(b.num_rows for b in parts)
+        if total_rows == 0:
+            return None
+        ts = self._tables[(schema, table)]
+        name_to_idx = {c.name: i for i, c in enumerate(ts.columns)}
+        nbytes = 0
+        for c in columns:
+            t = ts.columns[name_to_idx[c]].type
+            width = np.dtype(t.storage_dtype).itemsize
+            if getattr(t, "wide", False):
+                width *= 2  # wide DECIMALs store (n, 2) hi/lo lanes
+            nbytes += total_rows * (width + 1)
+        if nbytes > max_bytes:
+            return None
+        import jax
+
+        from trino_tpu.columnar import Column
+
+        host = concat_batches(
+            [
+                Batch(
+                    [b.columns[name_to_idx[c]] for c in columns],
+                    b.num_rows,
+                    b.sel,
+                )
+                for b in parts
+            ]
+        )
+        padded_rows = ((total_rows + cap - 1) // cap) * cap
+        pad = padded_rows - host.num_rows
+        cols = []
+        for c in host.columns:
+            data, valid = np.asarray(c.data), c.valid
+            if pad:
+                data = np.concatenate(
+                    [data, np.zeros((pad,) + data.shape[1:], dtype=data.dtype)]
+                )
+                if valid is not None:
+                    valid = np.concatenate(
+                        [np.asarray(valid), np.zeros(pad, dtype=np.bool_)]
+                    )
+            dev = jax.device_put(data)
+            dvalid = None if valid is None else jax.device_put(valid)
+            cols.append(Column(c.type, dev, dvalid, c.dictionary))
+        slab = Batch(cols, padded_rows)
+        self._device[key] = (slab, total_rows)
+        return slab, total_rows
 
     # --- transaction snapshot support (see trino_tpu.transaction) --------
 
@@ -53,17 +132,20 @@ class MemoryConnector(Connector):
         self._tables = dict(tables)
         self._data = {k: list(v) for k, v in data.items()}
         self._stats.clear()
+        self._invalidate()
 
     def truncate(self, schema, table):
         if (schema, table) not in self._tables:
             raise KeyError(f"table not found: {schema}.{table}")
         self._data[(schema, table)] = []
         self._stats.pop((schema, table), None)
+        self._invalidate()
 
     def drop_table(self, schema, table):
         self._tables.pop((schema, table), None)
         self._data.pop((schema, table), None)
         self._stats.pop((schema, table), None)
+        self._invalidate()
 
     def estimate_rows(self, schema, table):
         parts = self._data.get((schema, table))

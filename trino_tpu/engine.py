@@ -100,6 +100,57 @@ class Engine:
             self.catalogs.register("system", SystemConnector(self))
         except Exception:  # noqa: BLE001 — system catalog is best-effort
             pass
+        # plan + compiled-program reuse for repeated read-only queries
+        # (keyed by SQL text, session fingerprint, and catalog data
+        # versions; jax.jit re-traces on its own if input shapes change)
+        from collections import OrderedDict
+
+        self._query_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._query_cache_lock = threading.Lock()
+
+    _QUERY_CACHE_MAX = 64
+    # statements whose results depend on evaluation time/randomness (or
+    # session state) must not reuse a cached plan
+    _UNCACHEABLE_SQL = (
+        "random", "rand(", "now(", "current_time", "current_date",
+        "current_timestamp", "localtime", "uuid",
+    )
+
+    def _query_cache_entry(self, sql: str, session: Session) -> Optional[dict]:
+        """Cache slot for this (sql, session, data-version) or None when
+        the statement is uncacheable."""
+        import threading
+
+        if session.get("execution_mode") != "distributed" or not session.get(
+            "fragment_execution"
+        ):
+            return None
+        low = sql.lower()
+        if any(tok in low for tok in self._UNCACHEABLE_SQL):
+            return None
+        versions = tuple(
+            (name, getattr(self.catalogs.get(name), "_version", 0))
+            for name in sorted(self.catalogs.names())
+        )
+        key = (
+            sql,
+            session.user,
+            session.catalog,
+            session.schema,
+            tuple(sorted((k, repr(v)) for k, v in session.properties.items())),
+            versions,
+            self.access_control.generation,
+        )
+        with self._query_cache_lock:
+            entry = self._query_cache.get(key)
+            if entry is None:
+                entry = {"plan": None, "programs": {}, "lock": threading.Lock()}
+                self._query_cache[key] = entry
+                while len(self._query_cache) > self._QUERY_CACHE_MAX:
+                    self._query_cache.popitem(last=False)
+            else:
+                self._query_cache.move_to_end(key)
+        return entry
 
     # --- runtime introspection (system connector backend) -----------------
 
@@ -203,18 +254,50 @@ class Engine:
             )
             if m:
                 stmt = dataclasses.replace(stmt, sql=m.group(1).strip())
-        return self._dispatch_parsed(stmt, session, query_id)
+        return self._dispatch_parsed(stmt, session, query_id, sql_text=sql)
 
     def _dispatch_parsed(
-        self, stmt: t.Node, session: Session, query_id: Optional[str] = None
+        self,
+        stmt: t.Node,
+        session: Session,
+        query_id: Optional[str] = None,
+        sql_text: Optional[str] = None,
     ) -> StatementResult:
         handler = getattr(self, f"_do_{type(stmt).__name__.lower()}", None)
         if handler is not None:
             return handler(stmt, session)
         if isinstance(stmt, t.Query):
-            return self._execute_query_plan(
-                self.plan(stmt, session), session, query_id=query_id
+            entry = (
+                self._query_cache_entry(sql_text, session) if sql_text else None
             )
+            # shared program stores and capacity objects are not safe for
+            # concurrent executors: a second in-flight run of the same
+            # cached query executes uncached instead of waiting
+            if entry is not None and not entry["lock"].acquire(blocking=False):
+                entry = None
+            try:
+                if entry is not None and entry["plan"] is not None:
+                    return self._execute_query_plan(
+                        entry["plan"], session, query_id=query_id,
+                        programs=entry["programs"],
+                    )
+                plan = self.plan(stmt, session)
+                programs = None
+                if entry is not None:
+                    # joins carry data-dependent dynamic-filter rewrites
+                    # whose node identities change per query; cache
+                    # join-free plans
+                    if not any(
+                        isinstance(n, P.Join) for n in P.walk_plan(plan)
+                    ):
+                        entry["plan"] = plan
+                        programs = entry["programs"]
+                return self._execute_query_plan(
+                    plan, session, query_id=query_id, programs=programs
+                )
+            finally:
+                if entry is not None:
+                    entry["lock"].release()
         raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
 
     def plan(self, stmt: t.Node, session: Session) -> P.PlanNode:
@@ -232,6 +315,7 @@ class Engine:
         session: Session,
         collector=None,
         query_id: Optional[str] = None,
+        programs: Optional[dict] = None,
     ) -> StatementResult:
         from trino_tpu.memory import QueryMemoryContext
 
@@ -260,7 +344,7 @@ class Engine:
             max_bytes=int(session.get("query_max_memory_bytes")),
         )
         try:
-            executor = self._executor(session, ctx)
+            executor = self._executor(session, ctx, programs=programs)
             executor.stats_collector = collector
             batch, names = executor.execute(plan)
             return StatementResult(
@@ -273,14 +357,15 @@ class Engine:
         finally:
             ctx.close()
 
-    def _executor(self, session: Session, ctx) -> LocalExecutor:
+    def _executor(self, session: Session, ctx, programs: Optional[dict] = None) -> LocalExecutor:
         mode = session.get("execution_mode")
         if mode == "distributed":
             if session.get("fragment_execution"):
                 from trino_tpu.exec.fragments import FragmentedExecutor
 
                 return FragmentedExecutor(
-                    self.catalogs, session, self.mesh, memory_ctx=ctx
+                    self.catalogs, session, self.mesh, memory_ctx=ctx,
+                    programs=programs,
                 )
             from trino_tpu.parallel.distributed import DistributedExecutor
 

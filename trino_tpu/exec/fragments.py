@@ -190,10 +190,31 @@ class _Meta:
 
 
 class FragmentedExecutor(DistributedExecutor):
-    """Distributed executor that compiles each fragment into one program."""
+    """Distributed executor that compiles each fragment into one program.
+
+    ``programs`` (optional) is an engine-owned store that outlives this
+    per-query executor: jitted fragment programs and their capture
+    metadata are reused across executions of the same cached plan, so a
+    warm query skips Python retracing entirely (the reference's operators
+    are reused per-driver; ours are compiled programs reused per-plan).
+    """
+
+    # overflow flags queued during _execute_fragments (None outside it,
+    # e.g. when worker tasks call run_fragment_program directly)
+    deferred_flags: Optional[list] = None
+
+    def __init__(self, *args, programs: Optional[dict] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.programs: dict = {} if programs is None else programs
 
     def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
-        sub = fragment_plan(node)
+        # reuse the fragmented plan across executions of a cached plan:
+        # program-cache keys and traced closures reference fragment node
+        # identities, so the fragmentation must be stable too
+        sub = self.programs.get("__subplan__")
+        if sub is None:
+            sub = fragment_plan(node)
+            self.programs["__subplan__"] = sub
         if not query_fusable(sub):
             return super().execute(node)
         try:
@@ -218,17 +239,52 @@ class FragmentedExecutor(DistributedExecutor):
                 sp.fragment, results, names_holder
             )
 
-        run(sub)
-        root = results[sub.fragment.id]
-        if jax.process_count() > 1:
-            # multi-host: replicate the (small) root result so every
-            # process holds it fully before host materialization
-            from trino_tpu.parallel.mesh import replicated
+        # Optimistic overflow protocol: fragments enqueue their overflow
+        # flags (device scalars) in `deferred_flags` instead of pulling
+        # each one — a device->host pull costs a full runtime round trip,
+        # so the whole query checks ALL flags in ONE transfer, and only
+        # the (rare) overflow grows capacities and reruns.
+        attempts = 0
+        while True:
+            attempts += 1
+            if attempts > 12:
+                raise ExecutionError("capacity retry limit exceeded")
+            self.deferred_flags = []
+            results.clear()
+            names_holder.clear()
+            run(sub)
+            root = results[sub.fragment.id]
+            if jax.process_count() > 1:
+                # multi-host: replicate the (small) root result so every
+                # process holds it fully before host materialization
+                from trino_tpu.parallel.mesh import replicated
 
-            rep = jax.jit(
-                lambda b: b, out_shardings=replicated(self.mesh)
-            )(root.batch)
-            root = Result(rep, root.layout)
+                rep = jax.jit(
+                    lambda b: b, out_shardings=replicated(self.mesh)
+                )(root.batch)
+                root = Result(rep, root.layout)
+            deferred = self.deferred_flags
+            self.deferred_flags = None
+            # the overflow flags ride the SAME packed pull as the root
+            # batch (optimistic: the output of an overflowed run is
+            # discarded and the query reruns with grown budgets)
+            host_root, flag_vals = root.batch.to_host(
+                extras=[
+                    jnp.ravel(f.astype(jnp.int32)) for _, _, f, _ in deferred
+                ]
+            )
+            overflowed = False
+            for (key, names, _, caps), seg in zip(deferred, flag_vals):
+                seg = np.atleast_1d(np.asarray(seg))
+                for nm, fl in zip(names, seg):
+                    if fl:
+                        overflowed = True
+                        caps.grow(nm, 4 if nm.startswith("agg") else 2)
+                if seg.any() and key is not None:
+                    self.programs.pop(key, None)
+            if not overflowed:
+                root = Result(host_root, root.layout)
+                break
         out = root.batch.compact()
         names = names_holder.get(sub.fragment.id) or [
             s.name for s in sub.fragment.root.output_symbols
@@ -310,7 +366,7 @@ class FragmentedExecutor(DistributedExecutor):
                 names_holder[frag.id] = list(n.column_names)
         sink = {} if self.stats_collector is not None else None
         out = self.run_fragment_program(
-            frag, inputs, input_layouts, stats_sink=sink
+            frag, inputs, input_layouts, stats_sink=sink, defer=True
         )
         if self.stats_collector is not None:
             self.stats_collector.record_fragment(
@@ -348,7 +404,7 @@ class FragmentedExecutor(DistributedExecutor):
             self.session.get("stream_scan_threshold_rows")
         ):
             return None
-        caps = _Caps()
+        caps = self.programs.setdefault(("caps", "stream", frag.id), _Caps())
         attempts = 0
         while True:
             attempts += 1
@@ -382,7 +438,10 @@ class FragmentedExecutor(DistributedExecutor):
 
             return post
 
-        return self._retry_traced(caps, build_post, (res.batch,))
+        return self._retry_traced(
+            caps, build_post, (res.batch,), program_key=("post", frag.id),
+            defer=True,
+        )
 
     def _retry_traced(
         self,
@@ -391,22 +450,61 @@ class FragmentedExecutor(DistributedExecutor):
         args: tuple,
         stats_sink: Optional[dict] = None,
         input_rows: int = 0,
+        program_key=None,
+        defer: bool = False,
     ) -> Result:
         """Run a traced program under the capacity-overflow retry protocol
         and materialize its Result. ``build_fn(meta)`` returns the function
         to jit; it must call ``meta.capture`` and return ``meta.outputs``.
+
+        ``program_key`` (optional) reuses the jitted program + meta from
+        ``self.programs`` across queries on the same cached plan; an
+        overflow rebuilds and replaces the entry.
+
+        With ``defer=True`` (fragments inside ``_execute_fragments``) the
+        overflow flags are NOT pulled here: they are queued as device
+        scalars on ``self.deferred_flags`` and the whole query checks them
+        in one transfer; the outer loop grows ``caps`` and reruns.
         """
         import time as _time
 
+        cached = (
+            self.programs.get(program_key) if program_key is not None else None
+        )
         attempts = 0
         while True:
             attempts += 1
             if attempts > 12:
                 raise ExecutionError("capacity retry limit exceeded")
-            meta = _Meta()
+            if cached is not None:
+                jf, meta = cached
+                cached = None  # one shot: an overflow rebuilds below
+            else:
+                meta = _Meta()
+                jf = jax.jit(build_fn(meta))
             t0 = _time.perf_counter()
-            data, sel, flags = jax.jit(build_fn(meta))(*args)
-            flags_np = [bool(np.asarray(f)) for f in flags]
+            data, sel, flags = jf(*args)
+            if defer and getattr(self, "deferred_flags", None) is not None:
+                if flags:
+                    stacked = jnp.stack([jnp.reshape(f, ()) for f in flags])
+                    self.deferred_flags.append(
+                        (program_key, list(meta.overflow_names), stacked, caps)
+                    )
+                if program_key is not None:
+                    self.programs[program_key] = (jf, meta)
+                if stats_sink is not None:
+                    stats_sink.setdefault("attempts", 0)
+                    stats_sink["attempts"] += 1
+                    stats_sink["last_wall_s"] = _time.perf_counter() - t0
+                    stats_sink["input_rows"] = input_rows
+                break
+            # ONE device->host pull for all overflow flags: each separate
+            # scalar transfer pays the full runtime round-trip latency
+            if flags:
+                stacked = jnp.stack([jnp.reshape(f, ()) for f in flags])
+                flags_np = [bool(x) for x in np.asarray(stacked)]
+            else:
+                flags_np = []
             if stats_sink is not None:
                 jax.block_until_ready(sel)
                 stats_sink.setdefault("attempts", 0)
@@ -414,6 +512,8 @@ class FragmentedExecutor(DistributedExecutor):
                 stats_sink["last_wall_s"] = _time.perf_counter() - t0
                 stats_sink["input_rows"] = input_rows
             if not any(flags_np):
+                if program_key is not None:
+                    self.programs[program_key] = (jf, meta)
                 break
             for nm, f in zip(meta.overflow_names, flags_np):
                 if f:
@@ -434,6 +534,7 @@ class FragmentedExecutor(DistributedExecutor):
         input_layouts: dict[str, dict[str, int]],
         apply_exchange: bool = True,
         stats_sink: Optional[dict] = None,
+        defer: bool = False,
     ) -> Result:
         """Compile + run one fragment as a single jitted SPMD program.
 
@@ -443,7 +544,7 @@ class FragmentedExecutor(DistributedExecutor):
         (worker tasks) partition on the host instead. ``stats_sink``
         receives per-fragment compile/run timings when provided.
         """
-        caps = _Caps()
+        caps = self.programs.setdefault(("caps", frag.id), _Caps())
 
         def build(meta: _Meta):
             def fn(inp: dict[str, Batch]):
@@ -462,7 +563,72 @@ class FragmentedExecutor(DistributedExecutor):
             (inputs,),
             stats_sink=stats_sink,
             input_rows=sum(b.capacity for b in inputs.values()),
+            # the rewritten root's identity is part of the key: dynamic
+            # filtering rebuilds fragment nodes per attempt, and a program
+            # traced against old node ids must not serve new inputs (the
+            # cached closure pins the old root alive, so its id is unique)
+            program_key=("frag", frag.id, apply_exchange, id(frag.root)),
+            defer=defer,
         )
+
+
+class _OptPack:
+    """Unpacker for flat shard_map operand lists built by
+    :func:`pack_opt_pairs` (optional validity lanes are simply absent)."""
+
+    def __init__(self, has_kv, input_kinds):
+        self.has_kv = has_kv
+        self.input_kinds = input_kinds
+
+    def unpack(self, ops):
+        i = 0
+        lkeys = []
+        for hk in self.has_kv:
+            kd = ops[i]
+            i += 1
+            kv = None
+            if hk:
+                kv = ops[i]
+                i += 1
+            lkeys.append((kd, kv))
+        lsel = ops[i]
+        i += 1
+        linputs = []
+        for kind in self.input_kinds:
+            if kind == "none":
+                linputs.append(None)
+            elif kind == "data":
+                linputs.append((ops[i], None))
+                i += 1
+            else:
+                linputs.append((ops[i], ops[i + 1]))
+                i += 2
+        return lkeys, lsel, linputs, i
+
+
+def pack_opt_pairs(keys, sel, agg_inputs):
+    """Flatten (key pairs, selection, agg-input pairs) into shard_map
+    operands, omitting None validity lanes (columns with no nulls cost
+    zero extra sort passes downstream)."""
+    flat = []
+    has_kv = []
+    for kd, kv in keys:
+        flat.append(kd)
+        has_kv.append(kv is not None)
+        if kv is not None:
+            flat.append(kv)
+    flat.append(sel)
+    kinds = []
+    for p in agg_inputs:
+        if p is None:
+            kinds.append("none")
+        elif p[1] is None:
+            kinds.append("data")
+            flat.append(p[0])
+        else:
+            kinds.append("data+valid")
+            flat.extend([p[0], p[1]])
+    return flat, _OptPack(has_kv, kinds)
 
 
 class _FragmentTracer(DistributedExecutor):
@@ -640,7 +806,7 @@ class _FragmentTracer(DistributedExecutor):
                 continue
             sym = P.Symbol(fn.argument.name, fn.argument.type)
             c = res.column(sym)
-            data, valid = c.data, c.valid_mask()
+            data, valid = c.data, c.valid  # None valid = no nulls (cheaper)
             if c.dictionary is not None and fn.kind in ("min", "max"):
                 data = rank_codes(c.dictionary, data)
                 string_dicts.append(c.dictionary)
@@ -648,7 +814,8 @@ class _FragmentTracer(DistributedExecutor):
                 string_dicts.append(None)
             if fn.filter is not None:
                 fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
-                valid = valid & fc.data & fc.valid_mask()
+                fmask = fc.data & fc.valid_mask()
+                valid = fmask if valid is None else (valid & fmask)
             agg_inputs.append((data, valid))
             specs.append(sum_spec_for(fn, data))
         return agg_inputs, specs, string_dicts
@@ -658,36 +825,16 @@ class _FragmentTracer(DistributedExecutor):
         sel = res.batch.selection_mask()
         agg_inputs, specs, string_dicts = self._agg_inputs(node, res)
         key_cols = [res.column(k) for k in node.group_keys]
-        keys = [(c.data, c.valid_mask()) for c in key_cols]
+        keys = [(c.data, c.valid) for c in key_cols]
         nkeys = len(keys)
         if nkeys == 0:
             return self._agg_partial_global(node, res, sel, agg_inputs, specs, string_dicts)
         G = self.caps.get(f"agg{id(node)}", 1 << 12)
 
-        flat = []
-        for kd, kv in keys:
-            flat.extend([kd, kv])
-        flat.append(sel)
-        has_input = [p is not None for p in agg_inputs]
-        for p in agg_inputs:
-            if p is not None:
-                flat.extend([p[0], p[1]])
+        flat, pack = pack_opt_pairs(keys, sel, agg_inputs)
 
         def shard_partial(*ops):
-            i = 0
-            lkeys = []
-            for _ in range(nkeys):
-                lkeys.append((ops[i], ops[i + 1]))
-                i += 2
-            lsel = ops[i]
-            i += 1
-            linputs = []
-            for has in has_input:
-                if has:
-                    linputs.append((ops[i], ops[i + 1]))
-                    i += 2
-                else:
-                    linputs.append(None)
+            lkeys, lsel, linputs, _ = pack.unpack(ops)
             (kd, kv), raw, ng, ovf = group_aggregate(lkeys, lsel, linputs, specs, G)
             vals, cnts = [], []
             for spec, r in zip(specs, raw):
@@ -767,22 +914,10 @@ class _FragmentTracer(DistributedExecutor):
     ) -> Result:
         """Global (ungrouped) partial: one accumulator row per shard."""
 
-        flat = [sel]
-        has_input = [p is not None for p in agg_inputs]
-        for p in agg_inputs:
-            if p is not None:
-                flat.extend([p[0], p[1]])
+        flat, pack = pack_opt_pairs([], sel, agg_inputs)
 
         def shard_partial(*ops):
-            lsel = ops[0]
-            i = 1
-            linputs = []
-            for has in has_input:
-                if has:
-                    linputs.append((ops[i], ops[i + 1]))
-                    i += 2
-                else:
-                    linputs.append(None)
+            _, lsel, linputs, _ = pack.unpack(ops)
             raw = global_aggregate(lsel, linputs, specs)
             outs = []
             for spec, r in zip(specs, raw):
@@ -971,7 +1106,7 @@ class _FragmentTracer(DistributedExecutor):
                 Batch(cols, 1, jnp.ones(1, dtype=jnp.bool_)),
                 {s.name: i for i, s in enumerate(node.output_symbols)},
             )
-        keys = [res.pair(k) for k in node.group_keys]
+        keys = [res.opt_pair(k) for k in node.group_keys]
         key_cols = [res.column(k) for k in node.group_keys]
         G = self.caps.get(f"agg{id(node)}", 1 << 12)
         (kd, kv), raw, ng, ovf = group_aggregate(keys, sel, agg_inputs, specs, G)

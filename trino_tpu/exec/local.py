@@ -78,6 +78,11 @@ class Result:
         c = self.column(symbol)
         return c.data, c.valid_mask()
 
+    def opt_pair(self, symbol: P.Symbol):
+        """(data, valid-or-None): kernels skip null handling for None."""
+        c = self.column(symbol)
+        return c.data, c.valid
+
 
 class LocalExecutor:
     def __init__(

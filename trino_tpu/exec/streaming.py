@@ -185,6 +185,9 @@ class StreamingAggregator:
 
     def run(self) -> Result:
         chunk_rows = int(self.executor.session.get("stream_chunk_rows"))
+        res = self._run_device_slab(chunk_rows)
+        if res is not None:
+            return res
         it = self._chunks(chunk_rows)
         first = next(it, None)
         if first is None:
@@ -192,7 +195,7 @@ class StreamingAggregator:
 
             raise FusedUnsupported("streaming scan with zero splits")
         parts, cap = first
-        chunk = _pad_batch(self.mesh, parts, cap)
+        chunk, counts = _pad_batch(self.mesh, parts, cap)
         meta = self._collect_meta(chunk)
         state = self._init_state(meta)
         step = jax.jit(self._make_step(meta), donate_argnums=(0,))
@@ -203,17 +206,161 @@ class StreamingAggregator:
 
         prev_log = Dictionary.begin_trace_log()
         try:
-            state = step(state, chunk)
+            state = step(state, chunk, counts)
         finally:
             log = Dictionary.end_trace_log(prev_log)
         self._sensitive_dicts |= set(log.get("growth_sensitive", ()))
         for parts, cap in it:
-            chunk = _pad_batch(self.mesh, parts, cap)
-            state = step(state, chunk)
+            chunk, counts = _pad_batch(self.mesh, parts, cap)
+            state = step(state, chunk, counts)
+        self._check_overflow(state, None)
+        return self._finish(state, meta)
+
+    def _check_overflow(self, state, prog_key) -> None:
+        """Overflow handling: inside a fragmented query, queue the flag on
+        the executor's deferred list (ONE device->host pull per query, in
+        ``_execute_fragments``); otherwise pull and raise here so the
+        caller's retry loop grows the budget."""
+        dfl = getattr(self.executor, "deferred_flags", None)
+        if dfl is not None:
+            dfl.append(
+                (prog_key, [f"agg{id(self.agg)}"], state["overflow"], self.caps)
+            )
+            return
         if bool(np.asarray(state["overflow"]).max()):
             # the only registered capacity is the group budget
             raise StreamOverflow([f"agg{id(self.agg)}"])
+
+    # === device-resident slab source =====================================
+
+    def _run_device_slab(self, chunk_rows: int) -> Optional[Result]:
+        """Stream a device-resident table: the connector stages the whole
+        table into HBM once (``device_slab``), and each chunk is a
+        ``dynamic_slice`` INSIDE the compiled step — zero per-chunk host
+        work or host->device transfer, one dispatch per chunk.
+
+        Single-device meshes only (a sharded slab would need per-shard
+        offsets; multi-device streams use the host chunk path)."""
+        if self.n != 1:
+            return None
+        connector = self.executor.catalogs.get(self.scan.catalog)
+        # device chunks can be much larger than host chunks (no transfer
+        # to overlap, and fewer dispatches beat smaller sorts)
+        cap = bucket_capacity(
+            max(1, int(self.executor.session.get("stream_device_chunk_rows")))
+        )
+        slab = None
+        chunk_cols = None
+        stage = getattr(connector, "device_slab", None)
+        if stage is not None:
+            limit = int(self.executor.session.get("stream_device_cache_bytes"))
+            staged = stage(
+                self.scan.schema, self.scan.table, self.scan.column_names,
+                cap, limit,
+            )
+            if staged is not None:
+                slab, num_rows = staged
+                cap = min(cap, slab.capacity)
+        if slab is None:
+            gen = getattr(connector, "device_generator", None)
+            if gen is None:
+                return None
+            spec = gen(self.scan.schema, self.scan.table, self.scan.column_names)
+            if spec is None:
+                return None
+            chunk_cols, num_rows = spec
+            if num_rows <= 0:
+                return None
+        n_steps = (num_rows + cap - 1) // cap
+        programs = getattr(self.executor, "programs", None)
+        prog_key = ("slab", id(self.agg), self.G, cap, slab is None)
+        hit = programs.get(prog_key) if programs is not None else None
+        if hit is not None:
+            program, meta = hit
+            state = self._init_state(meta)
+            state = program(
+                state, slab, np.int32(n_steps), np.int64(num_rows)
+            )
+            self._check_overflow(state, prog_key)
+            return self._finish(state, meta)
+        if slab is not None:
+            probe_cols = [
+                Column(
+                    c.type,
+                    jax.ShapeDtypeStruct((cap,) + c.data.shape[1:], c.data.dtype),
+                    None
+                    if c.valid is None
+                    else jax.ShapeDtypeStruct((cap,), jnp.bool_),
+                    c.dictionary,
+                )
+                for c in slab.columns
+            ]
+        else:
+            probe_cols = [
+                Column(
+                    c.type,
+                    jax.ShapeDtypeStruct((cap,) + c.data.shape[1:], c.data.dtype),
+                    None,
+                    c.dictionary,
+                )
+                for c in jax.eval_shape(
+                    lambda: chunk_cols(jnp.zeros((), jnp.int32), cap)
+                )
+            ]
+        probe_chunk = Batch(
+            probe_cols, cap, jax.ShapeDtypeStruct((cap,), jnp.bool_)
+        )
+        meta = self._collect_meta(probe_chunk)
+        state = self._init_state(meta)
+        program = jax.jit(
+            self._make_slab_program(meta, cap, chunk_cols),
+            donate_argnums=(0,),
+        )
+        state = program(state, slab, np.int32(n_steps), np.int64(num_rows))
+        if programs is not None:
+            programs[prog_key] = (program, meta)
+        self._check_overflow(state, prog_key)
         return self._finish(state, meta)
+
+    def _make_slab_program(self, meta: dict, cap: int, chunk_cols=None):
+        """The ENTIRE chunk loop as one compiled program: a
+        ``lax.fori_loop`` whose body takes chunk i — dynamic-sliced from
+        the resident slab, or computed by the connector's traced
+        generator (``chunk_cols``) — and folds it into the carried
+        accumulators. One dispatch per query regardless of table size,
+        and the dynamic trip count means one compilation serves any row
+        count."""
+        inner = self._make_step(meta)
+
+        def body_for(slab, num_rows):
+            def body(i, state):
+                # int64 offset: i*cap wraps int32 past 2^31 rows (the
+                # generator path has no table-size bound)
+                off = i.astype(jnp.int64) * cap
+                cnt = jnp.minimum(cap, (num_rows - off).astype(jnp.int32))
+                if slab is not None:
+                    cols = []
+                    for c in slab.columns:
+                        data = jax.lax.dynamic_slice_in_dim(c.data, off, cap, axis=0)
+                        valid = (
+                            None
+                            if c.valid is None
+                            else jax.lax.dynamic_slice_in_dim(c.valid, off, cap, axis=0)
+                        )
+                        cols.append(Column(c.type, data, valid, c.dictionary))
+                else:
+                    cols = chunk_cols(off, cap)
+                live = jnp.arange(cap, dtype=jnp.int32) < cnt
+                return inner(state, Batch(cols, cap, live), None)
+
+            return body
+
+        def program(state, slab, n_steps, num_rows):
+            return jax.lax.fori_loop(
+                0, n_steps, body_for(slab, num_rows), state
+            )
+
+        return program
 
     # === metadata (eager pass over the first chunk) ======================
 
@@ -235,7 +382,7 @@ class StreamingAggregator:
         res = tracer._exec(self.agg.source)
         sel = res.batch.selection_mask()
         agg_inputs, specs, string_dicts = tracer._agg_inputs(self.agg, res)
-        keys = [res.pair(k) for k in self.agg.group_keys]
+        keys = [res.opt_pair(k) for k in self.agg.group_keys]
         key_dicts = [res.column(k).dictionary for k in self.agg.group_keys]
         return agg_inputs, specs, string_dicts, keys, key_dicts, sel
 
@@ -325,7 +472,15 @@ class StreamingAggregator:
         nspec = len(specs)
         sagg = self
 
-        def step(state, chunk: Batch):
+        def step(state, chunk: Batch, counts):
+            if counts is not None:
+                # per-shard valid-row counts (dynamic) instead of a host
+                # mask: tail chunks keep the same pytree structure, so the
+                # step compiles exactly once per stream
+                cap = chunk.capacity // sagg.n
+                pos = jnp.arange(chunk.capacity, dtype=jnp.int32)
+                live = pos % cap < counts[pos // cap]
+                chunk = Batch(chunk.columns, chunk.num_rows, live)
             tracer = sagg._tracer_for(chunk)
             agg_inputs, _specs, _sd, keys, _kd, sel = sagg._chunk_prep(tracer)
             if nkeys == 0:
@@ -343,14 +498,9 @@ class StreamingAggregator:
         nspec = len(specs)
         Gc = G  # chunk groups bounded by the same budget
 
-        flat = []
-        for kd, kv in keys:
-            flat.extend([kd, kv])
-        flat.append(sel)
-        has_input = [p is not None for p in agg_inputs]
-        for p in agg_inputs:
-            if p is not None:
-                flat.extend([p[0], p[1]])
+        from trino_tpu.exec.fragments import pack_opt_pairs
+
+        flat, pack = pack_opt_pairs(keys, sel, agg_inputs)
         flat.extend(state["key_data"])
         flat.extend(state["key_valid"])
         flat.append(state["live"])
@@ -358,19 +508,7 @@ class StreamingAggregator:
         flat.extend(state["counts"])
 
         def shard_step(*ops):
-            i = 0
-            lkeys = []
-            for _ in range(nkeys):
-                lkeys.append((ops[i], ops[i + 1]))
-                i += 2
-            lsel = ops[i]; i += 1
-            linputs = []
-            for h in has_input:
-                if h:
-                    linputs.append((ops[i], ops[i + 1]))
-                    i += 2
-                else:
-                    linputs.append(None)
+            lkeys, lsel, linputs, i = pack.unpack(ops)
             skd = list(ops[i : i + nkeys]); i += nkeys
             skv = list(ops[i : i + nkeys]); i += nkeys
             slive = ops[i]; i += 1
@@ -472,25 +610,15 @@ class StreamingAggregator:
         }
 
     def _step_global(self, state, sel, agg_inputs, specs, combine, widths):
+        from trino_tpu.exec.fragments import pack_opt_pairs
+
         nspec = len(specs)
-        flat = [sel]
-        has_input = [p is not None for p in agg_inputs]
-        for p in agg_inputs:
-            if p is not None:
-                flat.extend([p[0], p[1]])
+        flat, pack = pack_opt_pairs([], sel, agg_inputs)
         flat.extend(state["values"])
         flat.extend(state["counts"])
 
         def shard_step(*ops):
-            lsel = ops[0]
-            i = 1
-            linputs = []
-            for h in has_input:
-                if h:
-                    linputs.append((ops[i], ops[i + 1]))
-                    i += 2
-                else:
-                    linputs.append(None)
+            _, lsel, linputs, i = pack.unpack(ops)
             svals = list(ops[i : i + nspec]); i += nspec
             scnts = list(ops[i : i + nspec]); i += nspec
             raw = global_aggregate(lsel, linputs, specs)
@@ -692,9 +820,40 @@ def _empty_like(b: Batch) -> Batch:
     return Batch(cols, 0)
 
 
-def _pad_batch(mesh, parts: list[Batch], cap: int) -> Batch:
+def _pad_batch(mesh, parts: list[Batch], cap: int):
     """shard_batch with every part padded to exactly ``cap`` rows so each
-    step shares one compiled shape."""
+    step shares one compiled shape.
+
+    Returns (chunk, counts): when no part carries a selection mask, the
+    padding is expressed as per-shard valid-row *counts* (an (n,) int32
+    array the compiled step turns into a mask in-trace) — no mask bytes
+    cross to the device, and full and tail chunks share one pytree
+    structure (one compile per stream). Sources that do carry ``sel``
+    fall back to explicit masks (counts=None)."""
+    if all(p.sel is None for p in parts):
+        counts = np.asarray([p.num_rows for p in parts], dtype=np.int32)
+        padded = []
+        for p in parts:
+            if p.capacity == cap and p.num_rows == cap:
+                padded.append(p)
+                continue
+            cols = []
+            for c in p.columns:
+                data = np.asarray(c.data)
+                pad = cap - data.shape[0]
+                if pad:
+                    data = np.concatenate(
+                        [data, np.zeros((pad,) + data.shape[1:], dtype=data.dtype)]
+                    )
+                valid = c.valid
+                if valid is not None:
+                    valid = np.concatenate(
+                        [np.asarray(valid), np.zeros(pad, dtype=np.bool_)]
+                    ) if pad else valid
+                cols.append(Column(c.type, data, valid, c.dictionary))
+            # num_rows=cap: padding liveness is carried by `counts`
+            padded.append(Batch(cols, cap))
+        return shard_batch(mesh, padded), counts
     padded = []
     for p in parts:
         if p.capacity == cap and p.sel is None and p.num_rows == cap:
@@ -715,4 +874,4 @@ def _pad_batch(mesh, parts: list[Batch], cap: int) -> Batch:
         if p.sel is not None:
             sel[: p.capacity] &= np.asarray(p.sel)
         padded.append(Batch(cols, cap, sel))
-    return shard_batch(mesh, padded)
+    return shard_batch(mesh, padded), None

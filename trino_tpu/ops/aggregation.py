@@ -97,19 +97,30 @@ def group_aggregate(
     n = sel.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
     # build sort operands, tracking each key's operand positions (wide
-    # DECIMAL keys contribute two value lanes)
+    # DECIMAL keys contribute two value lanes). A ``valid`` of None means
+    # "no nulls": the validity sort lane and null-masking are skipped
+    # entirely (each dropped bool lane is a full bitonic pass saved).
     ops = [~sel]
-    key_pos: list[tuple[int, tuple[int, ...]]] = []  # (valid_idx, data_idx...)
+    key_pos: list = []  # (valid_idx | None, data_idx...)
     for data, valid in keys:
-        vi = len(ops)
-        ops.append(~valid)
+        if valid is None:
+            vi = None
+        else:
+            vi = len(ops)
+            ops.append(~valid)
         if getattr(data, "ndim", 1) == 2:
             di = (len(ops), len(ops) + 1)
             for lane in (data[:, 0], data[:, 1]):
-                ops.append(jnp.where(valid, lane, jnp.zeros_like(lane)))
+                ops.append(
+                    lane if valid is None
+                    else jnp.where(valid, lane, jnp.zeros_like(lane))
+                )
         else:
             di = (len(ops),)
-            ops.append(jnp.where(valid, data, jnp.zeros_like(data)))
+            ops.append(
+                data if valid is None
+                else jnp.where(valid, data, jnp.zeros_like(data))
+            )
         key_pos.append((vi, di))
     num_keys = len(ops)
     # aggregate inputs ride the sort as payload operands: bitonic payload
@@ -125,23 +136,24 @@ def group_aggregate(
             continue
         data, valid = pair
         base = num_keys + len(payload)
-        if getattr(data, "ndim", 1) == 2:
-            payload.extend([data[:, 0], data[:, 1], valid])
-            payload_pos[pid] = (base, base + 1, base + 2)
-        else:
-            payload.extend([data, valid])
-            payload_pos[pid] = (base, base + 1)
+        wide = getattr(data, "ndim", 1) == 2
+        lanes = [data[:, 0], data[:, 1]] if wide else [data]
+        if valid is not None:
+            lanes.append(valid)
+        payload.extend(lanes)
+        payload_pos[pid] = (wide, tuple(range(base, base + len(lanes))), valid is not None)
     sorted_ops = jax.lax.sort(tuple(ops) + tuple(payload), num_keys=num_keys)
     s_sel = ~sorted_ops[0]
 
     def _sorted_pair(pair):
-        pos = payload_pos[(id(pair[0]), id(pair[1]))]
-        if len(pos) == 3:
+        wide, pos, has_valid = payload_pos[(id(pair[0]), id(pair[1]))]
+        sv = sorted_ops[pos[-1]] if has_valid else None
+        if wide:
             return (
                 jnp.stack([sorted_ops[pos[0]], sorted_ops[pos[1]]], axis=1),
-                sorted_ops[pos[2]],
+                sv,
             )
-        return sorted_ops[pos[0]], sorted_ops[pos[1]]
+        return sorted_ops[pos[0]], sv
 
     # boundary: first row, or any sort key changed vs previous row
     changed = idx == 0
@@ -160,8 +172,10 @@ def group_aggregate(
     # group key output: gather the first row of each segment
     out_key_data, out_key_valid = [], []
     for (data, valid), (vi, di) in zip(keys, key_pos):
-        s_valid = ~sorted_ops[vi]
-        kv = seg.first(s_valid) & seg.nonempty
+        if vi is None:
+            kv = seg.nonempty
+        else:
+            kv = seg.first(~sorted_ops[vi]) & seg.nonempty
         lanes_out = []
         for d_idx in di:
             s_data = sorted_ops[d_idx]
@@ -180,10 +194,16 @@ def group_aggregate(
             results.append(seg.sizes.astype(jnp.int64))
             continue
         s_data, s_valid = _sorted_pair(pair)
+
+        def vcount():
+            if s_valid is None:
+                return seg.sizes.astype(jnp.int64)
+            return seg.sum(s_valid.astype(jnp.int64))
+
         if spec.kind in ("sum128", "sum128w"):
             from trino_tpu.ops import decimal128 as D
 
-            cnt = seg.sum(s_valid.astype(jnp.int64))
+            cnt = vcount()
             if spec.kind == "sum128":
                 limbs = D.narrow_limb_sums(s_data, s_valid, seg.sum)
             else:
@@ -193,23 +213,26 @@ def group_aggregate(
             results.append((limbs, cnt))
             continue
         if spec.kind == "count":
-            results.append(seg.sum(s_valid.astype(jnp.int64)))
+            results.append(vcount())
         elif spec.kind in ("sum", "avg"):
-            contrib = jnp.where(s_valid, s_data, jnp.zeros_like(s_data))
+            contrib = (
+                s_data if s_valid is None
+                else jnp.where(s_valid, s_data, jnp.zeros_like(s_data))
+            )
             ssum = seg.sum(contrib)
-            cnt = seg.sum(s_valid.astype(jnp.int64))
             # SQL: sum over empty/all-null group is NULL — caller uses cnt
-            results.append((ssum, cnt))
+            results.append((ssum, vcount()))
         elif spec.kind in ("min", "max"):
-            cnt = seg.sum(s_valid.astype(jnp.int64))
+            cnt = vcount()
             if getattr(s_data, "ndim", 1) == 2:
                 from trino_tpu.ops.decimal128 import sort_operands_wide
 
                 hi, lo = s_data[:, 0], s_data[:, 1]
                 ident = _max_ident(hi.dtype) if spec.kind == "min" else _min_ident(hi.dtype)
                 hk, lk = sort_operands_wide(hi, lo)
-                hk = jnp.where(s_valid, hk, ident)
-                lk = jnp.where(s_valid, lk, ident)
+                if s_valid is not None:
+                    hk = jnp.where(s_valid, hk, ident)
+                    lk = jnp.where(s_valid, lk, ident)
                 bh, blk = seg.extreme2(hk, lk, spec.kind)
                 from trino_tpu.ops.decimal128 import _SIGNBIT
 
@@ -220,11 +243,32 @@ def group_aggregate(
                     if spec.kind == "min"
                     else _min_ident(s_data.dtype)
                 )
-                masked = jnp.where(s_valid, s_data, ident)
+                masked = (
+                    s_data if s_valid is None
+                    else jnp.where(s_valid, s_data, ident)
+                )
                 results.append((seg.extreme(masked, spec.kind), cnt))
         else:
             raise NotImplementedError(spec.kind)
     return (out_key_data, out_key_valid), results, num_groups, overflow
+
+
+def _prefix_sum(x):
+    """Inclusive prefix sum via a blocked two-level scan.
+
+    ``jnp.cumsum`` lowers to one big reduce-window whose scoped-vmem
+    allocation blows up inside TPU while-loops (the streaming chunk loop);
+    scanning 512-row blocks keeps every window small, and the block-offset
+    pass runs over n/512 elements."""
+    n = x.shape[0]
+    blk = 512
+    if n <= blk or n % blk:
+        return jnp.cumsum(x)
+    xb = jnp.reshape(x, (n // blk, blk))
+    within = jnp.cumsum(xb, axis=1)
+    offsets = jnp.cumsum(within[:, -1])
+    offsets = jnp.concatenate([jnp.zeros((1,), x.dtype), offsets[:-1]])
+    return jnp.reshape(within + offsets[:, None], (n,))
 
 
 class _SortedSegments:
@@ -277,7 +321,8 @@ class _SortedSegments:
             return jax.ops.segment_sum(
                 x, self._gid, num_segments=self._max_groups
             )
-        csz = jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)])
+        cs = _prefix_sum(x)
+        csz = jnp.concatenate([jnp.zeros((1,), x.dtype), cs])
         return csz[self.starts[1:]] - csz[self.starts[:-1]]
 
     def extreme(self, masked, kind: str):
@@ -335,7 +380,7 @@ def global_aggregate(
             results.append(jnp.sum(sel.astype(jnp.int64)))
             continue
         data, valid = pair
-        use = valid & sel
+        use = sel if valid is None else (valid & sel)
         cnt = jnp.sum(use.astype(jnp.int64))
         if spec.kind in ("sum128", "sum128w"):
             from trino_tpu.ops import decimal128 as D

@@ -190,9 +190,12 @@ def narrow_limb_sums(data, weights_valid, seg_sum):
     reconstruction of the sign-extended 64-bit values, exact in Python)."""
     l0, l1 = _limbs32_from_i64(data)
     z = jnp.zeros_like(data)
-    l0 = jnp.where(weights_valid, l0, z)
-    l1 = jnp.where(weights_valid, l1, z)
-    neg = jnp.where(weights_valid & (data < 0), jnp.ones_like(data), z)
+    if weights_valid is None:  # no nulls: skip the masking
+        neg = jnp.where(data < 0, jnp.ones_like(data), z)
+    else:
+        l0 = jnp.where(weights_valid, l0, z)
+        l1 = jnp.where(weights_valid, l1, z)
+        neg = jnp.where(weights_valid & (data < 0), jnp.ones_like(data), z)
     return jnp.stack([seg_sum(l0), seg_sum(l1), seg_sum(neg)], axis=1)
 
 
@@ -204,11 +207,14 @@ def wide_limb_sums(hi, lo, weights_valid, seg_sum):
     lo0, lo1 = _limbs32_from_i64(lo)
     hi0, hi1 = _limbs32_from_i64(hi)
     z = jnp.zeros_like(lo)
-    lo0 = jnp.where(weights_valid, lo0, z)
-    lo1 = jnp.where(weights_valid, lo1, z)
-    hi0 = jnp.where(weights_valid, hi0, z)
-    hi1 = jnp.where(weights_valid, hi1, z)
-    neg = jnp.where(weights_valid & (hi < 0), jnp.ones_like(lo), z)
+    if weights_valid is None:  # no nulls: skip the masking
+        neg = jnp.where(hi < 0, jnp.ones_like(lo), z)
+    else:
+        lo0 = jnp.where(weights_valid, lo0, z)
+        lo1 = jnp.where(weights_valid, lo1, z)
+        hi0 = jnp.where(weights_valid, hi0, z)
+        hi1 = jnp.where(weights_valid, hi1, z)
+        neg = jnp.where(weights_valid & (hi < 0), jnp.ones_like(lo), z)
     return jnp.stack(
         [seg_sum(c) for c in (lo0, lo1, hi0, hi1, neg)], axis=1
     )

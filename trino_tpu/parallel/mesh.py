@@ -66,20 +66,29 @@ def shard_batch(mesh: Mesh, parts: Sequence[Batch]) -> Batch:
     sharding = row_sharding(mesh)
     width = parts[0].width
     cols: list[Column] = []
-    sels = []
-    for p in parts:
-        mask = np.zeros(cap, dtype=np.bool_)
-        mask[: p.num_rows] = True
-        if p.sel is not None:
-            local = np.zeros(cap, dtype=np.bool_)
-            local[: p.capacity] = np.asarray(p.sel)
-            mask &= local
-        sels.append(mask)
-    sel = _global(mesh, sharding, sels)
+    # full parts with no selection need no mask — skipping it avoids the
+    # host->device mask bytes entirely for full streaming chunks
+    if all(p.sel is None and p.num_rows == cap == p.capacity for p in parts):
+        sel = None
+    else:
+        sels = []
+        for p in parts:
+            mask = np.zeros(cap, dtype=np.bool_)
+            mask[: p.num_rows] = True
+            if p.sel is not None:
+                local = np.zeros(cap, dtype=np.bool_)
+                local[: p.capacity] = np.asarray(p.sel)
+                mask &= local
+            sels.append(mask)
+        sel = _global(mesh, sharding, sels)
     dictionaries = _unify_part_dictionaries(parts)
     for j in range(width):
         t = parts[0].columns[j].type  # same schema across parts
         datas, valids = [], []
+        no_valid = all(
+            p.columns[j].valid is None and p.columns[j].capacity == cap
+            for p in parts
+        )
         for pi, p in enumerate(parts):
             c = p.columns[j]
             data = np.asarray(c.data)
@@ -96,15 +105,16 @@ def shard_batch(mesh: Mesh, parts: Sequence[Batch]) -> Batch:
                 data = np.concatenate(
                     [data, np.zeros(pad_shape, dtype=data.dtype)]
                 )
-            valid = np.ones(cap, dtype=np.bool_)
-            if c.valid is not None:
-                v = np.asarray(c.valid)
-                valid[: v.shape[0]] = v
-                valid[v.shape[0]:] = False
             datas.append(data)
-            valids.append(valid)
+            if not no_valid:
+                valid = np.ones(cap, dtype=np.bool_)
+                if c.valid is not None:
+                    v = np.asarray(c.valid)
+                    valid[: v.shape[0]] = v
+                    valid[v.shape[0]:] = False
+                valids.append(valid)
         data_g = _global(mesh, sharding, datas)
-        valid_g = _global(mesh, sharding, valids)
+        valid_g = None if no_valid else _global(mesh, sharding, valids)
         d = dictionaries[j][0] if dictionaries[j] is not None else None
         cols.append(Column(t, data_g, valid_g, d))
     return Batch(cols, cap * n, sel)

@@ -101,9 +101,13 @@ class AccessControlManager(AccessControl):
 
     def __init__(self):
         self._controls: list[AccessControl] = []
+        # bumped whenever policy changes; cached query plans embed the
+        # generation so a policy change invalidates plan-time checks
+        self.generation = 0
 
     def add(self, control: AccessControl) -> None:
         self._controls.append(control)
+        self.generation += 1
 
     def check_can_select(self, user, catalog, schema, table):
         for c in self._controls:
