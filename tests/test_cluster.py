@@ -253,3 +253,93 @@ class TestClusterDynamicFiltering:
             except OSError:
                 continue  # a prior test killed this worker
         assert any(c > 0 for c in df_counts), df_counts
+
+
+class TestFusedStrictMode:
+    """worker_execution=fused_strict fails tasks instead of silently
+    interpreting (round-3 advisor: a swallowed fused-path regression
+    would quietly turn the cluster into a CPU interpreter)."""
+
+    def test_strict_fusable_query_succeeds(self, cluster, local):
+        sql = """select l_returnflag, count(*), sum(l_quantity)
+                 from lineitem group by l_returnflag order by l_returnflag"""
+        crows, _ = cluster.execute(
+            sql, session_properties={"worker_execution": "fused_strict"}
+        )
+        lrows, _ = local.execute(sql)
+        assert crows == lrows
+
+    def test_strict_task_fails_loud_on_unfusable_fragment(self):
+        """Task-level: a fragment the fused path cannot take MUST fail
+        the task under fused_strict (not silently interpret). Runs the
+        SqlTask machinery in-process for determinism."""
+        from trino_tpu.exec.fragments import fragment_fusable
+        from trino_tpu.planner.fragmenter import fragment_plan
+        from trino_tpu.planner.serde import fragment_to_json
+        from trino_tpu.server.task import SqlTask
+        from trino_tpu.testing import LocalQueryRunner
+
+        r = LocalQueryRunner()
+        r.session.set("execution_mode", "distributed")
+        plan = r.plan(
+            "select x, row_number() over (order by x)"
+            " from (values (1),(2),(3)) t(x)"
+        )
+        sub = fragment_plan(plan)
+
+        def frags(sp):
+            yield sp.fragment
+            for c in sp.children:
+                yield from frags(c)
+
+        unfusable = [f for f in frags(sub) if not fragment_fusable(f)]
+        assert unfusable, "expected the window fragment to be unfusable"
+        frag = unfusable[0]  # self-contained: Window over Values
+        payload = {
+            "fragment": fragment_to_json(frag),
+            "splits": {},
+            "sources": {},
+            "session": {
+                "properties": {"worker_execution": "fused_strict"},
+            },
+        }
+        task = SqlTask("strict-test-task", r.engine, payload)
+        task._run()
+        assert task.state == "FAILED"
+        assert "fused_strict" in (task.error or "")
+
+    def test_default_mode_falls_back_visibly(self):
+        """The same unfusable fragment in DEFAULT mode completes via the
+        interpreter — and says so (executionPath), instead of failing or
+        silently claiming the device path."""
+        from trino_tpu.exec.fragments import fragment_fusable
+        from trino_tpu.planner.fragmenter import fragment_plan
+        from trino_tpu.planner.serde import fragment_to_json
+        from trino_tpu.server.task import SqlTask
+        from trino_tpu.testing import LocalQueryRunner
+
+        r = LocalQueryRunner()
+        r.session.set("execution_mode", "distributed")
+        plan = r.plan(
+            "select x, row_number() over (order by x)"
+            " from (values (1),(2),(3)) t(x)"
+        )
+        sub = fragment_plan(plan)
+
+        def frags(sp):
+            yield sp.fragment
+            for c in sp.children:
+                yield from frags(c)
+
+        unfusable = [f for f in frags(sub) if not fragment_fusable(f)]
+        frag = unfusable[0]
+        payload = {
+            "fragment": fragment_to_json(frag),
+            "splits": {},
+            "sources": {},
+            "session": {"properties": {}},
+        }
+        task = SqlTask("fallback-test-task", r.engine, payload)
+        task._run()
+        assert task.state == "FINISHED", task.error
+        assert task.execution_path == "interpreter"

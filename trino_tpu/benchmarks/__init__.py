@@ -1,0 +1,1 @@
+"""Benchmark corpora + drivers (reference: testing/trino-benchto-benchmarks)."""

@@ -162,6 +162,62 @@ class CatalogManager:
         return sorted(self._catalogs)
 
 
+def slab_bytes_estimate(types: Sequence, rows: int) -> int:
+    """Bytes needed to stage ``rows`` of these column types in HBM
+    (wide DECIMALs store (n, 2) int64 lanes; +1 byte/row validity)."""
+    import numpy as np
+
+    nbytes = 0
+    for t in types:
+        width = np.dtype(t.storage_dtype).itemsize
+        if getattr(t, "wide", False):
+            width *= 2
+        nbytes += rows * (width + 1)
+    return nbytes
+
+
+# staging quantum: slabs are padded to a multiple of this row count, so
+# any power-of-two chunk size up to the quantum can dynamic_slice them —
+# one staged copy serves every chunk-size setting
+SLAB_PAD_QUANTUM = 1 << 22
+
+
+def stage_device_slab(host_batches: Sequence[Batch], cap: int):
+    """Stage host batches into device HBM as ONE slab padded to a
+    multiple of ``cap`` rows (so a compiled streaming step can
+    ``dynamic_slice`` any chunk without clamping). Per-part dictionaries
+    are unified during the concat. Returns (slab_batch, num_rows).
+
+    Shared by connectors whose data can live device-resident (memory
+    pages, generated tpch splits): HBM plays the role the reference's
+    worker heap plays for pinned pages."""
+    import jax
+    import numpy as np
+
+    from trino_tpu.columnar import Column, concat_batches
+
+    host = concat_batches(list(host_batches))
+    total_rows = host.num_rows
+    quantum = max(cap, SLAB_PAD_QUANTUM)
+    padded_rows = ((total_rows + quantum - 1) // quantum) * quantum
+    pad = padded_rows - total_rows
+    cols = []
+    for c in host.columns:
+        data, valid = np.asarray(c.data), c.valid
+        if pad:
+            data = np.concatenate(
+                [data, np.zeros((pad,) + data.shape[1:], dtype=data.dtype)]
+            )
+            if valid is not None:
+                valid = np.concatenate(
+                    [np.asarray(valid), np.zeros(pad, dtype=np.bool_)]
+                )
+        dev = jax.device_put(data)
+        dvalid = None if valid is None else jax.device_put(valid)
+        cols.append(Column(c.type, dev, dvalid, c.dictionary))
+    return Batch(cols, padded_rows), total_rows
+
+
 def batch_column_stats(columns, batch) -> dict:
     """Per-column (min, max, has_null) for a compacted batch — shared by
     stats-collecting connectors (the stripe-footer computation)."""

@@ -67,29 +67,27 @@ class MemoryConnector(Connector):
         parts = self._data.get((schema, table))
         if parts is None:
             return None
-        key = (schema, table, tuple(columns), cap, self._version)
+        key = (schema, table, tuple(columns), self._version)
         hit = self._device.get(key)
-        if hit is not None:
+        if hit is not None and hit[0].capacity % cap == 0:
             return hit
         total_rows = sum(b.num_rows for b in parts)
         if total_rows == 0:
             return None
         ts = self._tables[(schema, table)]
         name_to_idx = {c.name: i for i, c in enumerate(ts.columns)}
-        nbytes = 0
-        for c in columns:
-            t = ts.columns[name_to_idx[c]].type
-            width = np.dtype(t.storage_dtype).itemsize
-            if getattr(t, "wide", False):
-                width *= 2  # wide DECIMALs store (n, 2) hi/lo lanes
-            nbytes += total_rows * (width + 1)
+        from trino_tpu.connectors.api import (
+            slab_bytes_estimate,
+            stage_device_slab,
+        )
+
+        nbytes = slab_bytes_estimate(
+            [ts.columns[name_to_idx[c]].type for c in columns], total_rows
+        )
         if nbytes > max_bytes:
             return None
-        import jax
 
-        from trino_tpu.columnar import Column
-
-        host = concat_batches(
+        staged = stage_device_slab(
             [
                 Batch(
                     [b.columns[name_to_idx[c]] for c in columns],
@@ -97,27 +95,11 @@ class MemoryConnector(Connector):
                     b.sel,
                 )
                 for b in parts
-            ]
+            ],
+            cap,
         )
-        padded_rows = ((total_rows + cap - 1) // cap) * cap
-        pad = padded_rows - host.num_rows
-        cols = []
-        for c in host.columns:
-            data, valid = np.asarray(c.data), c.valid
-            if pad:
-                data = np.concatenate(
-                    [data, np.zeros((pad,) + data.shape[1:], dtype=data.dtype)]
-                )
-                if valid is not None:
-                    valid = np.concatenate(
-                        [np.asarray(valid), np.zeros(pad, dtype=np.bool_)]
-                    )
-            dev = jax.device_put(data)
-            dvalid = None if valid is None else jax.device_put(valid)
-            cols.append(Column(c.type, dev, dvalid, c.dictionary))
-        slab = Batch(cols, padded_rows)
-        self._device[key] = (slab, total_rows)
-        return slab, total_rows
+        self._device[key] = staged
+        return staged
 
     # --- transaction snapshot support (see trino_tpu.transaction) --------
 

@@ -38,10 +38,12 @@ class SyntheticConnector(Connector):
     def __init__(self, split_rows: int = 1 << 22):
         self.split_rows = split_rows
         self._tables: dict[tuple[str, str], SyntheticTable] = {}
+        self._version = 0  # keys the engine's plan/program cache
 
     def add_table(self, schema: str, table: str, schema_def: TableSchema,
                   num_rows: int, gen: Callable) -> None:
         self._tables[(schema, table)] = SyntheticTable(schema_def, num_rows, gen)
+        self._version += 1  # replaced generators must not serve cached plans
 
     # --- metadata --------------------------------------------------------
 

@@ -130,9 +130,17 @@ def _counts(sf: float) -> dict[str, int]:
 class TpchConnector(Connector):
     name = "tpch"
 
-    def __init__(self, split_rows: int = 1 << 20):
+    def __init__(self, split_rows: int = 1 << 20,
+                 cache_bytes: int = 2 << 30):
         self.split_rows = split_rows
         self._dict_cache: dict[str, Dictionary] = {}
+        # generated splits are deterministic: cache them so repeated
+        # queries (and benchmark reruns) measure the engine, not dbgen
+        self._batch_cache: dict[tuple, Batch] = {}
+        self._batch_cache_bytes = 0
+        self._batch_cache_limit = cache_bytes
+        # one HBM slab per (schema, table, columns); see device_slab
+        self._device_slabs: dict[tuple, tuple] = {}
 
     # --- metadata --------------------------------------------------------
     def list_schemas(self):
@@ -266,13 +274,68 @@ class TpchConnector(Connector):
         return {key: (lo + 1, hi, False)}
 
     # --- data generation -------------------------------------------------
+    def device_slab(self, schema, table, columns, cap: int, max_bytes: int):
+        """Stage a generated table's columns into device HBM once (the
+        reference's tpch connector generates into worker pages; HBM is
+        our page store). Bounded by ``max_bytes``; falls back to host
+        chunking beyond it. One slab per (schema, table, columns) —
+        quantum padding lets every chunk-size setting reuse it."""
+        scale_factor(schema)  # validates the schema name
+        rows = self.estimate_rows(schema, table)
+        if rows is None:
+            return None
+        from trino_tpu.connectors.api import (
+            slab_bytes_estimate,
+            stage_device_slab,
+        )
+
+        ts = self.get_table(schema, table)
+        by_name = {c.name: c for c in ts.columns}
+        if slab_bytes_estimate(
+            [by_name[c].type for c in columns], rows
+        ) > max_bytes:
+            return None
+        key = (schema, table, tuple(columns))
+        hit = self._device_slabs.get(key)
+        if hit is not None and hit[0].capacity % cap == 0:
+            return hit
+        sf = scale_factor(schema)
+        n_splits = max(1, (rows + self.split_rows - 1) // self.split_rows)
+        gen = getattr(self, f"_gen_{table}")
+        parts = []
+        for i in range(n_splits):
+            # generate directly (bypassing the host split cache: these
+            # batches are only needed once, staging must not evict hot
+            # host entries)
+            cols = gen(sf, i, n_splits, columns=set(columns))
+            out = [cols[c] for c in columns]
+            parts.append(Batch(out, out[0].data.shape[0] if out else 0))
+        staged = stage_device_slab(parts, cap)
+        self._device_slabs[key] = staged
+        return staged
+
     def read_split(self, schema, table, columns, split):
+        key = (schema, table, tuple(columns), split.index, split.total)
+        hit = self._batch_cache.get(key)
+        if hit is not None:
+            return hit
         sf = scale_factor(schema)
         gen = getattr(self, f"_gen_{table}")
         cols = gen(sf, split.index, split.total, columns=set(columns))
         out = [cols[c] for c in columns]
         n = out[0].data.shape[0] if out else 0
-        return Batch(out, n)
+        batch = Batch(out, n)
+        import numpy as np
+
+        nbytes = sum(
+            np.asarray(c.data).nbytes
+            + (np.asarray(c.valid).nbytes if c.valid is not None else 0)
+            for c in out
+        )
+        if self._batch_cache_bytes + nbytes <= self._batch_cache_limit:
+            self._batch_cache[key] = batch
+            self._batch_cache_bytes += nbytes
+        return batch
 
     # Each generator returns {column_name: Column} for this split's rows.
     def _range(self, total_rows: int, index: int, total: int) -> tuple[int, int]:

@@ -300,7 +300,7 @@ class FragmentedExecutor(DistributedExecutor):
         import time as _time
 
         t0 = _time.perf_counter()
-        streamed = self._try_streaming(frag, names_holder)
+        streamed = self._try_streaming(frag, names_holder, results)
         if streamed is not None:
             if self.stats_collector is not None:
                 self.stats_collector.record_fragment(
@@ -384,10 +384,14 @@ class FragmentedExecutor(DistributedExecutor):
         return out
 
     def _try_streaming(
-        self, frag: PlanFragment, names_holder: dict[int, list[str]]
+        self,
+        frag: PlanFragment,
+        names_holder: dict[int, list[str]],
+        results: Optional[dict] = None,
     ) -> Optional[Result]:
-        """Scan→agg fragments over large tables run as a bounded chunk
-        loop (exec/streaming.py) instead of materializing the table."""
+        """Scan→agg(→join) fragments over large tables run as a bounded
+        chunk loop (exec/streaming.py) instead of materializing the
+        probe table; join build sides materialize once up front."""
         from trino_tpu.exec.streaming import (
             StreamingAggregator,
             StreamOverflow,
@@ -397,13 +401,35 @@ class FragmentedExecutor(DistributedExecutor):
         chain = streamable_chain(frag.root)
         if chain is None:
             return None
-        agg, scan = chain
+        agg, scan, build_roots = chain
         connector = self.catalogs.get(scan.catalog)
         est = connector.estimate_rows(scan.schema, scan.table)
         if est is None or est <= int(
             self.session.get("stream_scan_threshold_rows")
         ):
             return None
+        # build-side inputs: scans materialize now (bounded by the spill
+        # threshold — bigger builds go to the interpreter's spill path),
+        # remote sources come from completed upstream fragments
+        build_inputs: dict[str, Batch] = {}
+        build_layouts: dict[str, dict[str, int]] = {}
+        build_bound = int(self.session.get("spill_threshold_rows"))
+        for root in build_roots:
+            for n in P.walk_plan(root):
+                if isinstance(n, P.TableScan):
+                    bconn = self.catalogs.get(n.catalog)
+                    best = bconn.estimate_rows(n.schema, n.table)
+                    if best is not None and best > build_bound:
+                        return None
+                    bres = self._exec_tablescan(n)
+                    build_inputs[f"scan{id(n)}"] = bres.batch
+                    build_layouts[f"scan{id(n)}"] = bres.layout
+                elif isinstance(n, P.RemoteSource):
+                    upstream = (results or {}).get(n.fragment_id)
+                    if upstream is None:
+                        return None
+                    build_inputs[f"remote{n.fragment_id}"] = upstream.batch
+                    build_layouts[f"remote{n.fragment_id}"] = upstream.layout
         caps = self.programs.setdefault(("caps", "stream", frag.id), _Caps())
         attempts = 0
         while True:
@@ -411,11 +437,16 @@ class FragmentedExecutor(DistributedExecutor):
             if attempts > 12:
                 raise ExecutionError("streaming capacity retry limit exceeded")
             try:
-                res = StreamingAggregator(self, frag, agg, scan, caps).run()
+                res = StreamingAggregator(
+                    self, frag, agg, scan, caps,
+                    build_roots=build_roots,
+                    build_inputs=build_inputs,
+                    build_layouts=build_layouts,
+                ).run()
                 break
             except StreamOverflow as e:
                 for nm in e.names:
-                    caps.grow(nm, 4)
+                    caps.grow(nm, 4 if nm.startswith("agg") else 2)
         if isinstance(frag.root, P.Output):
             names_holder[frag.id] = list(frag.root.column_names)
             cols = [res.column(s) for s in frag.root.symbols]

@@ -48,8 +48,16 @@ class StreamOverflow(Exception):
 
 
 def streamable_chain(frag_root: P.PlanNode):
-    """If the fragment is Output?→Aggregate→(Filter|Project)*→TableScan,
-    return (agg_node, scan_node); else None."""
+    """Detect a streamable fragment:
+    Output?→Aggregate→(Filter|Project|Join)*→TableScan along the PROBE
+    (left) spine. Joins on the spine have their build (right) sides
+    materialized once before the stream (reference: build-once
+    ``HashBuilderOperator.java:51``, probe-streamed
+    ``LookupJoinOperator.java:71``); each probe chunk then flows through
+    join→agg inside the compiled step with bounded output capacity.
+
+    Returns (agg_node, probe_scan, build_roots) or None. ``build_roots``
+    is the list of build-side subtree roots, outermost first."""
     node = frag_root
     if isinstance(node, P.Output):
         node = node.source
@@ -64,17 +72,36 @@ def streamable_chain(frag_root: P.PlanNode):
         if fn.kind not in ("sum", "count", "count_star", "min", "max", "avg"):
             return None
     node = agg.source
-    while isinstance(node, (P.Filter, P.Project)):
-        node = node.source
+    build_roots: list[P.PlanNode] = []
+    while True:
+        if isinstance(node, (P.Filter, P.Project)):
+            node = node.source
+            continue
+        if isinstance(node, P.Join):
+            if node.join_type not in ("INNER", "LEFT", "SEMI", "ANTI"):
+                return None
+            if not node.criteria:
+                return None
+            build_roots.append(node.right)
+            node = node.left
+            continue
+        break
     if not isinstance(node, P.TableScan):
         return None
-    return agg, node
+    return agg, node, build_roots
 
 
 class StreamingAggregator:
-    """Runs one streamable fragment as a chunk loop with carried state."""
+    """Runs one streamable fragment as a chunk loop with carried state.
 
-    def __init__(self, executor, frag, agg_node, scan_node, caps):
+    Joins on the probe spine stream too: the build (right) sides are
+    materialized ONCE up front (``_prebuild``), and every probe chunk
+    flows through join→agg inside the compiled step — the reference's
+    build-once/probe-streamed hash join (``HashBuilderOperator.java:51``,
+    ``LookupJoinOperator.java:71``) with the probe loop compiled."""
+
+    def __init__(self, executor, frag, agg_node, scan_node, caps,
+                 build_roots=(), build_inputs=None, build_layouts=None):
         self.executor = executor
         self.mesh = executor.mesh
         self.n = self.mesh.devices.size
@@ -82,6 +109,10 @@ class StreamingAggregator:
         self.agg = agg_node
         self.scan = scan_node
         self.caps = caps
+        self.build_roots = list(build_roots)
+        self.build_inputs = build_inputs or {}
+        self.build_layouts = build_layouts or {}
+        self._prememo: Optional[dict] = None
         self.nkeys = len(agg_node.group_keys)
         self.G = caps.get(
             f"agg{id(agg_node)}",
@@ -91,6 +122,36 @@ class StreamingAggregator:
         # dictionaries whose growth would invalidate the traced step
         self._running_dicts: Optional[list] = None
         self._sensitive_dicts: set[int] = set()
+
+    def _prebuild(self) -> None:
+        """Materialize the build sides of probe-spine joins once (device
+        resident for the whole stream). Their overflow flags join the
+        deferred check; build capacities grow through the same retry."""
+        if self._prememo is not None or not self.build_roots:
+            self._prememo = self._prememo or {}
+            return
+        from trino_tpu.exec.fragments import _FragmentTracer
+
+        tracer = _FragmentTracer(
+            self.executor, self.build_inputs, self.build_layouts, self.caps
+        )
+        self._prememo = {}
+        for root in self.build_roots:
+            self._prememo[id(root)] = tracer._exec(root)
+        if tracer.overflows:
+            names = [nm for nm, _ in tracer.overflows]
+            flags = jnp.stack(
+                [f.astype(jnp.int32) for _, f in tracer.overflows]
+            )
+            dfl = getattr(self.executor, "deferred_flags", None)
+            if dfl is not None:
+                dfl.append((None, names, flags, self.caps))
+            else:
+                fired = np.asarray(flags)
+                if fired.any():
+                    raise StreamOverflow(
+                        [nm for nm, f in zip(names, fired) if f]
+                    )
 
     # === chunk source ====================================================
 
@@ -185,6 +246,7 @@ class StreamingAggregator:
 
     def run(self) -> Result:
         chunk_rows = int(self.executor.session.get("stream_chunk_rows"))
+        self._prebuild()
         res = self._run_device_slab(chunk_rows)
         if res is not None:
             return res
@@ -213,23 +275,22 @@ class StreamingAggregator:
         for parts, cap in it:
             chunk, counts = _pad_batch(self.mesh, parts, cap)
             state = step(state, chunk, counts)
-        self._check_overflow(state, None)
+        self._check_overflow(state, None, meta)
         return self._finish(state, meta)
 
-    def _check_overflow(self, state, prog_key) -> None:
-        """Overflow handling: inside a fragmented query, queue the flag on
-        the executor's deferred list (ONE device->host pull per query, in
-        ``_execute_fragments``); otherwise pull and raise here so the
-        caller's retry loop grows the budget."""
+    def _check_overflow(self, state, prog_key, meta) -> None:
+        """Overflow handling: inside a fragmented query, queue the flag
+        vector on the executor's deferred list (ONE device->host pull per
+        query, in ``_execute_fragments``); otherwise pull and raise here
+        so the caller's retry loop grows the fired budgets."""
+        names = meta["ovf_names"]
         dfl = getattr(self.executor, "deferred_flags", None)
         if dfl is not None:
-            dfl.append(
-                (prog_key, [f"agg{id(self.agg)}"], state["overflow"], self.caps)
-            )
+            dfl.append((prog_key, names, state["overflow"], self.caps))
             return
-        if bool(np.asarray(state["overflow"]).max()):
-            # the only registered capacity is the group budget
-            raise StreamOverflow([f"agg{id(self.agg)}"])
+        fired = np.asarray(state["overflow"])
+        if fired.any():
+            raise StreamOverflow([nm for nm, f in zip(names, fired) if f])
 
     # === device-resident slab source =====================================
 
@@ -271,56 +332,82 @@ class StreamingAggregator:
             chunk_cols, num_rows = spec
             if num_rows <= 0:
                 return None
-        n_steps = (num_rows + cap - 1) // cap
         programs = getattr(self.executor, "programs", None)
-        prog_key = ("slab", id(self.agg), self.G, cap, slab is None)
-        hit = programs.get(prog_key) if programs is not None else None
-        if hit is not None:
-            program, meta = hit
-            state = self._init_state(meta)
-            state = program(
-                state, slab, np.int32(n_steps), np.int64(num_rows)
-            )
-            self._check_overflow(state, prog_key)
-            return self._finish(state, meta)
-        if slab is not None:
-            probe_cols = [
-                Column(
-                    c.type,
-                    jax.ShapeDtypeStruct((cap,) + c.data.shape[1:], c.data.dtype),
-                    None
-                    if c.valid is None
-                    else jax.ShapeDtypeStruct((cap,), jnp.bool_),
-                    c.dictionary,
-                )
-                for c in slab.columns
-            ]
-        else:
-            probe_cols = [
-                Column(
-                    c.type,
-                    jax.ShapeDtypeStruct((cap,) + c.data.shape[1:], c.data.dtype),
-                    None,
-                    c.dictionary,
-                )
-                for c in jax.eval_shape(
-                    lambda: chunk_cols(jnp.zeros((), jnp.int32), cap)
-                )
-            ]
-        probe_chunk = Batch(
-            probe_cols, cap, jax.ShapeDtypeStruct((cap,), jnp.bool_)
-        )
-        meta = self._collect_meta(probe_chunk)
-        state = self._init_state(meta)
-        program = jax.jit(
-            self._make_slab_program(meta, cap, chunk_cols),
-            donate_argnums=(0,),
-        )
-        state = program(state, slab, np.int32(n_steps), np.int64(num_rows))
+        if self.build_roots:
+            # the step closes over this query's materialized build
+            # batches; a cached program would pin stale builds
+            programs = None
+        # wide pipelines (many payload lanes) can exceed scoped vmem at
+        # large chunk sizes: on a compile failure, halve the chunk (the
+        # slab's quantum padding stays valid for any smaller power of
+        # two) and REMEMBER the working cap so warm queries never repeat
+        # the failing compile
         if programs is not None:
-            programs[prog_key] = (program, meta)
-        self._check_overflow(state, prog_key)
-        return self._finish(state, meta)
+            cap = min(cap, programs.get(("slabcap", id(self.agg)), cap))
+        while True:
+            n_steps = (num_rows + cap - 1) // cap
+            prog_key = ("slab", id(self.agg), self.G, cap, slab is None)
+            hit = programs.get(prog_key) if programs is not None else None
+            if hit is not None:
+                program, meta = hit
+                state = self._init_state(meta)
+                state = program(
+                    state, slab, np.int32(n_steps), np.int64(num_rows)
+                )
+                self._check_overflow(state, prog_key, meta)
+                return self._finish(state, meta)
+            if slab is not None:
+                probe_cols = [
+                    Column(
+                        c.type,
+                        jax.ShapeDtypeStruct((cap,) + c.data.shape[1:], c.data.dtype),
+                        None
+                        if c.valid is None
+                        else jax.ShapeDtypeStruct((cap,), jnp.bool_),
+                        c.dictionary,
+                    )
+                    for c in slab.columns
+                ]
+            else:
+                probe_cols = [
+                    Column(
+                        c.type,
+                        jax.ShapeDtypeStruct((cap,) + c.data.shape[1:], c.data.dtype),
+                        None,
+                        c.dictionary,
+                    )
+                    for c in jax.eval_shape(
+                        lambda: chunk_cols(jnp.zeros((), jnp.int32), cap)
+                    )
+                ]
+            probe_chunk = Batch(
+                probe_cols, cap, jax.ShapeDtypeStruct((cap,), jnp.bool_)
+            )
+            meta = self._collect_meta(probe_chunk)
+            state = self._init_state(meta)
+            program = jax.jit(
+                self._make_slab_program(meta, cap, chunk_cols),
+                donate_argnums=(0,),
+            )
+            try:
+                state = program(
+                    state, slab, np.int32(n_steps), np.int64(num_rows)
+                )
+            except jax.errors.JaxRuntimeError as e:
+                msg = str(e).lower()
+                compile_failure = any(
+                    tok in msg
+                    for tok in ("compile", "vmem", "resource_exhausted")
+                )
+                if not compile_failure or cap <= 1 << 18:
+                    raise
+                cap //= 2
+                continue
+            if programs is not None:
+                programs[prog_key] = (program, meta)
+                programs[("slabcap", id(self.agg))] = cap
+            self._check_overflow(state, prog_key, meta)
+            return self._finish(state, meta)
 
     def _make_slab_program(self, meta: dict, cap: int, chunk_cols=None):
         """The ENTIRE chunk loop as one compiled program: a
@@ -367,7 +454,7 @@ class StreamingAggregator:
     def _tracer_for(self, chunk: Batch):
         from trino_tpu.exec.fragments import _FragmentTracer
 
-        return _FragmentTracer(
+        tracer = _FragmentTracer(
             self.executor,
             {f"scan{id(self.scan)}": chunk},
             {
@@ -377,6 +464,12 @@ class StreamingAggregator:
             },
             self.caps,
         )
+        if self._prememo:
+            # build sides of probe-spine joins: already materialized, so
+            # the chunk trace consumes them as constants instead of
+            # re-executing the build per chunk
+            tracer._memo.update(self._prememo)
+        return tracer
 
     def _chunk_prep(self, tracer):
         res = tracer._exec(self.agg.source)
@@ -405,6 +498,10 @@ class StreamingAggregator:
             box["string_dicts"] = string_dicts
             box["key_dicts"] = key_dicts
             box["key_dtypes"] = [kd.dtype for kd, _ in keys]
+            # per-chunk overflow sources (probe-spine join capacities);
+            # execution order is deterministic, so the step trace will
+            # produce flags in this same order
+            box["ovf_names"] = [nm for nm, _ in tracer.overflows]
             return sel
 
         prev_log = Dictionary.begin_trace_log()
@@ -437,6 +534,7 @@ class StreamingAggregator:
             "string_dicts": string_dicts,
             "key_dicts": key_dicts,
             "key_dtypes": box["key_dtypes"],
+            "ovf_names": [f"agg{id(self.agg)}"] + box["ovf_names"],
         }
 
     def _init_state(self, meta: dict) -> dict:
@@ -446,7 +544,9 @@ class StreamingAggregator:
         def zeros(shape, dt):
             return jax.device_put(jnp.zeros(shape, dtype=dt), sh)
 
-        state: dict = {"overflow": jnp.zeros((), dtype=jnp.int32)}
+        state: dict = {
+            "overflow": jnp.zeros(len(meta["ovf_names"]), dtype=jnp.int32)
+        }
         if self.nkeys:
             state["key_data"] = [
                 zeros((rows,), dt) for dt in meta["key_dtypes"]
@@ -483,13 +583,23 @@ class StreamingAggregator:
                 chunk = Batch(chunk.columns, chunk.num_rows, live)
             tracer = sagg._tracer_for(chunk)
             agg_inputs, _specs, _sd, keys, _kd, sel = sagg._chunk_prep(tracer)
+            prev_ovf = state["overflow"]
             if nkeys == 0:
-                return sagg._step_global(
+                out = sagg._step_global(
                     state, sel, agg_inputs, specs, combine, widths
                 )
-            return sagg._step_grouped(
-                state, keys, sel, agg_inputs, specs, combine, widths
-            )
+            else:
+                out = sagg._step_grouped(
+                    state, keys, sel, agg_inputs, specs, combine, widths
+                )
+            # overflow lanes: [agg] + per-chunk join capacities, max'd
+            # with the carried vector
+            flags = [jnp.reshape(out["overflow"], ())] + [
+                jnp.reshape(f.astype(jnp.int32), ())
+                for _, f in tracer.overflows
+            ]
+            out["overflow"] = jnp.maximum(prev_ovf, jnp.stack(flags))
+            return out
 
         return step
 
@@ -606,7 +716,9 @@ class StreamingAggregator:
             "live": nlive,
             "values": list(nvals),
             "counts": list(ncnts),
-            "overflow": jnp.maximum(state["overflow"], ovf.astype(jnp.int32)),
+            # chunk-local agg overflow; the caller folds it into the
+            # carried per-source overflow vector
+            "overflow": ovf.astype(jnp.int32),
         }
 
     def _step_global(self, state, sel, agg_inputs, specs, combine, widths):
@@ -659,7 +771,7 @@ class StreamingAggregator:
         return {
             "values": list(nvals),
             "counts": list(ncnts),
-            "overflow": state["overflow"],
+            "overflow": jnp.zeros((), dtype=jnp.int32),  # global agg: none
         }
 
     # === result assembly =================================================

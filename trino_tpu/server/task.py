@@ -570,8 +570,9 @@ class SqlTask:
         try:
             prefetched = self._prefetch_sources()
             result = None
-            if self.session.get("worker_execution") == "fused":
-                result = self._try_fused(prefetched)
+            mode = self.session.get("worker_execution")
+            if mode in ("fused", "fused_strict"):
+                result = self._try_fused(prefetched, strict=mode == "fused_strict")
             if result is None:
                 self.execution_path = "interpreter"
                 result = self._run_interpreted(prefetched)
@@ -583,14 +584,23 @@ class SqlTask:
         finally:
             self.buffer.set_complete()
 
-    def _try_fused(self, prefetched) -> Optional[Result]:
+    def _try_fused(self, prefetched, strict: bool = False) -> Optional[Result]:
         """Fragment as one compiled program on worker-local devices; None
-        means fall back to the interpreter."""
+        means fall back to the interpreter.
+
+        ``strict`` (session ``worker_execution=fused_strict``) fails the
+        task instead of silently interpreting: a fused-path regression
+        turns a strict suite red rather than slow (round-3 advisor: one
+        swallowed exception could quietly degrade the whole cluster)."""
         import jax
 
         from trino_tpu.exec.fragments import FusedUnsupported, fragment_fusable
 
         if not fragment_fusable(self.fragment):
+            if strict:
+                raise FusedUnsupported(
+                    f"fused_strict: fragment {self.fragment.id} is not fusable"
+                )
             return None
         try:
             runner = FusedWorkerRunner(self.engine, self.session, self.fragment)
@@ -606,12 +616,17 @@ class SqlTask:
                 runner.executor.dynamic_filters
             )
             return result
-        except (FusedUnsupported, jax.errors.TracerArrayConversionError):
+        except (FusedUnsupported, jax.errors.TracerArrayConversionError) as e:
+            if strict:
+                raise
+            self.stats["fused_error"] = f"{type(e).__name__}: {e}"
             return None
         except Exception as e:  # noqa: BLE001
             # any other device-path failure (capacity retry exhaustion, XLA
             # errors): the interpreter fallback recomputes from the
             # prefetched sources — record why for observability
+            if strict:
+                raise
             self.stats["fused_error"] = f"{type(e).__name__}: {e}"
             return None
 
