@@ -217,3 +217,83 @@ class TestFusedExecution:
             " order by l_orderkey limit 7",
             fused_counter,
         )
+
+
+class TestScalarSubqueriesFused:
+    """Round-4 fused-tier clearances: scalar subqueries (correlated and
+    not), DISTINCT aggregates, and exact wide-decimal division all trace
+    now; results must equal the interpreter, and the multiple-row scalar
+    error must surface from the compiled program (err! flag channel)."""
+
+    @pytest.fixture(scope="class")
+    def fused(self):
+        from trino_tpu.testing import DistributedQueryRunner
+
+        return DistributedQueryRunner()
+
+    @pytest.fixture(scope="class")
+    def local(self, fused):
+        from trino_tpu.testing import LocalQueryRunner
+
+        return LocalQueryRunner(engine=fused.engine)
+
+    def _check(self, fused, local, sql):
+        got, _ = fused.execute(sql)
+        want, _ = local.execute(sql)
+        assert got == want, (sql, got[:3], want[:3])
+
+    def test_uncorrelated_scalar(self, fused, local):
+        self._check(
+            fused, local,
+            "select count(*) from orders where o_totalprice >"
+            " (select avg(o_totalprice) from orders)",
+        )
+
+    def test_correlated_scalar(self, fused, local):
+        self._check(
+            fused, local,
+            """select p_brand, count(*) from part p
+               where p_retailprice > (select avg(p2.p_retailprice)
+                                      from part p2
+                                      where p2.p_brand = p.p_brand)
+               group by p_brand order by p_brand limit 5""",
+        )
+
+    def test_scalar_over_empty_is_null(self, fused, local):
+        self._check(
+            fused, local,
+            "select count(*) from orders where o_totalprice <"
+            " (select sum(o_totalprice) from orders where o_orderkey < 0)",
+        )
+
+    def test_multiple_row_scalar_errors(self, fused):
+        with pytest.raises(Exception, match="multiple rows"):
+            fused.execute(
+                "select count(*) from orders where o_totalprice >"
+                " (select o_totalprice from orders where o_orderkey <= 2)"
+            )
+
+    def test_distinct_aggregates(self, fused, local):
+        self._check(
+            fused, local,
+            "select o_orderstatus, count(distinct o_custkey),"
+            " sum(distinct o_shippriority) from orders"
+            " group by o_orderstatus order by o_orderstatus",
+        )
+
+    def test_wide_decimal_division(self, fused, local):
+        self._check(
+            fused, local,
+            """select 100.00 * sum(case when p_type like 'PROMO%'
+                        then l_extendedprice * (1 - l_discount) else 0 end)
+                      / sum(l_extendedprice * (1 - l_discount))
+               from lineitem, part where l_partkey = p_partkey""",
+        )
+
+    def test_wide_avg(self, fused, local):
+        self._check(
+            fused, local,
+            "select l_returnflag,"
+            " avg(l_extendedprice * (1 - l_discount) * (1 + l_tax))"
+            " from lineitem group by l_returnflag order by l_returnflag",
+        )

@@ -14,18 +14,17 @@ from test_tpch_suite import QUERIES
 from trino_tpu.testing import DistributedQueryRunner, LocalQueryRunner
 
 # queries whose plans still contain non-fusable shapes (the tracked
-# fallback census; shrink this set as the fused tier widens):
-#  2  - correlated scalar subquery (single_row join)
-#  8,9 - CASE over wide-decimal division / EXTRACT chains
-#  11 - global-total correlated HAVING (single_row join)
-#  13 - LEFT join with filter on the build side
-#  14 - wide-decimal division in the projection (CASE/when revenue share)
-#  15 - view-style max-over-group correlated comparison (single_row)
-#  16 - DISTINCT aggregate (count(distinct ps_suppkey))
-#  17 - correlated scalar AVG subquery (single_row)
-#  21 - multi-EXISTS/NOT-EXISTS with inequality correlation (join filter)
-#  22 - substring IN + NOT EXISTS + global scalar subquery (single_row)
-EXPECTED_FALLBACK = {2, 8, 9, 11, 13, 14, 15, 16, 17, 21, 22}
+# fallback census; shrink this set as the fused tier widens).
+# Round-4 clearances: correlated/uncorrelated scalar subqueries trace
+# (single_row LEFT with dup detection + broadcast scalar CROSS), DISTINCT
+# aggregates dedup in-trace, wide-decimal division/narrowing-cast/avg run
+# through the exact div128_round kernel, and comma-list CROSS joins
+# flatten into the reorder graph (clearing the part x supplier crosses).
+# Remaining:
+#  13 - LEFT join with ON-filter (null-extension repair is host-only)
+#  15 - join criteria on wide DECIMAL keys (two-lane key hashing)
+#  21 - multi-EXISTS/NOT-EXISTS with inequality correlation (semi filter)
+EXPECTED_FALLBACK = {13, 15, 21}
 
 # fused-vs-interpreter equality runs only where the fused tier actually
 # executes (fallback queries would compare the interpreter with itself)

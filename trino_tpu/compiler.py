@@ -718,12 +718,27 @@ class ExprCompiler:
                 bhi, blo = D128.neg128(bhi, blo)
             hi, lo = D128.add128(ahi, alo, bhi, blo)
             return jnp.stack([hi, lo], axis=1), valid
-        if name in ("divide", "modulus"):
+        if name == "divide":
+            # exact 128-bit long division with HALF_UP rounding
+            # (reference: UnscaledDecimal128Arithmetic.divideRoundUp) —
+            # fully traced, so wide division fuses
+            shift = rt.scale - sa + sb
+            ahi, alo = _as_pair128(a[0], 0, 0)
+            bhi, blo = _as_pair128(b[0], 0, 0)
+            if shift < 0:
+                bhi, blo = D128.rescale_up_wide(bhi, blo, -shift)
+                shift = 0
+            qhi, qlo, ok = D128.div128_round(ahi, alo, bhi, blo, shift)
+            valid = valid & ok
+            if rt.wide:
+                return jnp.stack([qhi, qlo], axis=1), valid
+            return qlo, valid
+        if name == "modulus":
             # narrow at runtime (exact when operands fit int64); queries
             # whose operands genuinely exceed int64 error rather than
             # silently truncate
-            ad = _narrow_checked(a[0], "decimal division")
-            bd = _narrow_checked(b[0], "decimal division")
+            ad = _narrow_checked(a[0], "decimal modulus")
+            bd = _narrow_checked(b[0], "decimal modulus")
             narrowed = Call(
                 type=T.decimal(18, rt.scale), name=name, args=expr.args
             )
@@ -1042,6 +1057,30 @@ class ExprCompiler:
                 # wide -> wide upscale stays in (hi, lo) lanes
                 hi, lo = _as_pair128(d, st.scale, rt.scale)
                 return jnp.stack([hi, lo], axis=1), v
+            if (
+                isinstance(rt, T.DecimalType)
+                and isinstance(st, T.DecimalType)
+                and st.scale - rt.scale <= 18
+            ):
+                # wide -> narrow: HALF_UP rescale via exact long division
+                # (traceable). Values that genuinely exceed the target
+                # become NULL (the eager reference path raises instead;
+                # overflow inputs are errors either way)
+                from trino_tpu.ops import decimal128 as D128
+
+                hi, lo = d[:, 0], d[:, 1]
+                shift = rt.scale - st.scale
+                if shift >= 0:
+                    hi, lo = D128.rescale_up_wide(hi, lo, shift)
+                else:
+                    dhi, dlo = D128.widen_i64(
+                        jnp.full_like(lo, 10 ** (-shift))
+                    )
+                    hi, lo, _ok = D128.div128_round(hi, lo, dhi, dlo, 0)
+                fits = hi == (lo >> jnp.int64(63))  # sign-extension check
+                if rt.wide:
+                    return jnp.stack([hi, lo], axis=1), v
+                return lo, v & fits
             # other casts narrow at runtime (exact when values fit int64)
             d = _narrow_checked(d, f"cast {st} -> {rt}")
         if isinstance(rt, T.DecimalType):

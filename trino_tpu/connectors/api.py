@@ -162,24 +162,32 @@ class CatalogManager:
         return sorted(self._catalogs)
 
 
-def slab_bytes_estimate(types: Sequence, rows: int) -> int:
-    """Bytes needed to stage ``rows`` of these column types in HBM
-    (wide DECIMALs store (n, 2) int64 lanes; +1 byte/row validity)."""
+# staging quantum: slabs are padded to a multiple of this row count, so
+# any power-of-two chunk size up to the quantum can dynamic_slice them —
+# one staged copy serves every chunk-size setting
+SLAB_PAD_QUANTUM = 1 << 22
+
+
+def slab_padded_rows(rows: int, cap: int) -> int:
+    """Rows a staged slab actually allocates (quantum padding)."""
+    quantum = max(cap, SLAB_PAD_QUANTUM)
+    return ((rows + quantum - 1) // quantum) * quantum
+
+
+def slab_bytes_estimate(types: Sequence, rows: int, cap: int) -> int:
+    """Bytes needed to stage ``rows`` of these column types in HBM —
+    measured at the PADDED allocation (wide DECIMALs store (n, 2) int64
+    lanes; +1 byte/row validity), so admission bounds reflect reality."""
     import numpy as np
 
+    padded = slab_padded_rows(rows, cap)
     nbytes = 0
     for t in types:
         width = np.dtype(t.storage_dtype).itemsize
         if getattr(t, "wide", False):
             width *= 2
-        nbytes += rows * (width + 1)
+        nbytes += padded * (width + 1)
     return nbytes
-
-
-# staging quantum: slabs are padded to a multiple of this row count, so
-# any power-of-two chunk size up to the quantum can dynamic_slice them —
-# one staged copy serves every chunk-size setting
-SLAB_PAD_QUANTUM = 1 << 22
 
 
 def stage_device_slab(host_batches: Sequence[Batch], cap: int):

@@ -82,7 +82,8 @@ class MemoryConnector(Connector):
         )
 
         nbytes = slab_bytes_estimate(
-            [ts.columns[name_to_idx[c]].type for c in columns], total_rows
+            [ts.columns[name_to_idx[c]].type for c in columns],
+            total_rows, cap,
         )
         if nbytes > max_bytes:
             return None

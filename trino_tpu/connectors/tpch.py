@@ -292,7 +292,7 @@ class TpchConnector(Connector):
         ts = self.get_table(schema, table)
         by_name = {c.name: c for c in ts.columns}
         if slab_bytes_estimate(
-            [by_name[c].type for c in columns], rows
+            [by_name[c].type for c in columns], rows, cap
         ) > max_bytes:
             return None
         key = (schema, table, tuple(columns))

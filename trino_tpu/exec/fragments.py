@@ -75,19 +75,43 @@ def _is_wide_type(t) -> bool:
 
 
 def _expr_blocks_fusion(e) -> bool:
-    """Division/modulus/cast touching wide DECIMAL narrows at runtime with
-    a data-dependent check — not traceable; those queries interpret."""
+    """Modulus/cast touching wide DECIMAL narrows at runtime with a
+    data-dependent check — not traceable; those queries interpret.
+    (Wide DIVISION traces: ops/decimal128.div128_round.)"""
     from trino_tpu.ir import Call, SpecialForm
 
     if isinstance(e, Call):
-        if e.name in ("divide", "modulus", "cast") and (
+        if e.name == "modulus" and (
             _is_wide_type(e.type) or any(_is_wide_type(a.type) for a in e.args)
         ):
             return True
+        if e.name == "cast" and any(_is_wide_type(a.type) for a in e.args):
+            st, rt = e.args[0].type, e.type
+            traced = (
+                isinstance(rt, (T.DoubleType, T.RealType))
+                or (
+                    isinstance(rt, T.DecimalType)
+                    and isinstance(st, T.DecimalType)
+                    and (rt.wide and rt.scale >= st.scale
+                         or st.scale - rt.scale <= 18)
+                )
+            )
+            if not traced:
+                return True
         return any(_expr_blocks_fusion(a) for a in e.args)
     if isinstance(e, SpecialForm):
         return any(_expr_blocks_fusion(a) for a in e.args)
     return False
+
+
+def grow_or_raise(name: str, caps: "_Caps") -> None:
+    """Dispatch one fired traced flag: capacity names grow for a retry;
+    ``err!<message>`` names are data-dependent runtime ERRORS discovered
+    inside a compiled program (e.g. a scalar subquery returning multiple
+    rows) and fail the query."""
+    if name.startswith("err!"):
+        raise ExecutionError(name[4:])
+    caps.grow(name, 4 if name.startswith("agg") else 2)
 
 
 def query_fusable(sub: SubPlan) -> bool:
@@ -111,10 +135,14 @@ def fragment_fusable(frag: PlanFragment) -> bool:
                 ):
                     return False
                 continue
+            if n.join_type == "CROSS" and n.single_row:
+                # uncorrelated scalar subquery: the one-row build
+                # broadcasts into every probe row (traced)
+                continue
             if (
                 n.join_type not in ("INNER", "LEFT")
                 or not n.criteria
-                or n.single_row
+                or (n.single_row and n.join_type != "LEFT")
                 or (n.join_type == "LEFT" and n.filter is not None)
                 or any(
                     _is_wide_type(a.type) or _is_wide_type(b.type)
@@ -125,8 +153,8 @@ def fragment_fusable(frag: PlanFragment) -> bool:
             if n.filter is not None and _expr_blocks_fusion(n.filter):
                 return False
         if isinstance(n, P.Aggregate):
-            if any(fn.distinct for _, fn in n.aggregates):
-                return False
+            if any(fn.distinct for _, fn in n.aggregates) and n.step != "single":
+                return False  # distinct dedup must see all rows at once
             if any(_is_wide_type(k.type) for k in n.group_keys):
                 return False  # wide group keys: interpreter path
             for _, fn in n.aggregates:
@@ -134,16 +162,8 @@ def fragment_fusable(frag: PlanFragment) -> bool:
                     "sum", "count", "count_star", "min", "max", "avg"
                 ):
                     return False
-                arg_wide = fn.argument is not None and _is_wide_type(
-                    fn.argument.type
-                )
-                # wide sums/min/max fuse (limb accumulators, two-lane
-                # extrema); wide avg needs exact 128/64 division,
-                # which is host-only — interpret those
-                if fn.kind == "avg" and (
-                    arg_wide or _is_wide_type(fn.result_type)
-                ):
-                    return False
+                # wide sums/min/max/avg all fuse (limb accumulators,
+                # two-lane extrema, div128_round for the avg divide)
         if isinstance(n, P.Filter) and _expr_blocks_fusion(n.predicate):
             return False
         if isinstance(n, P.Project) and any(
@@ -279,7 +299,7 @@ class FragmentedExecutor(DistributedExecutor):
                 for nm, fl in zip(names, seg):
                     if fl:
                         overflowed = True
-                        caps.grow(nm, 4 if nm.startswith("agg") else 2)
+                        grow_or_raise(nm, caps)
                 if seg.any() and key is not None:
                     self.programs.pop(key, None)
             if not overflowed:
@@ -446,7 +466,7 @@ class FragmentedExecutor(DistributedExecutor):
                 break
             except StreamOverflow as e:
                 for nm in e.names:
-                    caps.grow(nm, 4 if nm.startswith("agg") else 2)
+                    grow_or_raise(nm, caps)
         if isinstance(frag.root, P.Output):
             names_holder[frag.id] = list(frag.root.column_names)
             cols = [res.column(s) for s in frag.root.symbols]
@@ -548,7 +568,7 @@ class FragmentedExecutor(DistributedExecutor):
                 break
             for nm, f in zip(meta.overflow_names, flags_np):
                 if f:
-                    caps.grow(nm, 4 if nm.startswith("agg") else 2)
+                    grow_or_raise(nm, caps)
         cols = [
             Column(t, d, v, dictionary)
             for (d, v), (t, dictionary) in zip(data, meta.column_meta)
@@ -601,6 +621,31 @@ class FragmentedExecutor(DistributedExecutor):
             program_key=("frag", frag.id, apply_exchange, id(frag.root)),
             defer=defer,
         )
+
+
+def _dup_key_rows(keys, sel):
+    """Boolean per-row flags: row's full key appears on MORE than one
+    selected row. Sort-based (scatter-free): adjacent equal keys in the
+    sorted order mark both neighbors; a second sort on the permutation
+    restores original row order."""
+    from trino_tpu.ops.aggregation import _sortable_keys
+
+    n = sel.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ops = _sortable_keys(keys, sel)
+    nk = len(ops)
+    sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=nk)
+    perm = sorted_ops[-1]
+    s_sel = ~sorted_ops[0]
+    same_prev = idx > 0  # first sorted row has no predecessor
+    for k in sorted_ops[:nk]:
+        prev = jnp.concatenate([k[:1], k[:-1]])
+        same_prev = same_prev & (k == prev)
+    same_prev = same_prev & s_sel
+    same_next = jnp.concatenate([same_prev[1:], jnp.zeros(1, jnp.bool_)])
+    dup_sorted = (same_prev | same_next) & s_sel
+    _, back = jax.lax.sort((perm, dup_sorted), num_keys=1)
+    return back
 
 
 class _OptPack:
@@ -819,10 +864,15 @@ class _FragmentTracer(DistributedExecutor):
             return self._agg_final(node, res)
         return self._agg_single(node, res)
 
-    def _agg_inputs(self, node: P.Aggregate, res: Result):
-        """Traceable version of the interpreter's aggregate input prep."""
+    def _agg_inputs(self, node: P.Aggregate, res: Result,
+                    distinct_keys=None, distinct_sel=None):
+        """Traceable version of the interpreter's aggregate input prep.
+        ``distinct_keys``/``distinct_sel`` enable DISTINCT dedup (single
+        step only — the fragmenter gathers distinct aggregations)."""
         agg_inputs, specs, string_dicts = [], [], []
         for _, fn in node.aggregates:
+            if fn.distinct and distinct_keys is None:
+                raise FusedUnsupported("distinct aggregate outside single step")
             if fn.kind == "count_star":
                 if fn.filter is not None:
                     fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
@@ -847,6 +897,21 @@ class _FragmentTracer(DistributedExecutor):
                 fc = res.column(P.Symbol(fn.filter.name, T.BOOLEAN))
                 fmask = fc.data & fc.valid_mask()
                 valid = fmask if valid is None else (valid & fmask)
+            if fn.distinct:
+                # DISTINCT: only the first occurrence of each
+                # (group keys, value) pair contributes (reference:
+                # MarkDistinctOperator / distinct accumulators)
+                from trino_tpu.ops.aggregation import distinct_first_mask
+
+                vmask = (
+                    distinct_sel
+                    if valid is None
+                    else (valid & distinct_sel)
+                )
+                first = distinct_first_mask(
+                    distinct_keys, (data, c.valid_mask()), vmask
+                )
+                valid = first if valid is None else (valid & first)
             agg_inputs.append((data, valid))
             specs.append(sum_spec_for(fn, data))
         return agg_inputs, specs, string_dicts
@@ -1129,7 +1194,10 @@ class _FragmentTracer(DistributedExecutor):
 
     def _agg_single(self, node: P.Aggregate, res: Result) -> Result:
         sel = res.batch.selection_mask()
-        agg_inputs, specs, string_dicts = self._agg_inputs(node, res)
+        dkeys = [res.pair(k) for k in node.group_keys]
+        agg_inputs, specs, string_dicts = self._agg_inputs(
+            node, res, distinct_keys=dkeys, distinct_sel=sel
+        )
         if not node.group_keys:
             raw = global_aggregate(sel, agg_inputs, specs)
             cols = self._finalize_traced(node, raw, string_dicts, 1)
@@ -1179,6 +1247,23 @@ class _FragmentTracer(DistributedExecutor):
             if getattr(ssum, "ndim", 1) == 2 and ssum.shape[1] == 2:
                 cnt = jnp.reshape(cnt, (-1,))
                 valid = cnt > 0
+                if fn.kind == "avg":
+                    from trino_tpu.ops.decimal128 import (
+                        div128_round,
+                        widen_i64,
+                    )
+
+                    chi, clo = widen_i64(jnp.maximum(cnt, 1))
+                    qhi, qlo, _ok = div128_round(
+                        ssum[:, 0], ssum[:, 1], chi, clo, 0
+                    )
+                    if isinstance(t, T.DecimalType) and t.wide:
+                        cols.append(
+                            Column(t, jnp.stack([qhi, qlo], axis=1), valid)
+                        )
+                    else:
+                        cols.append(Column(t, qlo.astype(t.storage_dtype), valid))
+                    continue
                 if fn.kind not in ("sum", "min", "max"):
                     raise FusedUnsupported(f"wide decimal {fn.kind}")
                 cols.append(Column(t, ssum, valid))
@@ -1215,11 +1300,21 @@ class _FragmentTracer(DistributedExecutor):
     def _exec_join(self, node: P.Join) -> Result:
         if node.join_type in ("SEMI", "ANTI"):
             return self._exec_semi_join_traced(node)
+        if node.join_type == "CROSS" and node.single_row:
+            return self._exec_scalar_cross_traced(node)
         if node.join_type not in ("INNER", "LEFT") or not node.criteria:
             raise FusedUnsupported(f"join {node.join_type}")
         right = self._exec(node.right)
         left = self._exec(node.left)
         lkeys, rkeys = self._join_keys(left, right, node.criteria)
+        if node.single_row:
+            # correlated scalar subquery (EnforceSingleRowNode analog):
+            # any build-key group with >1 selected rows that a probe row
+            # actually joins is a runtime error. The dup flag rides the
+            # probe as a synthetic build column so unmatched dup groups
+            # (which the reference tolerates) don't fire.
+            dup = _dup_key_rows(rkeys, right.batch.selection_mask())
+            self._single_row_dup = dup  # consumed below via build columns
         ph, _pv = J.hash_keys(lkeys)
         bh, _bv = J.hash_keys(rkeys)
         # per-shard probing needs key-co-partitioned sides, which only a
@@ -1240,6 +1335,12 @@ class _FragmentTracer(DistributedExecutor):
             c = right.column(s)
             build_cols.extend([c.data, c.valid_mask()])
             build_schema.append((s, c.dictionary))
+        if node.single_row:
+            # synthetic build lane: gathered per output row, True only
+            # when the matched build row's key group had duplicates
+            build_cols.extend(
+                [self._single_row_dup, jnp.ones_like(self._single_row_dup)]
+            )
         probe_keys = []
         for kd, kv in lkeys:
             probe_keys.extend([kd, kv])
@@ -1279,6 +1380,15 @@ class _FragmentTracer(DistributedExecutor):
             cols.append(Column(s.type, out_cols[i], out_cols[i + 1], d))
             layout[s.name] = len(cols) - 1
             i += 2
+        if node.single_row:
+            dup_hit = out_cols[i] & out_cols[i + 1] & out_sel
+            self.overflows.append(
+                (
+                    "err!Scalar sub-query has returned multiple rows",
+                    jnp.any(dup_hit),
+                )
+            )
+            i += 2
         total = out_cols[0].shape[0]
         result = Result(Batch(cols, total, out_sel), layout)
         if node.filter is not None:
@@ -1291,6 +1401,53 @@ class _FragmentTracer(DistributedExecutor):
             mask = ExprCompiler(work).predicate_mask(expr)
             result = Result(Batch(result.batch.columns, total, mask & out_sel), layout)
         return result
+
+    def _exec_scalar_cross_traced(self, node: P.Join) -> Result:
+        """Uncorrelated scalar subquery (single-row CROSS): broadcast the
+        one selected build row into every probe row. Zero rows -> NULL;
+        more than one -> runtime error via the err! flag channel
+        (reference: ``EnforceSingleRowNode`` semantics)."""
+        right = self._exec(node.right)
+        left = self._exec(node.left)
+        rsel = right.batch.selection_mask()
+        cnt = jnp.sum(rsel.astype(jnp.int32))
+        self.overflows.append(
+            ("err!Scalar sub-query has returned multiple rows", cnt > 1)
+        )
+        pick = jnp.argmax(rsel)  # index of the selected row (0 if none)
+        cap = left.batch.capacity
+        cols: list[Column] = []
+        layout: dict[str, int] = {}
+        for s in node.left.output_symbols:
+            c = left.column(s)
+            cols.append(c)
+            layout[s.name] = len(cols) - 1
+        from jax.sharding import NamedSharding
+
+        from trino_tpu.parallel.mesh import AXIS as _AXIS
+
+        row_sh = NamedSharding(self.mesh, PS(_AXIS))
+        has_row = cnt >= 1
+        for s in node.right.output_symbols:
+            c = right.column(s)
+            val = c.data[pick]
+            # materialized row-sharded arrays (not lazy broadcast views of
+            # the replicated build): these columns feed shard_map operands
+            # downstream, which need real global row-sharded arrays
+            data = jax.lax.with_sharding_constraint(
+                jnp.zeros((cap,) + val.shape, dtype=c.data.dtype) + val,
+                row_sh,
+            )
+            valid = jax.lax.with_sharding_constraint(
+                jnp.zeros((cap,), dtype=jnp.bool_)
+                | (c.valid_mask()[pick] & has_row),
+                row_sh,
+            )
+            cols.append(Column(s.type, data, valid, c.dictionary))
+            layout[s.name] = len(cols) - 1
+        return Result(
+            Batch(cols, left.batch.num_rows, left.batch.sel), layout
+        )
 
     def _exec_semi_join_traced(self, node: P.Join) -> Result:
         """SEMI/ANTI as a traced membership mark: probe key rows carry only

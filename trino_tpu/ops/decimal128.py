@@ -255,6 +255,161 @@ def limb_sums_to_pair(limbs):
     return (lp_hi + hp_lo), lp_lo
 
 
+_NLIMB = 9  # 288 bits: |dividend| < 2^127 times 10^shift (shift <= 39)
+
+
+def _limbs9_from_pair(hi, lo):
+    """|value| (non-negative (hi, lo)) -> (n, 9) array of 32-bit limbs in
+    int64 lanes, little-endian."""
+    u_lo = _u(lo)
+    u_hi = _u(hi)
+    mask = jnp.uint64(0xFFFFFFFF)
+    limbs = [
+        (u_lo & mask).astype(jnp.int64),
+        ((u_lo >> jnp.uint64(32)) & mask).astype(jnp.int64),
+        (u_hi & mask).astype(jnp.int64),
+        ((u_hi >> jnp.uint64(32)) & mask).astype(jnp.int64),
+    ]
+    z = jnp.zeros_like(limbs[0])
+    limbs += [z] * (_NLIMB - 4)
+    return jnp.stack(limbs, axis=1)
+
+
+def _limbs_mul_small(limbs, m: int):
+    """(n, k) limb array times a scalar < 2^31, with carry propagation.
+    Returns (limbs, lost) — ``lost`` marks rows whose product overflowed
+    the limb width."""
+    k = limbs.shape[1]
+    out = []
+    carry = jnp.zeros(limbs.shape[0], dtype=jnp.int64)
+    for j in range(k):
+        prod = limbs[:, j] * jnp.int64(m) + carry
+        out.append(prod & jnp.int64(0xFFFFFFFF))
+        carry = prod >> jnp.int64(32)
+    return jnp.stack(out, axis=1), carry != 0
+
+
+def _limbs_scale10(limbs, digits: int):
+    """Multiply a limb array by 10**digits (digits >= 0) in <2^31 chunks.
+    Returns (limbs, lost)."""
+    lost = jnp.zeros(limbs.shape[0], dtype=jnp.bool_)
+    while digits > 0:
+        step = min(digits, 9)
+        limbs, l = _limbs_mul_small(limbs, 10**step)
+        lost = lost | l
+        digits -= step
+    return limbs, lost
+
+
+def div128_round(ahi, alo, bhi, blo, shift: int):
+    """Exact DECIMAL division with HALF_UP rounding:
+    ``round(a * 10**shift / b)`` over signed 128-bit (hi, lo) pairs.
+
+    Reference semantics: ``spi/type/UnscaledDecimal128Arithmetic.java``
+    divideRoundUp — scale the dividend, divide magnitudes, round half
+    away from zero, apply the sign. The magnitude division is a
+    bit-serial restoring long division over 288-bit limbs inside a
+    ``fori_loop`` (shift-in quotient bits; no scatters), fully
+    vectorized across rows. Division by zero, a scaled dividend past 288
+    bits, or a quotient past 128 bits all yield 0 with ``ok=False``
+    (callers turn that into NULL; the eager reference raises instead —
+    such inputs are errors either way).
+
+    Returns (qhi, qlo, ok)."""
+    sign_neg = (ahi < 0) ^ (bhi < 0)
+    na_hi, na_lo = neg128(ahi, alo)
+    abs_a_hi = jnp.where(ahi < 0, na_hi, ahi)
+    abs_a_lo = jnp.where(ahi < 0, na_lo, alo)
+    nb_hi, nb_lo = neg128(bhi, blo)
+    abs_b_hi = jnp.where(bhi < 0, nb_hi, bhi)
+    abs_b_lo = jnp.where(bhi < 0, nb_lo, blo)
+
+    num = _limbs9_from_pair(abs_a_hi, abs_a_lo)
+    ok = (abs_b_hi != 0) | (abs_b_lo != 0)
+    if shift > 0:
+        num, lost = _limbs_scale10(num, shift)
+        ok = ok & ~lost
+    den = _limbs9_from_pair(abs_b_hi, abs_b_lo)
+
+    nbits = 32 * _NLIMB
+    n = num.shape[0]
+
+    def _ge(x, y):
+        """Lexicographic >= over little-endian limb arrays."""
+        res = jnp.zeros(n, dtype=jnp.bool_)
+        decided = jnp.zeros(n, dtype=jnp.bool_)
+        for j in range(_NLIMB - 1, -1, -1):
+            gt = x[:, j] > y[:, j]
+            lt = x[:, j] < y[:, j]
+            res = jnp.where(~decided & gt, True, res)
+            decided = decided | gt | lt
+        return res | ~decided  # equal counts as >=
+
+    def _sub(x, y):
+        borrow = jnp.zeros(n, dtype=jnp.int64)
+        out = []
+        for j in range(_NLIMB):
+            d = x[:, j] - y[:, j] - borrow
+            borrow = (d < 0).astype(jnp.int64)
+            out.append(d + borrow * jnp.int64(1 << 32))
+        return jnp.stack(out, axis=1)
+
+    def _shl1_or(x, bit):
+        """(x << 1) | bit across limbs; bit is (n,) 0/1."""
+        out = []
+        carry = bit
+        for j in range(_NLIMB):
+            v = (x[:, j] << 1) | carry
+            carry = v >> jnp.int64(32)
+            out.append(v & jnp.int64(0xFFFFFFFF))
+        return jnp.stack(out, axis=1)
+
+    def body(i, carry):
+        rem, quo = carry
+        pos = nbits - 1 - i
+        limb = pos // 32  # traced ints; dynamic_index over limb axis
+        off = pos % 32
+        bits = (
+            jax.lax.dynamic_index_in_dim(num, limb, axis=1, keepdims=False)
+            >> off
+        ) & 1
+        rem = _shl1_or(rem, bits)
+        ge = _ge(rem, den)
+        rem = jnp.where(ge[:, None], _sub(rem, den), rem)
+        quo = _shl1_or(quo, ge.astype(jnp.int64))
+        return rem, quo
+
+    zeros = jnp.zeros_like(num)
+    rem, quo = jax.lax.fori_loop(0, nbits, body, (zeros, zeros))
+    # HALF_UP: round away from zero when 2*rem >= den
+    twice = _shl1_or(rem, jnp.zeros(n, dtype=jnp.int64))
+    roundup = _ge(twice, den) & ok
+    # quo += roundup (carry-propagating add of 0/1)
+    carry = roundup.astype(jnp.int64)
+    limbs_out = []
+    for j in range(_NLIMB):
+        v = quo[:, j] + carry
+        carry = v >> jnp.int64(32)
+        limbs_out.append(v & jnp.int64(0xFFFFFFFF))
+    quo = jnp.stack(limbs_out, axis=1)
+    # quotient must fit 128 bits (magnitude < 2^127: the sign bit of the
+    # hi lane must stay clear before sign application)
+    over = (carry != 0) | (quo[:, 3] >> jnp.int64(31) != 0)
+    for j in range(4, _NLIMB):
+        over = over | (quo[:, j] != 0)
+    ok = ok & ~over
+    q_lo = _u(quo[:, 0]) | (_u(quo[:, 1]) << jnp.uint64(32))
+    q_hi = _u(quo[:, 2]) | (_u(quo[:, 3]) << jnp.uint64(32))
+    qhi = q_hi.astype(jnp.int64)
+    qlo = q_lo.astype(jnp.int64)
+    nqhi, nqlo = neg128(qhi, qlo)
+    qhi = jnp.where(sign_neg, nqhi, qhi)
+    qlo = jnp.where(sign_neg, nqlo, qlo)
+    qhi = jnp.where(ok, qhi, jnp.zeros_like(qhi))
+    qlo = jnp.where(ok, qlo, jnp.zeros_like(qlo))
+    return qhi, qlo, ok
+
+
 def rescale_up_wide(hi, lo, digits: int):
     """Multiply a wide value by 10**digits (digits >= 0), staying exact
     while the true result fits 128 bits."""

@@ -66,8 +66,28 @@ class DistributedExecutor(LocalExecutor):
             node.schema, node.table, target_splits=n * 4, constraint=node.constraint
         )
         if not splits:  # constraint pruned everything
+            # shard-compatible empty: one unselected row per shard (a
+            # 0-capacity batch would feed zero-sized operands into
+            # shard_map programs, which the partitioner rejects)
+            from trino_tpu.columnar import Dictionary as _Dict
+
+            parts = []
+            for _ in range(n):
+                cols = []
+                for s in node.symbols:
+                    wide = isinstance(s.type, T.DecimalType) and s.type.wide
+                    shape = (1, 2) if wide else (1,)
+                    cols.append(
+                        Column(
+                            s.type,
+                            np.zeros(shape, dtype=s.type.storage_dtype),
+                            None,
+                            _Dict([]) if T.is_string(s.type) else None,
+                        )
+                    )
+                parts.append(Batch(cols, 1, np.zeros(1, dtype=np.bool_)))
             return Result(
-                self._empty_batch(node),
+                shard_batch(self.mesh, parts),
                 {s.name: i for i, s in enumerate(node.symbols)},
             )
         per_shard: list[list[Batch]] = [[] for _ in range(n)]
@@ -387,6 +407,10 @@ class DistributedExecutor(LocalExecutor):
         if node.join_type == "LEFT" and node.filter is not None:
             # ON-clause filters on outer joins need the null-extension
             # repair implemented in the local join path
+            return super()._exec_join(node)
+        if node.single_row:
+            # correlated scalar subquery: the local path enforces the
+            # one-match-per-row error semantics (EnforceSingleRowNode)
             return super()._exec_join(node)
         right = self._exec(node.right)  # build first: enables dynamic filter
         left = self._exec(self._apply_dynamic_filters(node, right))

@@ -131,6 +131,19 @@ def broadcast_all(mesh: Mesh, arrays: Sequence[jax.Array], sel: jax.Array):
 
     Returns per-shard-replicated global arrays of the full length.
     """
+    n_ = mesh.devices.size
+    cap = sel.shape[0]
+    if cap % n_:
+        # tiny sources (e.g. a one-row scalar subquery result) pad up to
+        # the mesh width; padding rows are unselected
+        pad = n_ - cap % n_
+        arrays = [
+            jnp.concatenate(
+                [a, jnp.zeros((pad,) + a.shape[1:], dtype=a.dtype)]
+            )
+            for a in arrays
+        ]
+        sel = jnp.concatenate([sel, jnp.zeros(pad, dtype=jnp.bool_)])
 
     @partial(
         smap,

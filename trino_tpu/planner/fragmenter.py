@@ -253,10 +253,16 @@ def _add_exchanges_join(node: P.Join) -> tuple[P.PlanNode, Partitioning]:
     left, lpart = _add_exchanges(node.left)
     right, rpart = _add_exchanges(node.right)
 
+    if node.join_type == "CROSS" and node.single_row:
+        # uncorrelated scalar subquery: broadcast the one-row build so
+        # the probe keeps its partitioning (the scalar is an all_gather
+        # away on every shard)
+        bcast = P.Exchange(right, "broadcast", [], scope="remote")
+        return dataclasses.replace(node, left=left, right=bcast), lpart
     gather_kinds = ("CROSS", "SEMI", "ANTI", "RIGHT", "FULL")
     if (
         node.join_type in gather_kinds
-        or node.single_row
+        or (node.single_row and node.join_type != "LEFT")
         or not node.criteria
         or (node.join_type == "LEFT" and node.filter is not None)
     ):

@@ -126,7 +126,15 @@ def _syntactic_rebuild(graph: _JoinGraph) -> P.PlanNode:
 
 
 def _flattenable(j: P.Join) -> bool:
-    return j.join_type == "INNER" and j.mark_symbol is None
+    # CROSS joins from comma-list FROM flatten too: the reorderer then
+    # connects their relations through the real equality edges instead of
+    # materializing the syntactic cross product (ReorderJoins.java does
+    # the same via MultiJoinNode over INNER+CROSS)
+    return (
+        j.join_type in ("INNER", "CROSS")
+        and j.mark_symbol is None
+        and not j.single_row
+    )
 
 
 def _flatten(node: P.PlanNode) -> _JoinGraph:
