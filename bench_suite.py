@@ -4,8 +4,7 @@ Covers the BASELINE.json evaluation configs beyond the single-kernel
 headline in bench.py:
 - config 2: TPC-H SF1 Q1/Q3/Q5/Q10 engine wall time (SQL in -> rows out,
   spec dbgen data, streamed joins for the lineitem probes)
-- config 3: TPC-DS Q95 (engine wall time; Q64 joins when its full text
-  lands in the suite)
+- config 3: TPC-DS Q64 (full two-CTE text) + Q95 engine wall time
 - config 5: columnar scan+decode rate (GB/s) for parquet and ORC files
   written from dbgen lineitem
 
@@ -49,20 +48,18 @@ def tpch_sf1(queries=(1, 3, 5, 10)) -> dict:
     return out
 
 
-def tpcds_q95() -> dict:
+def tpcds_baseline() -> dict:
+    """Config 3: the full Q64 and Q95 texts (trino_tpu.benchmarks.tpcds)."""
+    from trino_tpu.benchmarks.tpcds import queries as corpus
     from trino_tpu.testing import LocalQueryRunner
 
     runner = LocalQueryRunner()
     runner.session.set("execution_mode", "distributed")
-    sql = (
-        "select count(distinct ws.ws_order_number) "
-        "from tpcds.tiny.web_sales ws "
-        "join tpcds.tiny.date_dim d on ws.ws_ship_date_sk = d.d_date_sk "
-        "where d.d_year = 1999 "
-        "and ws.ws_order_number in "
-        "(select wr_order_number from tpcds.tiny.web_returns)"
-    )
-    return {"q95_s": round(_median_time(runner, sql), 3)}
+    texts = corpus("tpcds.tiny")
+    return {
+        "q64_s": round(_median_time(runner, texts[64]), 3),
+        "q95_s": round(_median_time(runner, texts[95]), 3),
+    }
 
 
 def columnar_scan_rates(sf: float = 0.1) -> dict:
@@ -124,7 +121,7 @@ def run_suite() -> dict:
     suite = {}
     t0 = time.time()
     suite["tpch_sf1"] = tpch_sf1()
-    suite["tpcds"] = tpcds_q95()
+    suite["tpcds"] = tpcds_baseline()
     suite["columnar"] = columnar_scan_rates()
     suite["suite_wall_s"] = round(time.time() - t0, 1)
     return suite

@@ -824,3 +824,186 @@ int64_t tt_tpch_textpool(uint8_t* out, int64_t size, const uint8_t* blob,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// ORC integer/byte encoders (writer-side mirror of the decoders above;
+// reference lib/trino-orc RunLengthIntegerWriterV2 semantics, rebuilt from
+// the public ORC spec). Greedy: constant runs >=6 become SHORT_REPEAT (3..10)
+// or DELTA-with-zero-delta chunks (<=512); everything else packs as DIRECT.
+
+namespace orc_enc {
+
+static inline uint64_t zigzag64(int64_t v) {
+    return ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+}
+
+static inline int width_code(int w) {
+    if (w <= 24) return w - 1;
+    if (w <= 26) return 24;
+    if (w <= 28) return 25;
+    if (w <= 30) return 26;
+    if (w <= 32) return 27;
+    if (w <= 40) return 28;
+    if (w <= 48) return 29;
+    if (w <= 56) return 30;
+    return 31;
+}
+
+static inline int closest_fixed_bits_enc(int n) {
+    if (n < 1) return 1;
+    if (n <= 24) return n;
+    if (n <= 26) return 26;
+    if (n <= 28) return 28;
+    if (n <= 30) return 30;
+    if (n <= 32) return 32;
+    if (n <= 40) return 40;
+    if (n <= 48) return 48;
+    if (n <= 56) return 56;
+    return 64;
+}
+
+static inline int bits_of(uint64_t v) {
+    int b = 0;
+    while (v) { b++; v >>= 1; }
+    return b ? b : 1;
+}
+
+struct BitWriter {
+    uint8_t* out;
+    int64_t pos = 0;
+    uint8_t cur = 0;
+    int bit = 0;
+    void put(uint64_t v, int width) {
+        for (int i = width - 1; i >= 0; i--) {
+            cur = (uint8_t)((cur << 1) | ((v >> i) & 1));
+            if (++bit == 8) { out[pos++] = cur; cur = 0; bit = 0; }
+        }
+    }
+    void flush() {
+        if (bit) { out[pos++] = (uint8_t)(cur << (8 - bit)); cur = 0; bit = 0; }
+    }
+};
+
+static inline void put_varint(uint8_t* out, int64_t* pos, uint64_t u) {
+    while (u >= 0x80) { out[(*pos)++] = (uint8_t)(u | 0x80); u >>= 7; }
+    out[(*pos)++] = (uint8_t)u;
+}
+
+// DIRECT chunk of `n` (<=512) pre-zigzagged values.
+static void emit_direct(const uint64_t* u, int64_t n, uint8_t* out, int64_t* pos) {
+    uint64_t maxv = 0;
+    for (int64_t i = 0; i < n; i++) if (u[i] > maxv) maxv = u[i];
+    int width = closest_fixed_bits_enc(bits_of(maxv));
+    int code = width_code(width);
+    int64_t ln = n - 1;
+    out[(*pos)++] = (uint8_t)(0x40 | (code << 1) | (ln >> 8));
+    out[(*pos)++] = (uint8_t)(ln & 0xFF);
+    BitWriter bw{out + *pos};
+    for (int64_t i = 0; i < n; i++) bw.put(u[i], width);
+    bw.flush();
+    *pos += bw.pos;
+}
+
+static void emit_constant(int64_t value, int64_t run, int32_t is_signed,
+                          uint8_t* out, int64_t* pos) {
+    uint64_t uval = is_signed ? zigzag64(value) : (uint64_t)value;
+    while (run > 0) {
+        if (run >= 3 && run <= 10) {
+            int width = (bits_of(uval) + 7) / 8;
+            if (width < 1) width = 1;
+            out[(*pos)++] = (uint8_t)(((width - 1) << 3) | (run - 3));
+            for (int b = width - 1; b >= 0; b--)
+                out[(*pos)++] = (uint8_t)(uval >> (8 * b));
+            return;
+        }
+        int64_t take = run < 512 ? run : 512;
+        if (take < 3) {  // trailing 1-2: DIRECT them
+            uint64_t tmp[2] = {uval, uval};
+            emit_direct(tmp, take, out, pos);
+            return;
+        }
+        int64_t ln = take - 1;
+        out[(*pos)++] = (uint8_t)(0xC0 | (ln >> 8));  // DELTA, width code 0
+        out[(*pos)++] = (uint8_t)(ln & 0xFF);
+        put_varint(out, pos, is_signed ? zigzag64(value) : (uint64_t)value);
+        put_varint(out, pos, 0);  // delta0 = 0
+        run -= take;
+    }
+}
+
+}  // namespace orc_enc
+
+extern "C" {
+
+// RLEv2-encode `n` int64s; returns bytes written (caller sizes out at
+// n*9 + 64 worst case).
+int64_t tt_orc_rle2_encode(const int64_t* vals, int64_t n, int32_t is_signed,
+                           uint8_t* out) {
+    using namespace orc_enc;
+    if (n == 0) return 0;
+    std::vector<uint64_t> u((size_t)n);
+    for (int64_t i = 0; i < n; i++)
+        u[i] = is_signed ? zigzag64(vals[i]) : (uint64_t)vals[i];
+    int64_t pos = 0, i = 0, lit = 0;  // lit = start of pending literals
+    while (i < n) {
+        int64_t j = i + 1;
+        while (j < n && vals[j] == vals[i]) j++;
+        int64_t run = j - i;
+        if (run >= 6) {
+            for (int64_t c = lit; c < i; c += 512)
+                emit_direct(&u[c], (i - c) < 512 ? (i - c) : 512, out, &pos);
+            emit_constant(vals[i], run, is_signed, out, &pos);
+            lit = j;
+        }
+        i = j;
+    }
+    for (int64_t c = lit; c < n; c += 512)
+        emit_direct(&u[c], (n - c) < 512 ? (n - c) : 512, out, &pos);
+    return pos;
+}
+
+// Byte-RLE encode; returns bytes written (out sized n*2 + 64).
+int64_t tt_orc_byte_rle_encode(const uint8_t* b, int64_t n, uint8_t* out) {
+    int64_t pos = 0, i = 0, lit = 0;
+    while (i < n) {
+        int64_t j = i + 1;
+        while (j < n && b[j] == b[i]) j++;
+        int64_t run = j - i;
+        if (run >= 3) {
+            while (lit < i) {  // flush literals
+                int64_t take = (i - lit) < 128 ? (i - lit) : 128;
+                out[pos++] = (uint8_t)(256 - take);
+                for (int64_t k = 0; k < take; k++) out[pos++] = b[lit + k];
+                lit += take;
+            }
+            int64_t rem = run;
+            while (rem > 0) {
+                int64_t take = rem < 130 ? rem : 130;
+                if (rem - take == 1 || rem - take == 2) take -= 3 - (rem - take);
+                out[pos++] = (uint8_t)(take - 3);
+                out[pos++] = b[i];
+                rem -= take;
+            }
+            lit = j;
+        }
+        i = j;
+    }
+    while (lit < n) {
+        int64_t take = (n - lit) < 128 ? (n - lit) : 128;
+        out[pos++] = (uint8_t)(256 - take);
+        for (int64_t k = 0; k < take; k++) out[pos++] = b[lit + k];
+        lit += take;
+    }
+    return pos;
+}
+
+// Plain LEB128 of uint64 values (ORC string-length / dictionary-code aux
+// streams, decimal unscaled varints after host-side zigzag).
+int64_t tt_orc_varint_encode(const uint64_t* vals, int64_t n, uint8_t* out) {
+    using namespace orc_enc;
+    int64_t pos = 0;
+    for (int64_t i = 0; i < n; i++) put_varint(out, &pos, vals[i]);
+    return pos;
+}
+
+}  // extern "C"

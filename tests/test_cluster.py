@@ -343,3 +343,70 @@ class TestFusedStrictMode:
         task._run()
         assert task.state == "FINISHED", task.error
         assert task.execution_path == "interpreter"
+
+
+class TestSchedulerPolicies:
+    def test_uniform_node_selector_balances(self):
+        """UniformNodeSelector analog: placements favor the least-loaded
+        node (reference NodeScheduler.java/UniformNodeSelector.java)."""
+        from trino_tpu.server.cluster import (
+            ClusterNodeManager,
+            NodeScheduler,
+            WorkerNode,
+        )
+
+        nm = ClusterNodeManager()
+        ns = NodeScheduler(nm)
+        a, b = WorkerNode("a", "http://a"), WorkerNode("b", "http://b")
+        # node a is already busy with 3 tasks
+        for _ in range(3):
+            ns.acquire(a)
+        picks = ns.select([a, b], 4)
+        ids = [n.node_id for n in picks]
+        # b absorbs the imbalance: 3 of 4 new tasks land there
+        assert ids.count("b") == 3 and ids.count("a") == 1
+        ns.release(a)
+        assert ns._assigned["a"] == 2
+
+    def test_phased_order_builds_before_probes(self):
+        """PhasedExecutionSchedule analog: among one join's feeding
+        fragments the build (right) side launches first."""
+        from trino_tpu.exec.fragments import fragment_plan
+        from trino_tpu.planner import plan as P
+        from trino_tpu.server.cluster import phased_order
+        from trino_tpu.testing import LocalQueryRunner
+
+        r = LocalQueryRunner()
+        sub = fragment_plan(
+            r.plan(
+                "select count(*) from tpch.tiny.lineitem l"
+                " join tpch.tiny.orders o on l.l_orderkey = o.o_orderkey"
+            )
+        )
+        order = [f.id for f in phased_order(sub)]
+        # find the root fragment's join: its build-side fragment must
+        # appear in the launch order before the probe-side fragment
+        frags = {f.id: f for f in sub.all_fragments()}
+        join = next(
+            n
+            for f in frags.values()
+            for n in P.walk_plan(f.root)
+            if isinstance(n, P.Join)
+        )
+        def first_remote(node):
+            return next(
+                (
+                    rs.fragment_id
+                    for rs in P.walk_plan(node)
+                    if isinstance(rs, P.RemoteSource)
+                ),
+                None,
+            )
+        build_fid = first_remote(join.right)
+        probe_fid = first_remote(join.left)
+        if build_fid is not None and probe_fid is not None:
+            assert order.index(build_fid) < order.index(probe_fid)
+        # producers always precede consumers
+        for f in frags.values():
+            for src_fid in f.source_fragment_ids:
+                assert order.index(src_fid) < order.index(f.id)

@@ -1,0 +1,212 @@
+"""Iterative optimizer: Memo/group-reference mechanics, the pattern DSL,
+and each default rule.
+
+Reference: ``sql/planner/iterative/IterativeOptimizer.java:53``,
+``iterative/Memo.java:64``, ``lib/trino-matching`` and the
+``iterative/rule/`` analogs cited on each rule class.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.config import Session
+from trino_tpu.ir import const, special, variable
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.iterative import (
+    DEFAULT_RULES,
+    GroupReference,
+    IterativeOptimizer,
+    Memo,
+    pattern,
+)
+from trino_tpu.testing import LocalQueryRunner
+
+
+def scan(name="t", cols=("a", "b")):
+    syms = [P.Symbol(c, T.BIGINT) for c in cols]
+    return P.TableScan("memory", "default", name, syms, list(cols))
+
+
+class TestMemo:
+    def test_insert_groups_children(self):
+        memo = Memo()
+        s = scan()
+        f = P.Filter(source=s, predicate=const(True, T.BOOLEAN))
+        gid = memo.insert(f)
+        top = memo.node(gid)
+        assert isinstance(top, P.Filter)
+        assert isinstance(top.source, GroupReference)
+        assert isinstance(memo.resolve(top.source), P.TableScan)
+
+    def test_extract_round_trips(self):
+        memo = Memo()
+        s = scan()
+        f = P.Filter(source=s, predicate=const(True, T.BOOLEAN))
+        lim = P.Limit(source=f, count=3)
+        gid = memo.insert(lim)
+        out = memo.extract(gid)
+        assert isinstance(out, P.Limit)
+        assert isinstance(out.source, P.Filter)
+        assert isinstance(out.source.source, P.TableScan)
+
+    def test_replace_rewrites_group_in_place(self):
+        memo = Memo()
+        f = P.Filter(source=scan(), predicate=const(True, T.BOOLEAN))
+        gid = memo.insert(f)
+        memo.replace(gid, memo.resolve(memo.node(gid).source))
+        assert isinstance(memo.node(gid), P.TableScan)
+
+
+class TestPatterns:
+    def test_class_and_predicate(self):
+        p = pattern(P.Limit).with_(lambda l: l.count == 0)
+        assert p.matches(P.Limit(source=scan(), count=0), lambda n: n)
+        assert not p.matches(P.Limit(source=scan(), count=5), lambda n: n)
+        assert not p.matches(scan(), lambda n: n)
+
+    def test_source_pattern_resolves_through_memo(self):
+        memo = Memo()
+        lim = P.Limit(source=P.Limit(source=scan(), count=7), count=3)
+        gid = memo.insert(lim)
+        p = pattern(P.Limit).with_source(pattern(P.Limit))
+        assert p.matches(memo.node(gid), memo.resolve)
+
+
+def run_rules(node, catalogs=None):
+    return IterativeOptimizer(DEFAULT_RULES).optimize(node, Session(), catalogs)
+
+
+class TestRules:
+    def test_merge_filters(self):
+        inner = P.Filter(
+            source=scan(),
+            predicate=special(
+                "not", T.BOOLEAN, const(False, T.BOOLEAN)
+            ),
+        )
+        outer = P.Filter(
+            source=inner,
+            predicate=special("not", T.BOOLEAN, const(False, T.BOOLEAN)),
+        )
+        out = run_rules(outer)
+        assert isinstance(out, P.Filter)
+        assert isinstance(out.source, P.TableScan)
+        assert out.predicate.form == "and"
+
+    def test_trivial_filters(self):
+        t = P.Filter(source=scan(), predicate=const(True, T.BOOLEAN))
+        assert isinstance(run_rules(t), P.TableScan)
+        f = P.Filter(source=scan(), predicate=const(False, T.BOOLEAN))
+        out = run_rules(f)
+        assert isinstance(out, P.Values) and out.rows == []
+
+    def test_identity_projection_removed(self):
+        s = scan()
+        p = P.Project(
+            source=s,
+            assignments=[(sym, variable(sym.name, sym.type)) for sym in s.symbols],
+        )
+        assert isinstance(run_rules(p), P.TableScan)
+
+    def test_renaming_projection_kept(self):
+        s = scan()
+        renamed = P.Symbol("c", T.BIGINT)
+        p = P.Project(
+            source=s, assignments=[(renamed, variable("a", T.BIGINT))]
+        )
+        assert isinstance(run_rules(p), P.Project)
+
+    def test_inline_projections(self):
+        s = scan()
+        mid_sym = P.Symbol("m", T.BIGINT)
+        inner = P.Project(
+            source=s,
+            assignments=[
+                (
+                    mid_sym,
+                    special(
+                        "if",
+                        T.BIGINT,
+                        const(True, T.BOOLEAN),
+                        variable("a", T.BIGINT),
+                        variable("b", T.BIGINT),
+                    ),
+                )
+            ],
+        )
+        out_sym = P.Symbol("o", T.BIGINT)
+        outer = P.Project(
+            source=inner, assignments=[(out_sym, variable("m", T.BIGINT))]
+        )
+        out = run_rules(outer)
+        assert isinstance(out, P.Project)
+        assert isinstance(out.source, P.TableScan)
+
+    def test_zero_limit(self):
+        out = run_rules(P.Limit(source=scan(), count=0))
+        assert isinstance(out, P.Values) and out.rows == []
+
+    def test_merge_limits(self):
+        out = run_rules(
+            P.Limit(source=P.Limit(source=scan(), count=7), count=3)
+        )
+        assert isinstance(out, P.Limit) and out.count == 3
+        assert isinstance(out.source, P.TableScan)
+
+    def test_create_topn(self):
+        ordering = [P.Ordering(P.Symbol("a", T.BIGINT))]
+        out = run_rules(
+            P.Limit(source=P.Sort(source=scan(), order_by=ordering), count=4)
+        )
+        assert isinstance(out, P.TopN)
+        assert out.count == 4 and isinstance(out.source, P.TableScan)
+
+    def test_push_limit_through_project(self):
+        s = scan()
+        renamed = P.Symbol("c", T.BIGINT)
+        p = P.Project(source=s, assignments=[(renamed, variable("a", T.BIGINT))])
+        out = run_rules(P.Limit(source=p, count=5))
+        assert isinstance(out, P.Project)
+        assert isinstance(out.source, P.Limit)
+
+
+class TestEndToEnd:
+    @pytest.fixture(scope="class")
+    def runner(self):
+        return LocalQueryRunner()
+
+    def test_count_star_from_metadata(self, runner):
+        """Global count(*) over exact-count connectors collapses to
+        Values (PushAggregationIntoTableScan via applyAggregation)."""
+        plan = runner.plan("select count(*) from tpch.tiny.orders")
+        kinds = {type(n).__name__ for n in P.walk_plan(plan)}
+        assert "Values" in kinds and "TableScan" not in kinds
+        rows, _ = runner.execute("select count(*) from tpch.tiny.orders")
+        assert rows == [(15000,)]
+
+    def test_count_star_with_filter_still_scans(self, runner):
+        plan = runner.plan(
+            "select count(*) from tpch.tiny.orders where o_custkey = 1"
+        )
+        kinds = {type(n).__name__ for n in P.walk_plan(plan)}
+        assert "TableScan" in kinds
+
+    def test_lineitem_count_not_closed_form(self, runner):
+        """lineitem cardinality is stream-dependent — must scan."""
+        plan = runner.plan("select count(*) from tpch.tiny.lineitem")
+        kinds = {type(n).__name__ for n in P.walk_plan(plan)}
+        assert "TableScan" in kinds
+
+    def test_limit_hint_reaches_scan(self, runner):
+        plan = runner.plan("select o_orderkey from tpch.tiny.orders limit 5")
+        scans = [n for n in P.walk_plan(plan) if isinstance(n, P.TableScan)]
+        assert scans and scans[0].limit == 5
+        rows, _ = runner.execute(
+            "select o_orderkey from tpch.tiny.orders limit 5"
+        )
+        assert len(rows) == 5
+
+    def test_limit_zero(self, runner):
+        rows, _ = runner.execute("select o_orderkey from tpch.tiny.orders limit 0")
+        assert rows == []

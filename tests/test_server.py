@@ -110,9 +110,17 @@ class TestProtocol:
         assert any(r[0] == "o_orderkey" for r in rows)
 
     def test_explain(self, conn):
-        rows, _ = conn.execute("explain select count(*) from tpch.tiny.orders")
+        # count over lineitem cannot be metadata-answered (its cardinality
+        # is stream-dependent), so the plan keeps Aggregate + TableScan
+        rows, _ = conn.execute(
+            "explain select count(*) from tpch.tiny.lineitem"
+        )
         text = "\n".join(r[0] for r in rows)
         assert "Aggregate" in text and "TableScan" in text
+        # a bare count(*) over closed-form tables collapses to Values
+        rows, _ = conn.execute("explain select count(*) from tpch.tiny.orders")
+        text = "\n".join(r[0] for r in rows)
+        assert "Values" in text
 
 
 class TestNodeEndpoints:

@@ -109,3 +109,60 @@ class TestSpmdQueries:
             "from orders order by o_orderstatus, rnk limit 5"
         )
         check(spmd_cluster, local, sql)
+
+    def test_two_overlapping_queries(self, spmd_cluster, local):
+        """Two SPMD queries submitted concurrently both complete (the
+        round-3 global lock serialized submission end-to-end; the
+        two-phase protocol only serializes the launch order)."""
+        import threading
+
+        sqls = [Q1, "select count(*), sum(l_quantity) from lineitem"]
+        results: dict = {}
+
+        def run(i, sql):
+            try:
+                results[i] = spmd_cluster.execute(sql)
+            except Exception as e:  # noqa: BLE001
+                results[i] = e
+
+        ts = [
+            threading.Thread(target=run, args=(i, s))
+            for i, s in enumerate(sqls)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=600)
+        for i, sql in enumerate(sqls):
+            assert not isinstance(results[i], Exception), results[i]
+            crows, _ = results[i]
+            lrows, _ = local.execute(sql)
+            assert crows == lrows, f"overlapped query {i} diverged"
+
+
+class TestSpmdRecovery:
+    def test_lost_peer_falls_back(self, local):
+        """A peer that vanishes is detected at the PREPARE round-trip and
+        the query falls back to per-task scheduling (round-3 behavior was
+        a hard error after skipping the sequence slot)."""
+        from trino_tpu.parallel.spmd import SpmdRunner, SpmdUnsupported
+
+        runner = LocalQueryRunner()
+        spmd = SpmdRunner.__new__(SpmdRunner)  # no jax.distributed needed
+        import threading
+
+        spmd.engine = runner.engine
+        spmd.process_count = 2
+        spmd._seq_lock = threading.Lock()
+        spmd._seq = 0
+        spmd._done_seq = -1
+        spmd._cond = threading.Condition()
+        spmd._pending = {}
+        plan = runner.plan("select count(*) from tpch.tiny.region")
+        from trino_tpu.config import Session
+
+        with pytest.raises(SpmdUnsupported, match="peer unavailable"):
+            spmd.execute(plan, Session(), ["http://127.0.0.1:1"])  # dead peer
+        # the aborted slot advanced the sequence: a later slot is not
+        # head-of-line blocked behind it
+        assert spmd._done_seq == 0

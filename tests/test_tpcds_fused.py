@@ -1,0 +1,83 @@
+"""The TPC-DS corpus through the FUSED distributed executor, with a
+fallback census.
+
+Mirrors tests/test_tpch_fused.py for the TPC-DS side (VERDICT r3 weak
+point 4: both TPC-DS suites were interpreter-only, so the fused tier's
+behavior on star-join shapes was untested). Fused results must equal
+the interpreter's; the census pins which queries still interpret so a
+fusable-set regression fails loudly.
+"""
+
+import pytest
+
+from test_tpcds_oracle import QUERIES as ORACLE_QUERIES
+from test_tpcds_suite import QUERIES as SUITE_QUERIES
+from trino_tpu.testing import DistributedQueryRunner, LocalQueryRunner
+
+# one corpus: the oracle queries plus the suite-only ones
+QUERIES = dict(SUITE_QUERIES)
+QUERIES.update(ORACLE_QUERIES)
+
+# queries whose plans still contain non-fusable shapes (tracked, not
+# aspirational — shrink as the fused tier widens). Current gap families:
+# UNION ALL branches (2, 56, 60, 66, 71, 74, 76), INTERSECT/EXCEPT
+# chains (38, 87), window-over-aggregate (12, 20, 53), correlated IN /
+# quantified subqueries (6, 33, 41, 61), multi-branch scalar-subquery
+# CASE ladders (28, 88, 90), EXISTS joins (94, 97, 98).
+EXPECTED_FALLBACK = {
+    2, 6, 12, 20, 28, 33, 38, 41, 53, 56, 60, 61, 66, 71, 74, 76, 87,
+    88, 90, 94, 97, 98,
+}
+
+# large multi-CTE self-join shapes: equality still asserted, but at
+# several minutes apiece on a cold compile cache they dominate suite
+# wall time, so they run in the census only unless TT_SLOW_FUSED=1
+SLOW = {2, 59, 64}
+
+FUSED_QUERIES = sorted(
+    set(QUERIES) - EXPECTED_FALLBACK - SLOW, key=lambda q: (isinstance(q, str), q)
+)
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner()
+
+
+@pytest.fixture(scope="module")
+def fused():
+    return DistributedQueryRunner()
+
+
+@pytest.mark.parametrize("qid", FUSED_QUERIES)
+def test_fused_equals_interpreter(qid, fused, local):
+    got, _ = fused.execute(QUERIES[qid])
+    want, _ = local.execute(QUERIES[qid])
+    assert got == want, f"Q{qid}: fused != interpreter\n{got[:3]}\n{want[:3]}"
+
+
+@pytest.mark.skipif(
+    __import__("os").environ.get("TT_SLOW_FUSED") != "1",
+    reason="opt-in: multi-CTE heavyweights (TT_SLOW_FUSED=1)",
+)
+@pytest.mark.parametrize("qid", sorted(SLOW))
+def test_fused_equals_interpreter_slow(qid, fused, local):
+    got, _ = fused.execute(QUERIES[qid])
+    want, _ = local.execute(QUERIES[qid])
+    assert got == want, f"Q{qid}: fused != interpreter\n{got[:3]}\n{want[:3]}"
+
+
+def test_fallback_census(fused):
+    """Which TPC-DS plans run fused vs interpret (tracked expectation)."""
+    from trino_tpu.exec.fragments import fragment_plan, query_fusable
+
+    fallbacks = set()
+    for qid, sql in QUERIES.items():
+        sub = fragment_plan(fused.plan(sql))
+        if not query_fusable(sub):
+            fallbacks.add(qid)
+    assert fallbacks == EXPECTED_FALLBACK, (
+        f"fused census changed: now falling back {sorted(fallbacks, key=str)}, "
+        f"expected {sorted(EXPECTED_FALLBACK, key=str)} — update the tracked "
+        f"set (shrinking it is progress; growing it is a regression)"
+    )

@@ -787,7 +787,17 @@ class Analyzer:
         parts = tuple(p.lower() for p in rel.name)
         if len(parts) == 1 and parts[0] in self.ctes:
             cte = self.ctes[parts[0]]
-            return RelationPlan(cte.node, cte.scope)
+            # re-instantiate per reference: sharing one plan (and its
+            # symbols) across references turns cross-reference predicates
+            # like t1.k = t2.k into tautologies over a single symbol
+            node, mapping = P.instantiate(cte.node)
+            fields = [
+                dataclasses.replace(
+                    f, symbol=mapping.get(f.symbol.name, f.symbol)
+                )
+                for f in cte.scope.fields
+            ]
+            return RelationPlan(node, Scope(fields))
         if len(parts) == 3:
             catalog, schema, table = parts
         elif len(parts) == 2:

@@ -64,6 +64,13 @@ class Session:
         # cluster worker tasks: 'fused' compiles the fragment onto the
         # worker's local devices; 'interpreter' forces the CPU fallback
         ("worker_execution", "fused"),
+        # stage launch order: all-at-once | phased (build-before-probe;
+        # reference AllAtOnceExecutionPolicy / PhasedExecutionPolicy)
+        ("execution_policy", "all-at-once"),
+        # distributed writer tasks over shared-storage connectors
+        # (ScaledWriterScheduler analog; see Engine._scaled_insert ADR)
+        ("scaled_writers", False),
+        ("writer_target_bytes", 32 << 20),
         # streaming scans (Driver-loop analog): scan->agg fragments whose
         # table exceeds the threshold run as a chunk loop with carried
         # accumulators instead of materializing the table on device

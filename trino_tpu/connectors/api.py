@@ -70,6 +70,9 @@ class Split:
 
 class Connector:
     name: str = "connector"
+    # True when concurrent inserts from several NODES are safe (shared
+    # storage): enables scaled-writer dispatch (ScaledWriterScheduler)
+    supports_distributed_writes: bool = False
 
     # --- metadata --------------------------------------------------------
     def list_schemas(self) -> list[str]:
@@ -80,6 +83,48 @@ class Connector:
 
     def get_table(self, schema: str, table: str) -> Optional[TableSchema]:
         raise NotImplementedError
+
+    # --- optimizer pushdown hooks ----------------------------------------
+    # Reference: ``spi/connector/ConnectorMetadata.java`` applyLimit
+    # (:1064), applyTopN (:1090), applyAggregation (:932); applyFilter's
+    # analog is the constraint/prune_splits path below.
+
+    def apply_limit(self, schema: str, table: str, count: int) -> bool:
+        """True if the connector will honor a read-at-most-``count`` hint
+        on its scans (guarantee-free: the engine still enforces LIMIT)."""
+        return False
+
+    def apply_topn(
+        self, schema: str, table: str, keys: list, count: int
+    ) -> bool:
+        """True ONLY if this connector's ``get_splits_with_hints`` orders
+        scans by ``keys`` ([(column, ascending)]) well enough that the
+        first ``count`` rows read contain the true top-N — the engine
+        stops reading splits at the limit when this returns True (the
+        TopN node above still sorts/cuts what was read)."""
+        return False
+
+    def get_splits_with_hints(
+        self,
+        schema: str,
+        table: str,
+        target_splits: int,
+        constraint=None,
+        limit: Optional[int] = None,
+        topn: Optional[list] = None,
+    ) -> list["Split"]:
+        """Split enumeration with the optimizer's pushed limit/topn hints.
+
+        Default ignores the hints (safe: the engine only trusts them when
+        the connector's apply_limit/apply_topn accepted). Connectors that
+        accept override this to cap or order their splits."""
+        return self.get_splits(schema, table, target_splits, constraint)
+
+    def apply_aggregation_count(self, schema: str, table: str):
+        """Exact total row count, or None when the connector cannot answer
+        without scanning. ONLY return a value that is exactly correct —
+        the optimizer replaces a global count(*) with it."""
+        return None
 
     # --- splits + data ---------------------------------------------------
     def get_splits(
@@ -243,3 +288,42 @@ def batch_column_stats(columns, batch) -> dict:
         else:
             out[cs.name] = (None, None, has_null)
     return out
+
+
+def register_catalog_spec(manager: CatalogManager, spec: str) -> None:
+    """Register a connector from a ``name=kind[:arg]`` spec string.
+
+    The ``etc/catalog/*.properties`` analog (reference:
+    ``server/PluginManager.java`` / ``connector/ConnectorManager.java``):
+    servers take ``--catalog data=parquet:/shared/path`` so every node of
+    a cluster mounts the same catalogs at boot.
+    """
+    name, _, rest = spec.partition("=")
+    kind, _, arg = rest.partition(":")
+    name, kind = name.strip(), kind.strip()
+    if kind == "memory":
+        from trino_tpu.connectors.memory import MemoryConnector
+
+        manager.register(name, MemoryConnector())
+    elif kind == "blackhole":
+        from trino_tpu.connectors.blackhole import BlackHoleConnector
+
+        manager.register(name, BlackHoleConnector())
+    elif kind == "file":
+        from trino_tpu.connectors.file import FileConnector
+
+        manager.register(name, FileConnector(arg))
+    elif kind == "parquet":
+        from trino_tpu.connectors.parquet import ParquetConnector
+
+        manager.register(name, ParquetConnector(arg))
+    elif kind == "orc":
+        from trino_tpu.connectors.orc import OrcConnector
+
+        manager.register(name, OrcConnector(arg))
+    elif kind == "tpch":
+        from trino_tpu.connectors.tpch import TpchConnector
+
+        manager.register(name, TpchConnector())
+    else:
+        raise ValueError(f"unknown catalog kind in spec: {spec!r}")

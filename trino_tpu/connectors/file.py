@@ -44,6 +44,9 @@ def _retry_fnf(fn, attempts: int = 50, delay: float = 0.01):
 
 class FileConnector(Connector):
     name = "file"
+    # part-file writes land on a shared filesystem, so writer
+    # tasks on any node append safely (scaled-writer eligible)
+    supports_distributed_writes = True
 
     def __init__(self, root: str):
         self.root = root
@@ -137,8 +140,11 @@ class FileConnector(Connector):
     def _write_part_into(self, d: str, ts: TableSchema, batch: Batch) -> int:
         """Write one part file + stats into an explicit directory (used by
         both the live-table insert path and replace_data staging)."""
+        import uuid
+
         compacted = batch.compact()
-        part = f"part-{len(self._parts_in(d)):05d}.ttp"
+        # node-unique names: concurrent scaled-writer tasks must not collide
+        part = f"part-{len(self._parts_in(d)):05d}-{uuid.uuid4().hex[:8]}.ttp"
         with open(os.path.join(d, part), "wb") as f:
             f.write(serialize_batch(compacted))
         # per-file column stats (the ORC stripe-footer analog)
@@ -154,7 +160,10 @@ class FileConnector(Connector):
             with open(stats_path) as f:
                 all_stats = json.load(f)
         all_stats[part] = {"rows": compacted.num_rows, "columns": stats}
-        tmp = stats_path + ".tmp"
+        # unique tmp per writer: scaled-writer tasks on several nodes swap
+        # concurrently; a lost stats entry only disables pruning for that
+        # part (split_stats -> None), never correctness
+        tmp = f"{stats_path}.tmp{os.getpid()}-{uuid.uuid4().hex[:6]}"
         with open(tmp, "w") as f:  # atomic swap: a crash never truncates
             json.dump(all_stats, f)
         os.replace(tmp, stats_path)

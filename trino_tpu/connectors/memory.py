@@ -136,6 +136,13 @@ class MemoryConnector(Connector):
             return None
         return sum(b.num_rows for b in parts)
 
+    # --- optimizer pushdown (ConnectorMetadata.applyLimit/applyAggregation)
+    def apply_limit(self, schema, table, count):
+        return True  # scans stop pulling stored parts once covered
+
+    def apply_aggregation_count(self, schema, table):
+        return self.estimate_rows(schema, table)  # stored parts: exact
+
     def get_splits(self, schema, table, target_splits, constraint=None):
         parts = self._data.get((schema, table), [])
         n = max(1, len(parts))

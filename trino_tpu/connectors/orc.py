@@ -1,12 +1,12 @@
-"""ORC connector: directory-of-files tables (hive-style layout), read
-path only.
+"""ORC connector: directory-of-files tables (hive-style layout).
 
-Reference: ``plugin/trino-hive`` selecting ``lib/trino-orc`` readers
-(``OrcReader.java:66,251``); splits are (file, stripe) pairs and stripe
-statistics drive TupleDomain split pruning
-(``TupleDomainOrcPredicate.java:74``). Layout:
+Reference: ``plugin/trino-hive`` selecting ``lib/trino-orc`` readers and
+writers (``OrcReader.java:66,251``, ``OrcWriter.java``); splits are
+(file, stripe) pairs and stripe statistics drive TupleDomain split
+pruning (``TupleDomainOrcPredicate.java:74``). Layout:
 ``<root>/<schema>/<table>/*.orc``; the table schema is read from the
-first file's footer.
+first file's footer. Writes (CTAS/INSERT) produce one ORC file per
+insert via the from-scratch writer in :mod:`trino_tpu.formats.orc`.
 """
 
 from __future__ import annotations
@@ -24,6 +24,9 @@ from trino_tpu.formats import orc as ORC
 
 class OrcConnector(Connector):
     name = "orc"
+    # part-file writes land on a shared filesystem, so writer
+    # tasks on any node append safely (scaled-writer eligible)
+    supports_distributed_writes = True
 
     def __init__(self, root: str):
         self.root = root
@@ -141,9 +144,48 @@ class OrcConnector(Connector):
             return None
         return sum(self._file(p).num_rows for p in files)
 
-    # --- writes: not supported (reader-only tier) -------------------------
+    # --- writes: one ORC file per insert ----------------------------------
 
-    def create_table(self, schema, table, schema_def) -> None:
-        raise NotImplementedError(
-            "the orc connector is read-only; CTAS via the parquet connector"
+    def create_table(self, schema, table, schema_def: TableSchema) -> None:
+        d = self._table_dir(schema, table)
+        if os.path.isdir(d) and self._files(schema, table):
+            raise ValueError(f"table already exists: {schema}.{table}")
+        os.makedirs(d, exist_ok=True)
+        self._pending_schema = schema_def  # first insert writes the file
+
+    def insert(self, schema, table, batch: Batch) -> int:
+        import threading
+
+        d = self._table_dir(schema, table)
+        if not os.path.isdir(d):
+            raise KeyError(f"table not found: {schema}.{table}")
+        lock = getattr(self, "_write_lock", None)
+        if lock is None:
+            lock = self._write_lock = threading.Lock()
+        ts = self.get_table(schema, table)
+        names = (
+            [c.name for c in ts.columns]
+            if ts is not None
+            else [c.name for c in getattr(self, "_pending_schema").columns]
         )
+        with lock:
+            import uuid
+
+            # node-unique part names: concurrent writer tasks on several
+            # nodes append without coordination (scaled writers)
+            n = len(self._files(schema, table))
+            path = os.path.join(
+                d, f"part-{n:05d}-{uuid.uuid4().hex[:8]}.orc"
+            )
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                ORC.write_orc(f, names, [batch])
+            os.replace(tmp, path)
+        return batch.compact().num_rows
+
+    def drop_table(self, schema, table) -> None:
+        import shutil
+
+        d = self._table_dir(schema, table)
+        if os.path.isdir(d):
+            shutil.rmtree(d)

@@ -24,6 +24,9 @@ from trino_tpu.formats import parquet as PQ
 
 class ParquetConnector(Connector):
     name = "parquet"
+    # part-file writes land on a shared filesystem, so writer
+    # tasks on any node append safely (scaled-writer eligible)
+    supports_distributed_writes = True
 
     def __init__(self, root: str):
         self.root = root
@@ -143,8 +146,14 @@ class ParquetConnector(Connector):
             else [c.name for c in getattr(self, "_pending_schema").columns]
         )
         with self._write_lock:
+            import uuid
+
+            # node-unique part names: concurrent writer tasks on several
+            # nodes append without coordination (scaled writers)
             n = len(self._files(schema, table))
-            path = os.path.join(d, f"part-{n:05d}.parquet")
+            path = os.path.join(
+                d, f"part-{n:05d}-{uuid.uuid4().hex[:8]}.parquet"
+            )
             tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 PQ.write_parquet(f, names, [batch])

@@ -168,6 +168,19 @@ class TpchConnector(Connector):
             return c["orders"] * 4
         return c[table]
 
+    # --- optimizer pushdown (ConnectorMetadata.applyLimit/applyAggregation)
+    def apply_limit(self, schema, table, count):
+        # scans stop generating splits once the row budget is covered
+        return True
+
+    def apply_aggregation_count(self, schema, table):
+        """dbgen row counts are closed-form exact for every table except
+        lineitem (whose per-order cardinality is drawn from the stream)."""
+        if table == "lineitem":
+            return None
+        sf = scale_factor(schema)
+        return _counts(sf).get(table)
+
     def table_stats(self, schema, table):
         """Column statistics derived from the generator's known value
         domains (reference: ``plugin/trino-tpch/.../statistics/`` — the

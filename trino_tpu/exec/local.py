@@ -153,15 +153,22 @@ class LocalExecutor:
     # === leaf nodes =====================================================
     def _exec_tablescan(self, node: P.TableScan) -> Result:
         connector = self.catalogs.get(node.catalog)
-        splits = connector.get_splits(
-            node.schema, node.table, target_splits=64, constraint=node.constraint
+        splits = connector.get_splits_with_hints(
+            node.schema, node.table, 64, node.constraint,
+            limit=node.limit, topn=node.topn,
         )
         if not splits:
             return Result(self._empty_batch(node), {s.name: i for i, s in enumerate(node.symbols)})
-        batches = [
-            connector.read_split(node.schema, node.table, node.column_names, s)
-            for s in splits
-        ]
+        batches = []
+        rows_read = 0
+        for s in splits:
+            # connector applyLimit hint: stop pulling splits once the
+            # pushed row budget is covered (the Limit node still enforces)
+            if node.limit is not None and rows_read >= node.limit:
+                break
+            b = connector.read_split(node.schema, node.table, node.column_names, s)
+            batches.append(b)
+            rows_read += b.num_rows
         batch = concat_batches(batches) if len(batches) > 1 else batches[0]
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         return Result(batch, layout)

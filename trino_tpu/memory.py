@@ -142,3 +142,83 @@ def batch_nbytes(batch) -> int:
     if batch.sel is not None:
         total += batch.capacity
     return total
+
+
+class ClusterMemoryManager:
+    """Coordinator-side cluster-wide memory enforcement.
+
+    Reference: ``memory/ClusterMemoryManager.java:89,104`` — workers report
+    their pool state (here: piggybacked on the discovery announce), the
+    coordinator aggregates reservations per query across every node, and
+    when the cluster total exceeds the limit it kills the query with the
+    largest total reservation (``TotalReservationLowMemoryKiller``).
+    """
+
+    def __init__(
+        self,
+        local_pool: MemoryPool,
+        cluster_limit_bytes: int,
+        kill_fn: Callable[[str, str], bool],
+    ):
+        self.local_pool = local_pool
+        self.limit = int(cluster_limit_bytes)
+        self.kill_fn = kill_fn  # (query_id, message) -> killed?
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict[str, int]] = {}  # node -> query -> bytes
+        self.kills: list[str] = []  # query ids killed (observability)
+
+    def update(self, node_id: str, memory_info: Optional[dict]) -> None:
+        """Record one worker's per-query reservations and re-check."""
+        if memory_info is None:
+            return
+        with self._lock:
+            self._nodes[node_id] = {
+                str(q): int(b)
+                for q, b in (memory_info.get("queryReservations") or {}).items()
+            }
+        self.check()
+
+    def remove_node(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+
+    def cluster_reservations(self) -> dict[str, int]:
+        """Per-query bytes summed over the coordinator + every worker."""
+        totals: dict[str, int] = {}
+        with self._lock:
+            snapshots = list(self._nodes.values())
+        for per_query in snapshots:
+            for q, b in per_query.items():
+                totals[q] = totals.get(q, 0) + b
+        with self.local_pool._lock:
+            for q, b in self.local_pool._query_reserved.items():
+                totals[q] = totals.get(q, 0) + b
+        return totals
+
+    def check(self) -> Optional[str]:
+        """Kill the largest query if the cluster total exceeds the limit.
+
+        Returns the killed query id (None when under the limit)."""
+        totals = self.cluster_reservations()
+        used = sum(totals.values())
+        if used <= self.limit or not totals:
+            return None
+        victim = max(totals, key=lambda q: totals[q])
+        message = (
+            f"Query killed by the cluster memory manager: cluster memory "
+            f"used {used} bytes exceeds the limit {self.limit} bytes "
+            f"(this query reserved {totals[victim]} across the cluster)"
+        )
+        if self.kill_fn(victim, message):
+            self.kills.append(victim)
+            return victim
+        return None
+
+    def info(self) -> dict:
+        totals = self.cluster_reservations()
+        return {
+            "clusterMemoryLimitBytes": self.limit,
+            "clusterReservedBytes": sum(totals.values()),
+            "queryReservations": totals,
+            "killedQueries": list(self.kills),
+        }

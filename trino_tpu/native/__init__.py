@@ -85,6 +85,12 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.tt_orc_byte_rle.argtypes = [u8p, i64, i64, u8p]
     lib.tt_orc_decimal64.restype = i64
     lib.tt_orc_decimal64.argtypes = [u8p, i64, i64, i64p]
+    lib.tt_orc_rle2_encode.restype = i64
+    lib.tt_orc_rle2_encode.argtypes = [i64p, i64, ctypes.c_int32, u8p]
+    lib.tt_orc_byte_rle_encode.restype = i64
+    lib.tt_orc_byte_rle_encode.argtypes = [u8p, i64, u8p]
+    lib.tt_orc_varint_encode.restype = i64
+    lib.tt_orc_varint_encode.argtypes = [u64p, i64, u8p]
     lib.tt_snappy_compress.restype = i64
     lib.tt_snappy_compress.argtypes = [u8p, i64, u8p]
     lib.tt_parquet_rle_decode.restype = i64
@@ -583,3 +589,47 @@ def orc_decimal64(data: bytes, count: int) -> Optional[np.ndarray]:
     if rc < 0:
         raise ValueError("corrupt ORC decimal stream")
     return out
+
+
+def orc_rle2_encode(vals: np.ndarray, signed: bool) -> Optional[bytes]:
+    """ORC RLEv2 integer encode (None -> caller uses the Python path)."""
+    if _LIB is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    n = len(vals)
+    if n == 0:
+        return b""
+    out = np.empty(9 * n + 64, dtype=np.uint8)
+    ln = _LIB.tt_orc_rle2_encode(
+        _ptr(vals, ctypes.c_int64), n, int(signed), _ptr(out, ctypes.c_uint8)
+    )
+    return out[:ln].tobytes()
+
+
+def orc_byte_rle_encode(b: np.ndarray) -> Optional[bytes]:
+    if _LIB is None:
+        return None
+    b = np.ascontiguousarray(b, dtype=np.uint8)
+    n = len(b)
+    if n == 0:
+        return b""
+    out = np.empty(2 * n + 64, dtype=np.uint8)
+    ln = _LIB.tt_orc_byte_rle_encode(
+        _ptr(b, ctypes.c_uint8), n, _ptr(out, ctypes.c_uint8)
+    )
+    return out[:ln].tobytes()
+
+
+def orc_varint_encode(u: np.ndarray) -> Optional[bytes]:
+    """Plain LEB128 of a uint64 array (no delta, unlike varint_encode)."""
+    if _LIB is None:
+        return None
+    u = np.ascontiguousarray(u, dtype=np.uint64)
+    n = len(u)
+    if n == 0:
+        return b""
+    out = np.empty(10 * n + 16, dtype=np.uint8)
+    ln = _LIB.tt_orc_varint_encode(
+        _ptr(u, ctypes.c_uint64), n, _ptr(out, ctypes.c_uint8)
+    )
+    return out[:ln].tobytes()

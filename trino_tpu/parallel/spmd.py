@@ -9,15 +9,24 @@ only ships the *plan*: every process traces and launches the same jitted
 programs in the same order, so XLA's collectives rendezvous without any
 explicit message passing.
 
-Protocol:
+Protocol (two-phase):
 - All server processes boot with ``jax.distributed.initialize`` (rank 0 is
   the coordinator) and build the same global mesh.
 - A query arrives at the coordinator. If the plan is fusable it assigns a
-  sequence number, broadcasts ``{seq, plan, session}`` to every worker's
-  ``POST /v1/spmd``, and starts executing itself.
-- Workers execute strictly in sequence order; capacity-overflow retries
-  re-trace identically on every process (overflow flags are globally
-  reduced), keeping the program streams aligned.
+  sequence number and **prepares** it on every worker
+  (``POST /v1/spmd`` with ``phase=prepare`` — plan + session are staged,
+  nothing launches). If any peer is unreachable the coordinator aborts the
+  slot (``phase=commit, go=false``) and the query falls back to per-task
+  cluster scheduling — a lost peer costs one round-trip, not an error.
+- On all-ready the coordinator **commits** (``phase=commit, go=true``);
+  every process (coordinator included) executes committed slots strictly
+  in sequence order, so the jitted program streams launch identically and
+  XLA's multi-host collectives rendezvous. Aborted slots advance the
+  sequence without launching anything.
+- Multiple queries may be in flight: sequence allocation and the prepare
+  round-trips overlap freely; only the launch order is serialized.
+- Capacity-overflow retries re-trace identically on every process
+  (overflow flags are globally reduced), keeping the streams aligned.
 - The root result is replicated to all processes (tiny by then), and the
   coordinator answers the client.
 """
@@ -75,10 +84,26 @@ class SpmdRunner:
         self.engine = engine
         self.mesh = make_mesh()  # global mesh over every process's devices
         self.process_count = jax.process_count()
-        self._lock = threading.Lock()  # one SPMD query at a time
+        self._seq_lock = threading.Lock()  # sequence allocation only
         self._seq = 0
         self._done_seq = -1
         self._cond = threading.Condition()
+        self._pending: dict[int, dict] = {}  # staged prepares (worker side)
+
+    # --- launch-order gate ------------------------------------------------
+
+    def _await_turn(self, seq: int, timeout: float = 600.0) -> bool:
+        """Block until every earlier slot completed or was aborted."""
+        with self._cond:
+            while self._done_seq < seq - 1:
+                if not self._cond.wait(timeout=timeout):
+                    return False
+        return True
+
+    def _finish(self, seq: int) -> None:
+        with self._cond:
+            self._done_seq = max(self._done_seq, seq)
+            self._cond.notify_all()
 
     # --- shared execution body -------------------------------------------
 
@@ -121,81 +146,141 @@ class SpmdRunner:
             raise SpmdUnsupported(
                 f"{len(peers)} peers announced, need {self.process_count - 1}"
             )
-        with self._lock:
+        with self._seq_lock:
             seq = self._seq
             self._seq += 1
-            payload = json.dumps(
-                {
-                    "seq": seq,
-                    "plan": node_to_json(plan),
-                    "session": session_to_json(session),
-                }
-            ).encode()
-            errors: list[str] = []
-            threads = []
 
-            def post(uri: str):
-                from trino_tpu.server import auth
+        def post(uri: str, body: dict, timeout: float) -> dict:
+            from trino_tpu.server import auth
 
-                req = urllib.request.Request(
-                    f"{uri}/v1/spmd",
-                    data=payload,
-                    method="POST",
-                    headers=auth.headers(),
-                )
-                req.add_header("Content-Type", "application/json")
+            req = urllib.request.Request(
+                f"{uri}/v1/spmd",
+                data=json.dumps(body).encode(),
+                method="POST",
+                headers=auth.headers(),
+            )
+            req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read().decode())
+
+        def broadcast(body: dict, timeout: float) -> list:
+            """POST to all peers concurrently -> list of (uri, reply|exc)."""
+            results: list = [None] * len(peers)
+
+            def one(i: int, uri: str):
                 try:
-                    with urllib.request.urlopen(req, timeout=600) as r:
-                        body = json.loads(r.read().decode())
-                    if body.get("error"):
-                        errors.append(body["error"])
+                    results[i] = (uri, post(uri, body, timeout))
                 except Exception as e:  # noqa: BLE001
-                    errors.append(f"{uri}: {e}")
+                    results[i] = (uri, e)
 
-            for uri in peers:
-                t = threading.Thread(target=post, args=(uri,), daemon=True)
+            ts = [
+                threading.Thread(target=one, args=(i, u), daemon=True)
+                for i, u in enumerate(peers)
+            ]
+            for t in ts:
                 t.start()
-                threads.append(t)
+            for t in ts:
+                t.join(timeout=timeout + 30)
+            return results
+
+        # phase 1 — prepare: stage the plan everywhere; nothing launches,
+        # so a dead peer here is recoverable (fall back to task scheduling)
+        prepare = {
+            "phase": "prepare",
+            "seq": seq,
+            "plan": node_to_json(plan),
+            "session": session_to_json(session),
+        }
+        failed = [
+            (uri, r)
+            for uri, r in broadcast(prepare, timeout=30)
+            if isinstance(r, Exception) or r.get("error")
+        ]
+        if failed:
+            # abort the slot everywhere so sequence numbers stay aligned
+            broadcast({"phase": "commit", "seq": seq, "go": False}, timeout=30)
+            self._await_turn(seq)
+            self._finish(seq)
+            raise SpmdUnsupported(
+                f"peer unavailable at prepare ({failed[0][0]}): {failed[0][1]}"
+            )
+
+        # phase 2 — commit: everyone (us included) launches in seq order
+        errors: list[str] = []
+        commit_threads = []
+
+        def commit(uri: str):
             try:
-                result = self._execute(plan, session)
-            finally:
-                for t in threads:
-                    t.join(timeout=600)
-            if errors:
-                raise ExecutionError(f"spmd worker failed: {errors[0]}")
-            return result
+                body = post(
+                    uri, {"phase": "commit", "seq": seq, "go": True}, 600
+                )
+                if body.get("error"):
+                    errors.append(body["error"])
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"{uri}: {e}")
+
+        for uri in peers:
+            t = threading.Thread(target=commit, args=(uri,), daemon=True)
+            t.start()
+            commit_threads.append(t)
+        if not self._await_turn(seq):
+            # predecessors abandoned: advance past them and run this slot
+            with self._cond:
+                self._done_seq = max(self._done_seq, seq - 1)
+                self._cond.notify_all()
+        try:
+            result = self._execute(plan, session)
+        finally:
+            self._finish(seq)
+            for t in commit_threads:
+                t.join(timeout=600)
+        if errors:
+            raise ExecutionError(f"spmd worker failed: {errors[0]}")
+        return result
 
     # --- worker side ------------------------------------------------------
 
     def execute_remote(self, payload: dict) -> dict:
-        """Handle POST /v1/spmd on a worker: execute in sequence order."""
+        """Handle POST /v1/spmd on a worker (two-phase)."""
         from trino_tpu.planner.serde import node_from_json
 
         seq = int(payload["seq"])
-        plan = node_from_json(payload["plan"])
-        session = session_from_json(payload.get("session", {}))
+        phase = payload.get("phase", "prepare")
+        if phase == "prepare":
+            with self._cond:
+                if self._done_seq >= seq:
+                    return {"error": f"seq {seq} slot already passed"}
+            self._pending[seq] = payload
+            return {"ready": True, "seq": seq}
+
+        go = bool(payload.get("go", True))
+        pend = self._pending.pop(seq, None)
         with self._cond:
             if self._done_seq >= seq:
-                # a predecessor's timeout already skipped this slot; running
-                # it now would launch programs out of order
-                return {"error": f"seq {seq} arrived after being skipped"}
-            deadline = 600.0
-            while self._done_seq < seq - 1:
-                if not self._cond.wait(timeout=deadline):
-                    # advance past the lost predecessor so later queries
-                    # aren't head-of-line blocked forever
-                    self._done_seq = max(self._done_seq, seq)
-                    self._cond.notify_all()
-                    return {"error": f"timed out waiting for seq {seq - 1}"}
+                # this slot was declared abandoned while its commit was in
+                # flight; launching now would be out of launch order
+                return {"error": f"seq {seq} slot already passed"}
+        if not self._await_turn(seq):
+            # predecessors abandoned (commit or abort never arrived, e.g.
+            # a missed go=False broadcast): advance past THEM and serve
+            # this slot — the abandoned slots' late commits are rejected
+            # by the guard above, so the healthy query is not the victim
+            with self._cond:
+                self._done_seq = max(self._done_seq, seq - 1)
+                self._cond.notify_all()
         try:
+            if not go:
+                return {"skipped": True, "seq": seq}
+            if pend is None:
+                return {"error": f"seq {seq} committed without prepare"}
+            plan = node_from_json(pend["plan"])
+            session = session_from_json(pend.get("session", {}))
             self._execute(plan, session)
             return {"ok": True, "seq": seq}
         except Exception as e:  # noqa: BLE001
             return {"error": f"{type(e).__name__}: {e}", "seq": seq}
         finally:
-            with self._cond:
-                self._done_seq = max(self._done_seq, seq)
-                self._cond.notify_all()
+            self._finish(seq)
 
 
 def initialize_spmd(coordinator: str, num_processes: int, process_id: int):

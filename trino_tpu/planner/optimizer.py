@@ -17,6 +17,8 @@ fragmenter (parallel/), where the mesh is known.
 
 from __future__ import annotations
 
+import dataclasses
+
 from typing import Optional
 
 from trino_tpu import types as T
@@ -39,8 +41,13 @@ def optimize(root: P.PlanNode, session: Session, catalogs) -> P.PlanNode:
     from trino_tpu.planner.joins import determine_join_distribution, reorder_joins
     from trino_tpu.planner.stats import StatsCalculator
 
+    from trino_tpu.planner.iterative import run_default
+
     root = push_down_predicates(root)
     root = push_into_scans(root)
+    # iterative rule tier (Memo + pattern rules): simplification, limit
+    # merging/TopN creation, connector applyLimit/applyTopN/applyAggregation
+    root = run_default(root, session, catalogs)
     stats = StatsCalculator(catalogs)
     if session.get("join_reordering_strategy") == "AUTOMATIC":
         root = reorder_joins(root, stats, session)
@@ -81,10 +88,7 @@ def push_into_scans(node: P.PlanNode) -> P.PlanNode:
                 )
             if scan.constraint is not None:
                 constraint = scan.constraint.intersect(constraint)
-            new_scan = P.TableScan(
-                scan.catalog, scan.schema, scan.table, scan.symbols,
-                scan.column_names, scan.pushed_predicate, constraint,
-            )
+            new_scan = dataclasses.replace(scan, constraint=constraint)
             return P.Filter(new_scan, node.predicate)
         return node
     new_sources = [push_into_scans(s) for s in node.sources]
@@ -282,16 +286,7 @@ def _as_criterion(c: RowExpr, left_names: set[str], right_names: set[str]):
 
 
 def _replace_sources(node: P.PlanNode, new_sources: list[P.PlanNode]) -> P.PlanNode:
-    import copy
-
-    out = copy.copy(node)
-    if isinstance(node, P.Join):
-        out.left, out.right = new_sources
-    elif hasattr(node, "source") and new_sources:
-        out.source = new_sources[0]
-    elif isinstance(node, P.SetOp):
-        out.inputs = new_sources
-    return out
+    return P.replace_sources(node, new_sources)
 
 
 # === column pruning ========================================================
@@ -326,10 +321,9 @@ def prune_columns(node: P.PlanNode, required: Optional[set[str]] = None) -> P.Pl
         ]
         if not keep:  # keep one column for row counting
             keep = [(node.symbols[0], node.column_names[0])]
-        return P.TableScan(
-            node.catalog, node.schema, node.table,
-            [s for s, _ in keep], [c for _, c in keep], node.pushed_predicate,
-            node.constraint,
+        return dataclasses.replace(
+            node, symbols=[s for s, _ in keep],
+            column_names=[c for _, c in keep],
         )
 
     if isinstance(node, P.Aggregate):

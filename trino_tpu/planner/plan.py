@@ -75,6 +75,11 @@ class TableScan(PlanNode):
     # connector applyFilter (reference: PushPredicateIntoTableScan.java);
     # advisory — the enclosing Filter still applies the full predicate
     constraint: Optional[Any] = None
+    # connector applyLimit / applyTopN hints (reference:
+    # ConnectorMetadata.java:1064,1090); guarantee-free — the Limit/TopN
+    # node above still enforces, the scan just reads less
+    limit: Optional[int] = None
+    topn: Optional[list] = None  # [(column_name, ascending)]
 
     @property
     def output_symbols(self):
@@ -451,3 +456,98 @@ def plan_text(node: PlanNode, indent: int = 0) -> str:
     for s in node.sources:
         lines.append(plan_text(s, indent + 1))
     return "\n".join(lines)
+
+
+def replace_sources(node: PlanNode, new_sources: list["PlanNode"]) -> PlanNode:
+    """Shallow-copy ``node`` with its child nodes swapped (used by the
+    Memo's group-reference rewrites and the whole-plan passes)."""
+    import copy
+
+    out = copy.copy(node)
+    if isinstance(node, Join):
+        out.left, out.right = new_sources
+    elif isinstance(node, SetOp):
+        out.inputs = list(new_sources)
+    elif hasattr(node, "source") and new_sources:
+        out.source = new_sources[0]
+    return out
+
+
+# === CTE re-instantiation ===================================================
+
+
+def instantiate(node: PlanNode) -> tuple[PlanNode, dict[str, Symbol]]:
+    """Deep-copy a plan subtree, renaming every Symbol to a fresh name.
+
+    Each WITH-query reference must own distinct symbols: sharing the plan
+    object between two references makes a correlation like
+    ``t1.k = t2.k`` degenerate into a tautology over one symbol (the
+    reference inlines named queries per reference for the same reason —
+    ``StatementAnalyzer.java`` named-query analysis). Returns the clone
+    plus the old-name -> new-Symbol mapping so callers can re-point their
+    scopes.
+    """
+    from trino_tpu import ir
+
+    mapping: dict[str, Symbol] = {}
+    node_cache: dict[int, PlanNode] = {}
+
+    def map_symbol(s: Symbol) -> Symbol:
+        got = mapping.get(s.name)
+        if got is None:
+            got = Symbol(fresh_name(s.name.rsplit("_", 1)[0] or s.name), s.type)
+            mapping[s.name] = got
+        return got
+
+    def map_expr(e):
+        def repl(x):
+            if isinstance(x, ir.Variable):
+                # every Variable in a CTE body is produced inside it, so
+                # mapping-on-first-sight is safe regardless of field order
+                return ir.Variable(
+                    type=x.type, name=map_symbol(Symbol(x.name, x.type)).name
+                )
+            return x
+
+        return ir.transform(e, repl)
+
+    def map_value(v):
+        if isinstance(v, PlanNode):
+            return clone(v)
+        if isinstance(v, Symbol):
+            return map_symbol(v)
+        if isinstance(v, Ordering):
+            return dataclasses.replace(v, symbol=map_symbol(v.symbol))
+        if isinstance(v, ir.RowExpr):
+            return map_expr(v)
+        if isinstance(v, (AggFunction, WindowFunction)):
+            kw = {}
+            for f in dataclasses.fields(v):
+                kw[f.name] = map_value(getattr(v, f.name))
+            return type(v)(**kw)
+        if isinstance(v, list):
+            return [map_value(x) for x in v]
+        if isinstance(v, tuple):
+            return tuple(map_value(x) for x in v)
+        if isinstance(v, dict):
+            return {map_value(k): map_value(x) for k, x in v.items()}
+        return v
+
+    def clone(n: PlanNode) -> PlanNode:
+        got = node_cache.get(id(n))
+        if got is not None:
+            return got
+        kw = {}
+        for f in dataclasses.fields(n):
+            val = getattr(n, f.name)
+            # sources first so symbol mappings exist before expressions
+            kw[f.name] = map_value(val) if isinstance(val, PlanNode) else val
+        for f in dataclasses.fields(n):
+            val = getattr(n, f.name)
+            if not isinstance(val, PlanNode):
+                kw[f.name] = map_value(val)
+        out = type(n)(**kw)
+        node_cache[id(n)] = out
+        return out
+
+    return clone(node), mapping

@@ -114,6 +114,8 @@ def node_to_json(node: P.PlanNode) -> dict:
             "table": node.table,
             "symbols": [_sym(s) for s in node.symbols],
             "columns": list(node.column_names),
+            "limit": node.limit,
+            "topn": node.topn,
         }
     if isinstance(node, P.RemoteSource):
         return {
@@ -272,6 +274,8 @@ def node_from_json(d: dict) -> P.PlanNode:
             d["table"],
             [_sym_from(s) for s in d["symbols"]],
             list(d["columns"]),
+            limit=d.get("limit"),
+            topn=d.get("topn"),
         )
     if k == "remotesource":
         return P.RemoteSource(

@@ -15,7 +15,9 @@ import os
 ENV_VAR = "TRINO_TPU_INTERNAL_SECRET"
 
 #: request paths that are cluster-internal (prefix match)
-INTERNAL_PREFIXES = ("/v1/task", "/v1/announce", "/v1/spmd", "/v1/discovery")
+INTERNAL_PREFIXES = (
+    "/v1/task", "/v1/announce", "/v1/spmd", "/v1/discovery", "/v1/write",
+)
 
 
 def secret() -> str | None:

@@ -111,6 +111,91 @@ class ClusterNodeManager:
         ]
 
 
+
+class NodeScheduler:
+    """Node-selection policy for task placement.
+
+    Reference: ``execution/scheduler/NodeScheduler.java`` +
+    ``UniformNodeSelector.java`` — tasks go to the least-loaded active
+    nodes (tracked coordinator-side per in-flight task) rather than blind
+    round-robin, so a straggling worker stops attracting new work.
+    """
+
+    def __init__(self, node_manager: "ClusterNodeManager"):
+        self.node_manager = node_manager
+        self._assigned: dict[str, int] = {}  # node_id -> in-flight tasks
+        self._lock = threading.Lock()
+
+    def select(self, nodes: list["WorkerNode"], count: int) -> list["WorkerNode"]:
+        """Pick ``count`` placements (repeats allowed when count > nodes),
+        each time choosing the node with the fewest in-flight tasks."""
+        out: list[WorkerNode] = []
+        with self._lock:
+            load = {n.node_id: self._assigned.get(n.node_id, 0) for n in nodes}
+            for _ in range(count):
+                best = min(nodes, key=lambda n: (load[n.node_id], n.node_id))
+                load[best.node_id] += 1
+                out.append(best)
+        return out
+
+    def acquire(self, node: "WorkerNode") -> None:
+        with self._lock:
+            self._assigned[node.node_id] = self._assigned.get(node.node_id, 0) + 1
+
+    def release(self, node: "WorkerNode") -> None:
+        with self._lock:
+            v = self._assigned.get(node.node_id, 0) - 1
+            if v <= 0:
+                self._assigned.pop(node.node_id, None)
+            else:
+                self._assigned[node.node_id] = v
+
+
+def phased_order(sub: "SubPlan") -> list["PlanFragment"]:
+    """Fragment launch order under the phased policy.
+
+    Reference: ``execution/scheduler/PhasedExecutionSchedule.java`` —
+    producers launch before consumers (our baseline bottom-up already
+    guarantees that), and among one join's feeding fragments the BUILD
+    side (the join's right subtree) launches before the PROBE side, so
+    probe tasks never sit on a worker waiting for a build that has not
+    even been scheduled.
+    """
+    out: list[PlanFragment] = []
+
+    def build_side_fragments(frag: PlanFragment) -> set[int]:
+        """Fragment ids referenced from any join's right (build) subtree."""
+        build: set[int] = set()
+
+        def mark(node, in_build: bool):
+            if isinstance(node, P.RemoteSource):
+                if in_build:
+                    build.add(node.fragment_id)
+                return
+            if isinstance(node, P.Join):
+                mark(node.left, in_build)
+                mark(node.right, True)
+                return
+            for s in node.sources:
+                mark(s, in_build)
+
+        mark(frag.root, False)
+        return build
+
+    def rec(sp: "SubPlan"):
+        build_ids = build_side_fragments(sp.fragment)
+        ordered = sorted(
+            sp.children,
+            key=lambda c: 0 if c.fragment.id in build_ids else 1,
+        )
+        for c in ordered:
+            rec(c)
+        out.append(sp.fragment)
+
+    rec(sub)
+    return out
+
+
 class HttpRemoteTask:
     """Coordinator-side handle of one worker task."""
 
@@ -160,6 +245,7 @@ class ClusterScheduler:
     def __init__(self, engine, node_manager: ClusterNodeManager):
         self.engine = engine
         self.node_manager = node_manager
+        self.node_scheduler = NodeScheduler(node_manager)
 
     def execute(self, plan: P.PlanNode, session: Session):
         """Returns (Batch, column_names)."""
@@ -171,7 +257,13 @@ class ClusterScheduler:
         query_id = f"cq{next(_task_counter)}"
 
         fragments = {f.id: f for f in sub.all_fragments()}
-        order = self._bottom_up(sub)
+        # execution policy: all-at-once launches in simple bottom-up order;
+        # phased launches join build sides before their probes
+        # (AllAtOnceExecutionPolicy vs PhasedExecutionPolicy)
+        if session.get("execution_policy") == "phased":
+            order = phased_order(sub)
+        else:
+            order = self._bottom_up(sub)
 
         # task counts per fragment (root runs on the coordinator)
         task_counts: dict[int, int] = {}
@@ -222,6 +314,10 @@ class ClusterScheduler:
                 for t in tasks:
                     t.cancel()
             raise
+        finally:
+            for tasks in remote_tasks.values():
+                for t in tasks:
+                    self.node_scheduler.release(t.node)
 
     # --- stage scheduling -------------------------------------------------
 
@@ -308,21 +404,32 @@ class ClusterScheduler:
                         )
         frag_json = fragment_to_json(frag)
         tasks: list[HttpRemoteTask] = []
-        for p in range(n_tasks):
-            payload = {
-                "session": session_json,
-                "fragment": frag_json,
-                "splits": split_assignment[p],
-                "sources": self._sources_payload(
-                    frag, p, remote_tasks, fragments
-                ),
-                "output_partitions": output_partitions,
-            }
-            task = HttpRemoteTask(
-                nodes[p % len(nodes)], f"{query_id}.{frag.id}.{p}", payload
-            )
-            task.start()
-            tasks.append(task)
+        placements = self.node_scheduler.select(nodes, n_tasks)
+        try:
+            for p in range(n_tasks):
+                payload = {
+                    "session": session_json,
+                    "fragment": frag_json,
+                    "splits": split_assignment[p],
+                    "sources": self._sources_payload(
+                        frag, p, remote_tasks, fragments
+                    ),
+                    "output_partitions": output_partitions,
+                }
+                task = HttpRemoteTask(
+                    placements[p], f"{query_id}.{frag.id}.{p}", payload
+                )
+                task.start()  # acquire only after a successful start
+                self.node_scheduler.acquire(placements[p])
+                tasks.append(task)
+        except Exception:
+            # a mid-fragment failure leaves these tasks outside
+            # remote_tasks, so the query-level release never sees them:
+            # cancel + release here to keep the load counters honest
+            for t in tasks:
+                t.cancel()
+                self.node_scheduler.release(t.node)
+            raise
         return tasks
 
     # --- root fragment on the coordinator --------------------------------

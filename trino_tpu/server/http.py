@@ -57,6 +57,7 @@ class TrinoTpuServer:
         node_id: Optional[str] = None,
         discovery_uri: Optional[str] = None,
         spmd: bool = False,
+        cluster_memory_limit_bytes: Optional[int] = None,
     ):
         from trino_tpu.server.resourcegroups import ResourceGroupManager
         from trino_tpu.server.task import SqlTaskManager
@@ -86,6 +87,15 @@ class TrinoTpuServer:
                 self.engine.spmd_peers = lambda: [
                     n.uri for n in self.node_manager.active_nodes()
                 ]
+        self.cluster_memory_manager = None
+        if role == "coordinator":
+            from trino_tpu.memory import ClusterMemoryManager
+
+            self.cluster_memory_manager = ClusterMemoryManager(
+                self.engine.memory_pool,
+                cluster_memory_limit_bytes or (64 << 30),
+                kill_fn=lambda qid, msg: self.query_manager.kill(qid, msg),
+            )
         self.query_manager = QueryManager(
             self.engine,
             max_concurrent,
@@ -127,8 +137,19 @@ class TrinoTpuServer:
                 try:
                     from trino_tpu.server import auth
 
+                    pool = self.engine.memory_pool
+                    with pool._lock:
+                        reservations = dict(pool._query_reserved)
                     body = json.dumps(
-                        {"nodeId": self.node_id, "uri": self.base_uri}
+                        {
+                            "nodeId": self.node_id,
+                            "uri": self.base_uri,
+                            "memoryInfo": {
+                                "capacityBytes": pool.capacity,
+                                "reservedBytes": sum(reservations.values()),
+                                "queryReservations": reservations,
+                            },
+                        }
                     ).encode()
                     req = _rq.Request(
                         f"{self.discovery_uri}/v1/announce",
@@ -346,6 +367,23 @@ def _make_handler(server: TrinoTpuServer):
                 payload = json.loads(self.rfile.read(length).decode())
                 task = server.task_manager.create_or_update(parts[2], payload)
                 return self._send_json(task.info())
+            if path == "/v1/write":
+                # scaled-writer data plane: binary serialized batch in the
+                # body, target table in query params; the connector appends
+                # a part file on shared storage (reference: TableWriter
+                # tasks under ScaledWriterScheduler)
+                q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+                length = int(self.headers.get("Content-Length", 0))
+                payload = self.rfile.read(length)
+                try:
+                    from trino_tpu.serde import deserialize_batch
+
+                    batch = deserialize_batch(payload)
+                    conn = server.engine.catalogs.get(q["catalog"][0])
+                    n = conn.insert(q["schema"][0], q["table"][0], batch)
+                    return self._send_json({"rows": n})
+                except Exception as e:  # noqa: BLE001
+                    return self._error(400, f"write failed: {e}")
             if path == "/v1/spmd":
                 if server.spmd is None:
                     return self._error(400, "spmd mode not enabled")
@@ -369,6 +407,10 @@ def _make_handler(server: TrinoTpuServer):
                         "uptime": f"{time.time() - server.start_time:.2f}s",
                     }
                 )
+            if path == "/v1/memory":
+                if server.cluster_memory_manager is None:
+                    return self._error(404, "not a coordinator")
+                return self._send_json(server.cluster_memory_manager.info())
             if path == "/v1/info/state":
                 return self._send_json(server.state)
             if path == "/v1/status":
@@ -537,6 +579,10 @@ def _make_handler(server: TrinoTpuServer):
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length).decode())
                 server.node_manager.announce(body["nodeId"], body["uri"])
+                if server.cluster_memory_manager is not None:
+                    server.cluster_memory_manager.update(
+                        body["nodeId"], body.get("memoryInfo")
+                    )
                 return self._send_json({"ok": True})
             if path == "/v1/info/state":
                 length = int(self.headers.get("Content-Length", 0))

@@ -32,6 +32,18 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--spmd-procs", type=int, default=0)
     parser.add_argument("--spmd-rank", type=int, default=0)
+    parser.add_argument(
+        "--catalog",
+        action="append",
+        default=[],
+        help="register a catalog: name=kind[:arg] (etc/catalog analog)",
+    )
+    parser.add_argument(
+        "--cluster-memory-limit-bytes",
+        type=int,
+        default=None,
+        help="coordinator-enforced cluster-wide memory ceiling",
+    )
     args = parser.parse_args(argv)
 
     if args.platform:
@@ -47,13 +59,24 @@ def main(argv=None) -> int:
 
     from trino_tpu.server.http import TrinoTpuServer
 
+    engine = None
+    if args.catalog:
+        from trino_tpu.connectors.api import register_catalog_spec
+        from trino_tpu.engine import Engine
+
+        engine = Engine()
+        for spec in args.catalog:
+            register_catalog_spec(engine.catalogs, spec)
+
     server = TrinoTpuServer(
+        engine=engine,
         host=args.host,
         port=args.port,
         role=args.role,
         node_id=args.node_id,
         discovery_uri=args.discovery,
         spmd=bool(args.spmd_coordinator),
+        cluster_memory_limit_bytes=args.cluster_memory_limit_bytes,
     )
     server.start()
     # parent supervisors (tests, orchestration) read this line

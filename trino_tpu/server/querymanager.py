@@ -115,6 +115,20 @@ class ManagedQuery:
             self.error = ErrorInfo("Query was canceled", 1, "USER_CANCELED", "USER_ERROR")
             self.end_time = time.time()
 
+    def kill(self, message: str) -> bool:
+        """Administrative kill (cluster memory manager): FAILED with
+        CLUSTER_OUT_OF_MEMORY, not user-canceled (reference:
+        ``ClusterMemoryManager.java:104`` killQuery)."""
+        self._cancelled.set()
+        if self.state.set(QueryState.FAILED):
+            self.error = ErrorInfo(
+                message, 131081, "CLUSTER_OUT_OF_MEMORY",
+                "INSUFFICIENT_RESOURCES",
+            )
+            self.end_time = time.time()
+            return True
+        return False
+
     # --- info -------------------------------------------------------------
 
     def info(self) -> dict:
@@ -202,6 +216,12 @@ class QueryManager:
             return False
         q.cancel()
         return True
+
+    def kill(self, query_id: str, message: str) -> bool:
+        q = self.get(query_id)
+        if q is None:
+            return False
+        return q.kill(message)
 
     def _gc_locked(self) -> None:
         if len(self._queries) <= self.max_history:

@@ -566,9 +566,40 @@ class SqlTask:
             raise errors[0]
         return out
 
+    @property
+    def query_id(self) -> str:
+        """Task ids are ``{query_id}.{fragment}.{partition}``."""
+        return self.task_id.rsplit(".", 2)[0]
+
+    def _account(self, nbytes: int) -> None:
+        """Per-task memory accounting against this node's pool, keyed by
+        query id — the reservations workers report to the coordinator's
+        ClusterMemoryManager (reference: per-task memory contexts rolling
+        up to ``MemoryPool`` / ``ClusterMemoryManager.java:89``)."""
+        if nbytes <= 0:
+            return
+        from trino_tpu.memory import ExceededMemoryLimitError
+
+        if not self.engine.memory_pool.try_reserve(self.query_id, nbytes):
+            raise ExceededMemoryLimitError(
+                f"task {self.task_id}: node memory pool exhausted reserving "
+                f"{nbytes} bytes"
+            )
+        self._reserved += nbytes
+
     def _run(self) -> None:
+        self._reserved = 0
         try:
             prefetched = self._prefetch_sources()
+            from trino_tpu.memory import batch_nbytes
+
+            self._account(
+                sum(
+                    batch_nbytes(b)
+                    for batches in prefetched.values()
+                    for b in batches
+                )
+            )
             result = None
             mode = self.session.get("worker_execution")
             if mode in ("fused", "fused_strict"):
@@ -576,6 +607,7 @@ class SqlTask:
             if result is None:
                 self.execution_path = "interpreter"
                 result = self._run_interpreted(prefetched)
+            self._account(batch_nbytes(result.batch) if result.batch is not None else 0)
             self._emit(result)
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001
@@ -583,6 +615,8 @@ class SqlTask:
             self.state = "FAILED"
         finally:
             self.buffer.set_complete()
+            if self._reserved:
+                self.engine.memory_pool.free(self.query_id, self._reserved)
 
     def _try_fused(self, prefetched, strict: bool = False) -> Optional[Result]:
         """Fragment as one compiled program on worker-local devices; None

@@ -542,6 +542,17 @@ class Parser:
                 q = self.query()
                 self.expect_op(")")
                 return t.SubqueryRelation(q)
+            if self.at_op("("):
+                # ambiguous: "((select ...) except (select ...))" is a
+                # set-op subquery; "((a join b) ...)" is a relation.
+                # Try the query grammar first, backtrack on failure.
+                save = self.pos
+                try:
+                    q = self.query()
+                    self.expect_op(")")
+                    return t.SubqueryRelation(q)
+                except SqlSyntaxError:
+                    self.pos = save
             rel = self._relation()
             self.expect_op(")")
             return rel

@@ -208,11 +208,39 @@ def lower_string_calls(expr: RowExpr, columns: list[Column]) -> RowExpr:
         new_values = [_apply(name, v, rest) for v in d.values]
         return add_column(transformed_column(col, new_values))
 
+    def _coalesce_to_ref(a: RowExpr) -> RowExpr:
+        """COALESCE(string_col, 'const') -> synthetic column with the
+        constant folded into the dictionary (nulls remapped to its code)."""
+        if not (
+            isinstance(a, SpecialForm)
+            and a.form == "coalesce"
+            and len(a.args) == 2
+            and isinstance(a.args[0], InputRef)
+            and isinstance(a.args[1], Constant)
+            and a.args[1].value is not None
+        ):
+            return a
+        col = columns[a.args[0].channel]
+        d = col.dictionary or Dictionary([])
+        fill = str(a.args[1].value)
+        values = list(d.values)
+        try:
+            fill_code = values.index(fill)
+        except ValueError:
+            fill_code = len(values)
+            values = values + [fill]
+        valid = col.valid_mask() & (jnp.asarray(col.data) >= 0)
+        codes = jnp.where(valid, jnp.maximum(col.data, 0), fill_code).astype(
+            jnp.int32
+        )
+        return add_column(Column(T.VARCHAR, codes, None, Dictionary(values)))
+
     def lower_concat(e: Call) -> RowExpr:
         parts = []  # "const" str | ("ref", channel)
         channels: list[int] = []
         any_null_const = False
         for a in e.args:
+            a = _coalesce_to_ref(a)
             if isinstance(a, Constant):
                 if a.value is None:
                     any_null_const = True
@@ -241,7 +269,34 @@ def lower_string_calls(expr: RowExpr, columns: list[Column]) -> RowExpr:
             da = ca.dictionary or Dictionary([])
             db = cb.dictionary or Dictionary([])
             if max(len(da), 1) * max(len(db), 1) > _CROSS_DICT_CAP:
-                raise NotImplementedError("concat dictionary cross too large")
+                # big cross (name x name): materialize per ROW instead of
+                # per dictionary pair — O(rows) host work, bounded output
+                import numpy as np
+
+                codes_a = np.asarray(ca.data)
+                codes_b = np.asarray(cb.data)
+                valid = np.asarray(ca.valid_mask() & cb.valid_mask()) & (
+                    codes_a >= 0
+                ) & (codes_b >= 0)
+                row_strings = []
+                for i in range(len(codes_a)):
+                    if not valid[i]:
+                        row_strings.append("")
+                        continue
+                    va = da.decode(int(codes_a[i])) or ""
+                    vb = db.decode(int(codes_b[i])) or ""
+                    row_strings.append(
+                        "".join(
+                            p
+                            if isinstance(p, str)
+                            else (va if p[1] == channels[0] else vb)
+                            for p in parts
+                        )
+                    )
+                d, codes = Dictionary.from_strings(row_strings)
+                codes = np.where(valid, codes, -1).astype(np.int32)
+                return add_column(Column(T.VARCHAR, jnp.asarray(codes),
+                                         jnp.asarray(valid), d))
             values = []
             for va in da.values:
                 for vb in db.values:

@@ -77,7 +77,14 @@ class MultiProcessQueryRunner:
     stricter: nothing can leak through shared memory).
     """
 
-    def __init__(self, n_workers: int = 2, platform: str = "cpu", spmd: bool = False):
+    def __init__(
+        self,
+        n_workers: int = 2,
+        platform: str = "cpu",
+        spmd: bool = False,
+        cluster_memory_limit_bytes: Optional[int] = None,
+        catalogs: Optional[list] = None,
+    ):
         import os
         import subprocess
         import sys
@@ -167,10 +174,16 @@ class MultiProcessQueryRunner:
                 for rank in range(nprocs)
             ]
 
-        coord_proc = popen(
-            ["--role", "coordinator", "--platform", platform]
-            + (spmd_args[0] if spmd else [])
-        )
+        catalog_args: list[str] = []
+        for spec in catalogs or []:
+            catalog_args += ["--catalog", spec]
+        coord_args = ["--role", "coordinator", "--platform", platform]
+        coord_args += catalog_args
+        if cluster_memory_limit_bytes is not None:
+            coord_args += [
+                "--cluster-memory-limit-bytes", str(cluster_memory_limit_bytes)
+            ]
+        coord_proc = popen(coord_args + (spmd_args[0] if spmd else []))
         if spmd:
             # workers must join the jax.distributed group before any process
             # finishes booting; spawn all before reading LISTENING lines.
@@ -188,6 +201,7 @@ class MultiProcessQueryRunner:
                         "--platform",
                         platform,
                     ]
+                    + catalog_args
                     + spmd_args[i + 1]
                 )
                 for i in range(n_workers)
@@ -224,6 +238,7 @@ class MultiProcessQueryRunner:
                             "--platform",
                             platform,
                         ]
+                        + catalog_args
                     )
                 )
                 for i in range(n_workers)
