@@ -51,7 +51,7 @@ class TestFileConnector:
             "default", "parts_t", 8,
             constraint=TupleDomain({"k": Domain.of_values([150])}),
         )
-        assert len(pruned) == 1 and pruned[0].info == "part-00001.ttp"
+        assert len(pruned) == 1 and pruned[0].info.startswith("part-00001-")
         runner.assert_query(
             "select v from file.default.parts_t where k = 200", [("d",)]
         )

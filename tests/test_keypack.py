@@ -1,0 +1,142 @@
+"""ops/keypack unit tests: bit-packed sort lanes and layout round-trips.
+
+The packing discipline exists because XLA:TPU ``lax.sort`` compile time
+is ~linear in operand count (and doubles under ``is_stable``): grouping
+sorts pack every bool/int key into 1-3 integer lanes.  These tests pin
+the layout algebra against numpy oracles, including the lane-straddle
+layouts the round-5 review flagged (index field split across 63-bit
+lanes)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu.ops import keypack as KP
+
+
+def _lexsort_oracle(arrays):
+    """np.lexsort with most-significant key LAST in np convention."""
+    return np.lexsort(tuple(reversed(arrays)))
+
+
+class TestSortPermutation:
+    def test_matches_lexsort_mixed_dtypes(self):
+        rng = np.random.default_rng(1)
+        n = 5000
+        sel = rng.random(n) < 0.8
+        k1 = rng.integers(-(2**62), 2**62, n)
+        v1 = rng.random(n) < 0.9
+        k2 = rng.integers(-40000, 40000, n).astype(np.int32)
+        fields, native = KP.key_fields(
+            [(jnp.asarray(k1), jnp.asarray(v1)), (jnp.asarray(k2), None)],
+            jnp.asarray(sel),
+        )
+        assert not native
+        _, perm, _, first_bit = KP.sort_permutation(fields, n)
+        k1m = np.where(v1, k1, 0)
+        order = _lexsort_oracle([~sel, ~v1, k1m, k2, np.arange(n)])
+        assert np.array_equal(np.asarray(perm), order)
+        assert np.array_equal(np.asarray(~first_bit), sel[order])
+
+    def test_straddling_index_field(self):
+        # 1(sel)+1(valid)+32+16 = 50 field bits; 17 index bits straddles
+        # a 63-bit boundary without the filler alignment
+        rng = np.random.default_rng(2)
+        n = 1 << 17
+        k1 = rng.integers(-(2**30), 2**30, n).astype(np.int32)
+        k2 = rng.integers(-30000, 30000, n).astype(np.int16)
+        sel = rng.random(n) < 0.9
+        v1 = rng.random(n) < 0.95
+        eq, perm, s_sel = KP.grouping_sort(
+            [(jnp.asarray(k1), jnp.asarray(v1)), (jnp.asarray(k2), None)],
+            jnp.asarray(sel),
+            n,
+        )
+        p = np.asarray(perm)
+        assert sorted(p.tolist()) == list(range(n))
+        assert np.array_equal(np.asarray(s_sel), sel[p])
+
+    def test_wide_decimal_ordering(self):
+        rng = np.random.default_rng(3)
+        n = 4096
+        hi = rng.integers(-(2**62), 2**62, n)
+        lo = rng.integers(0, 2**63, n)
+        v = rng.random(n) < 0.9
+        sel = np.ones(n, bool)
+        wd = jnp.stack([jnp.asarray(hi), jnp.asarray(lo)], axis=1)
+        fields, _ = KP.key_fields([(wd, jnp.asarray(v))], jnp.asarray(sel))
+        _, perm, _, _ = KP.sort_permutation(fields, n)
+        him = np.where(v, hi, 0)
+        lom = np.where(v, lo, 0).astype(np.uint64)
+        order = _lexsort_oracle([~sel, ~v, him, lom, np.arange(n)])
+        assert np.array_equal(np.asarray(perm), order)
+
+
+class TestKeyPlan:
+    def test_round_trip_layouts(self):
+        rng = np.random.default_rng(4)
+        n = 1000
+        cases = [
+            # single int64 key, nullable
+            [(rng.integers(-(2**62), 2**62, n), rng.random(n) < 0.9)],
+            # int32 + int16 (straddle layout)
+            [
+                (rng.integers(-(2**30), 2**30, n).astype(np.int32),
+                 rng.random(n) < 0.9),
+                (rng.integers(-30000, 30000, n).astype(np.int16), None),
+            ],
+            # bool + date-like int32
+            [
+                (rng.random(n) < 0.5, None),
+                (rng.integers(0, 40000, n).astype(np.int32),
+                 rng.random(n) < 0.8),
+            ],
+            # three int64 keys (multi-lane)
+            [
+                (rng.integers(-(2**62), 2**62, n), None),
+                (rng.integers(-(2**62), 2**62, n), rng.random(n) < 0.7),
+                (rng.integers(-100, 100, n), None),
+            ],
+        ]
+        for raw in cases:
+            keys = [
+                (jnp.asarray(d), None if v is None else jnp.asarray(v))
+                for d, v in raw
+            ]
+            sel = jnp.ones(n, bool)
+            plan = KP.KeyPlan(keys, sel_present=True)
+            fields, native = plan.build_fields(keys, sel)
+            lanes = KP.pack(fields)
+            assert len(lanes) == plan.num_lanes
+            assert bool(np.asarray(plan.sel_bit(lanes[0])).all())
+            for ki, (d, v) in enumerate(raw):
+                g, kv = plan.key_output(keys, lanes, [], ki)
+                m = np.ones(n, bool) if v is None else v
+                assert np.array_equal(np.asarray(g)[m], d[m]), (ki, raw)
+                if v is not None:
+                    assert np.array_equal(np.asarray(kv), v)
+
+
+class TestHelpers:
+    def test_compact_front_positions(self):
+        rng = np.random.default_rng(5)
+        for n in (64, 1 << 12, 100_000):
+            flags = rng.random(n) < 0.3
+            pos = np.asarray(
+                KP.compact_front_positions(jnp.asarray(flags), n)
+            )
+            want = np.nonzero(flags)[0]
+            assert np.array_equal(pos[: len(want)], want)
+
+    def test_inverse_permute_mask(self):
+        rng = np.random.default_rng(6)
+        n = 5000
+        perm = rng.permutation(n).astype(np.int32)
+        mask = rng.random(n) < 0.5
+        out = np.asarray(
+            KP.inverse_permute_mask(jnp.asarray(perm), jnp.asarray(mask))
+        )
+        want = np.empty(n, bool)
+        want[perm] = mask
+        assert np.array_equal(out, want)

@@ -625,27 +625,23 @@ class FragmentedExecutor(DistributedExecutor):
 
 def _dup_key_rows(keys, sel):
     """Boolean per-row flags: row's full key appears on MORE than one
-    selected row. Sort-based (scatter-free): adjacent equal keys in the
-    sorted order mark both neighbors; a second sort on the permutation
-    restores original row order."""
-    from trino_tpu.ops.aggregation import _sortable_keys
+    selected row. Sort-based (scatter-free): one narrow bit-packed sort
+    (ops/keypack.py) puts equal keys adjacent; neighbors with equal keys
+    are duplicates; a scatter-free inverse-permutation sort restores
+    original row order."""
+    from trino_tpu.ops import keypack as KP
 
     n = sel.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    ops = _sortable_keys(keys, sel)
-    nk = len(ops)
-    sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=nk)
-    perm = sorted_ops[-1]
-    s_sel = ~sorted_ops[0]
+    eq_lanes, perm, s_sel = KP.grouping_sort(keys, sel, n)
     same_prev = idx > 0  # first sorted row has no predecessor
-    for k in sorted_ops[:nk]:
+    for k in eq_lanes:
         prev = jnp.concatenate([k[:1], k[:-1]])
         same_prev = same_prev & (k == prev)
     same_prev = same_prev & s_sel
     same_next = jnp.concatenate([same_prev[1:], jnp.zeros(1, jnp.bool_)])
     dup_sorted = (same_prev | same_next) & s_sel
-    _, back = jax.lax.sort((perm, dup_sorted), num_keys=1)
-    return back
+    return KP.inverse_permute_mask(perm, dup_sorted)
 
 
 class _OptPack:
@@ -768,7 +764,8 @@ class _FragmentTracer(DistributedExecutor):
     def _exec_limit(self, node: P.Limit) -> Result:
         res = self._exec(node.source)
         sel = res.batch.selection_mask()
-        rank = jnp.cumsum(sel.astype(jnp.int64))
+        from trino_tpu.ops.aggregation import _prefix_sum
+        rank = _prefix_sum(sel.astype(jnp.int32))
         keep = sel
         if node.offset:
             keep = keep & (rank > node.offset)

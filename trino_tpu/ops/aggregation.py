@@ -55,21 +55,6 @@ class AggSpec:
     input_dtype: object | None = None  # storage dtype of the input
 
 
-def _sortable_keys(keys: Sequence[tuple[jnp.ndarray, jnp.ndarray]], sel: jnp.ndarray):
-    """Build lax.sort operand list: selection first (selected rows to the
-    front), then per-key (valid, data) pairs so NULL keys form one group.
-    Wide DECIMAL keys ((n, 2) lanes) contribute one operand per lane."""
-    ops = [~sel]  # False (selected) sorts before True
-    for data, valid in keys:
-        ops.append(~valid)  # non-null first; all nulls group together
-        if getattr(data, "ndim", 1) == 2:
-            for lane in (data[:, 0], data[:, 1]):
-                ops.append(jnp.where(valid, lane, jnp.zeros_like(lane)))
-        else:
-            ops.append(jnp.where(valid, data, jnp.zeros_like(data)))
-    return ops
-
-
 def group_aggregate(
     keys: Sequence[tuple[jnp.ndarray, jnp.ndarray]],
     sel: jnp.ndarray,
@@ -96,36 +81,23 @@ def group_aggregate(
     """
     n = sel.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    # build sort operands, tracking each key's operand positions (wide
-    # DECIMAL keys contribute two value lanes). A ``valid`` of None means
-    # "no nulls": the validity sort lane and null-masking are skipped
-    # entirely (each dropped bool lane is a full bitonic pass saved).
-    ops = [~sel]
-    key_pos: list = []  # (valid_idx | None, data_idx...)
-    for data, valid in keys:
-        if valid is None:
-            vi = None
-        else:
-            vi = len(ops)
-            ops.append(~valid)
-        if getattr(data, "ndim", 1) == 2:
-            di = (len(ops), len(ops) + 1)
-            for lane in (data[:, 0], data[:, 1]):
-                ops.append(
-                    lane if valid is None
-                    else jnp.where(valid, lane, jnp.zeros_like(lane))
-                )
-        else:
-            di = (len(ops),)
-            ops.append(
-                data if valid is None
-                else jnp.where(valid, data, jnp.zeros_like(data))
-            )
-        key_pos.append((vi, di))
-    num_keys = len(ops)
-    # aggregate inputs ride the sort as payload operands: bitonic payload
-    # moves are near-contiguous vector ops, ~17x cheaper here than the
-    # random 1M-row gathers a post-sort ``data[perm]`` would need
+    # ONE narrow sort: all key columns (plus selection/validity bits) are
+    # bit-packed into 1-3 integer lanes (ops/keypack.py), sorted unstably
+    # — XLA:TPU sort compile time is ~linear in operand count AND doubles
+    # under is_stable, so the old per-column operand list compiled ~20x
+    # slower. Aggregate inputs RIDE the sort as payload lanes: a post-sort
+    # random gather costs ~35ms per column at 2^21 rows on v5e, ~10x the
+    # whole sort; payload moves inside the sort are near-free by
+    # comparison. Group-key outputs are recovered by G-sized bit
+    # extraction from the packed lanes (KeyPlan), not payload lanes.
+    from trino_tpu.ops import keypack as KP
+
+    plan = KP.KeyPlan(keys, sel_present=True)
+    fields, native = plan.build_fields(keys, sel)
+    packed = KP.pack(fields)
+    n_packed = len(packed)
+    key_ops = packed + list(native)
+    nkey_ops = len(key_ops)
     payload: list = []
     payload_pos: dict[tuple, tuple] = {}
     for pair in agg_inputs:
@@ -135,15 +107,18 @@ def group_aggregate(
         if pid in payload_pos:
             continue
         data, valid = pair
-        base = num_keys + len(payload)
+        base = nkey_ops + len(payload)
         wide = getattr(data, "ndim", 1) == 2
         lanes = [data[:, 0], data[:, 1]] if wide else [data]
         if valid is not None:
             lanes.append(valid)
         payload.extend(lanes)
         payload_pos[pid] = (wide, tuple(range(base, base + len(lanes))), valid is not None)
-    sorted_ops = jax.lax.sort(tuple(ops) + tuple(payload), num_keys=num_keys)
-    s_sel = ~sorted_ops[0]
+    sorted_ops = jax.lax.sort(
+        tuple(key_ops) + tuple(payload), num_keys=nkey_ops, is_stable=False
+    )
+    s_lanes = list(sorted_ops[:nkey_ops])
+    s_sel = plan.sel_bit(s_lanes[0])
 
     def _sorted_pair(pair):
         wide, pos, has_valid = payload_pos[(id(pair[0]), id(pair[1]))]
@@ -155,9 +130,9 @@ def group_aggregate(
             )
         return sorted_ops[pos[0]], sv
 
-    # boundary: first row, or any sort key changed vs previous row
+    # boundary: first row, or any sorted key lane changed vs previous row
     changed = idx == 0
-    for k in sorted_ops[:num_keys]:
+    for k in s_lanes:
         prev = jnp.concatenate([k[:1], k[:-1]])
         changed = changed | (k != prev)
     changed = changed & s_sel
@@ -169,23 +144,19 @@ def group_aggregate(
 
     seg = _SortedSegments(changed, s_sel, group_id, num_groups, max_groups, n)
 
-    # group key output: gather the first row of each segment
+    # group key output: gather the packed lanes at each segment's first
+    # sorted row (G-sized gathers) and bit-extract the key fields back
+    lanes_at = [seg.first(ln) for ln in s_lanes[:n_packed]]
+    native_at = [seg.first(ln) for ln in s_lanes[n_packed:]]
     out_key_data, out_key_valid = [], []
-    for (data, valid), (vi, di) in zip(keys, key_pos):
-        if vi is None:
-            kv = seg.nonempty
+    for ki, (data, valid) in enumerate(keys):
+        g, kv = plan.key_output(keys, lanes_at, native_at, ki)
+        kv = seg.nonempty if kv is None else (kv & seg.nonempty)
+        zero = jnp.zeros((), data.dtype)
+        if getattr(data, "ndim", 1) == 2:
+            out_key_data.append(jnp.where(kv[:, None], g, zero).astype(data.dtype))
         else:
-            kv = seg.first(~sorted_ops[vi]) & seg.nonempty
-        lanes_out = []
-        for d_idx in di:
-            s_data = sorted_ops[d_idx]
-            lanes_out.append(
-                jnp.where(seg.nonempty, seg.first(s_data), jnp.zeros((), s_data.dtype))
-            )
-        if len(lanes_out) == 2:
-            out_key_data.append(jnp.stack(lanes_out, axis=1).astype(data.dtype))
-        else:
-            out_key_data.append(lanes_out[0].astype(data.dtype))
+            out_key_data.append(jnp.where(kv, g, zero).astype(data.dtype))
         out_key_valid.append(kv)
 
     results = []
@@ -256,38 +227,63 @@ def group_aggregate(
 def _prefix_sum(x):
     """Inclusive prefix sum via a blocked two-level scan.
 
-    ``jnp.cumsum`` lowers to one big reduce-window whose scoped-vmem
-    allocation blows up inside TPU while-loops (the streaming chunk loop);
-    scanning 512-row blocks keeps every window small, and the block-offset
-    pass runs over n/512 elements."""
+    ``jnp.cumsum`` lowers to one big reduce-window: its scoped-vmem
+    allocation blows up inside TPU while-loops (the streaming chunk
+    loop), and XLA:TPU takes ~1min to COMPILE an int64 reduce-window at
+    odd (non-power-of-two) sizes. Odd sizes are padded to a block
+    multiple so every window stays small and power-of-two shaped."""
     n = x.shape[0]
     blk = 512
-    if n <= blk or n % blk:
+    if n <= blk:
         return jnp.cumsum(x)
-    xb = jnp.reshape(x, (n // blk, blk))
+    pad = (-n) % blk
+    xp = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)]) if pad else x
+    xb = jnp.reshape(xp, ((n + pad) // blk, blk))
     within = jnp.cumsum(xb, axis=1)
     offsets = jnp.cumsum(within[:, -1])
     offsets = jnp.concatenate([jnp.zeros((1,), x.dtype), offsets[:-1]])
-    return jnp.reshape(within + offsets[:, None], (n,))
+    out = jnp.reshape(within + offsets[:, None], (n + pad,))
+    return out[:n] if pad else out
+
+
+def _segmented_scan(flags, x, kind: str):
+    """Running within-segment reduction (sum/min/max) via one
+    ``associative_scan`` over (segment-start flag, value) pairs — the
+    standard segmented-scan operator, O(log n) passes, no sort and no
+    scatter. ``run[last_row_of_segment]`` is the segment reduction."""
+    if kind == "sum":
+        op = jnp.add
+    elif kind == "min":
+        op = jnp.minimum
+    else:
+        op = jnp.maximum
+
+    def comb(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, op(av, bv))
+
+    _, run = jax.lax.associative_scan(comb, (flags, x))
+    return run
 
 
 class _SortedSegments:
     """Scatter-free reductions over rows sorted by a monotonic group id.
 
     ``starts[g]`` is the first sorted-row index of group ``g``; every
-    reduction is then a cumsum difference or a boundary gather. Boundary
-    positions come from one cheap ``(bool, int32)`` sort — stably sorting
-    row indices by "is not a group boundary" compacts the boundary
-    positions to the front (a ``searchsorted`` over the 1M-row group-id
-    array costs ~5x more here: its binary-search rounds serialize, while
-    one more bitonic sort rides the same fast path the main sort uses).
-    """
+    reduction is then a cumsum difference, a boundary gather, or a
+    segmented associative scan. Boundary positions come from one cheap
+    single-lane sort of ``(is-not-boundary, row-index)`` packed into one
+    integer (a ``searchsorted`` over the 1M-row group-id array costs ~5x
+    more here: its binary-search rounds serialize, while one more narrow
+    bitonic sort rides the same fast path the main sort uses)."""
 
     def __init__(self, changed, s_sel, group_id_sorted, num_groups,
                  max_groups: int, n: int):
-        idx = jnp.arange(n, dtype=jnp.int32)
+        from trino_tpu.ops import keypack as KP
+
         g = min(max_groups + 1, n)
-        _, pos = jax.lax.sort((~changed, idx), num_keys=1)
+        pos = KP.compact_front_positions(changed, n)
         pos = pos[:g]
         if g < max_groups + 1:  # tiny batch: fewer rows than groups
             pos = jnp.concatenate(
@@ -298,6 +294,7 @@ class _SortedSegments:
         self.starts = jnp.where(live, pos, n_sel)
         self.sizes = self.starts[1:] - self.starts[:-1]
         self.nonempty = self.sizes > 0
+        self._changed = changed
         self._gid = group_id_sorted
         self._max_groups = max_groups
         hi = max(n - 1, 0)
@@ -311,30 +308,47 @@ class _SortedSegments:
     def sum(self, x):
         """Per-segment sum via exclusive-cumsum boundary differences.
 
-        Exact for integers (modular wraparound cancels); floats keep the
-        scatter path so per-segment rounding stays left-to-right instead
-        of accumulating across the whole chunk.
-        """
+        Exact for integers (modular wraparound cancels); floats use a
+        segmented scan (the running within-segment sum read at each
+        segment's last row) — a global float cumsum would accumulate
+        cross-segment rounding, and a scatter ``segment_sum`` serializes
+        on TPU."""
         import numpy as np
 
         if not np.issubdtype(np.dtype(x.dtype), np.integer):
-            return jax.ops.segment_sum(
-                x, self._gid, num_segments=self._max_groups
-            )
+            run = _segmented_scan(self._changed, x, "sum")
+            return jnp.where(self.nonempty, run[self._last_idx], 0)
         cs = _prefix_sum(x)
         csz = jnp.concatenate([jnp.zeros((1,), x.dtype), cs])
         return csz[self.starts[1:]] - csz[self.starts[:-1]]
 
     def extreme(self, masked, kind: str):
-        """Per-segment min/max of pre-masked values via one extra sort."""
-        _, sv = jax.lax.sort((self._gid, masked), num_keys=2)
-        return sv[self._first_idx] if kind == "min" else sv[self._last_idx]
+        """Per-segment min/max of pre-masked values via one segmented
+        associative scan (sort-free, scatter-free): the running extreme
+        read at each segment's last row."""
+        run = _segmented_scan(self._changed, masked, kind)
+        return run[self._last_idx]
 
     def extreme2(self, k1, k2, kind: str):
-        """Lexicographic two-lane min/max (wide DECIMAL) via one sort."""
-        _, s1, s2 = jax.lax.sort((self._gid, k1, k2), num_keys=3)
-        i = self._first_idx if kind == "min" else self._last_idx
-        return s1[i], s2[i]
+        """Lexicographic two-lane min/max (wide DECIMAL) via one
+        segmented scan over (hi, lo) pairs."""
+        flags = self._changed
+
+        def comb(a, b):
+            af, ah, al = a
+            bf, bh, bl = b
+            a_less = (ah < bh) | ((ah == bh) & (al < bl))
+            take_a = a_less if kind == "min" else ~a_less
+            take_a = take_a & ~bf  # segment restart: keep b
+            return (
+                af | bf,
+                jnp.where(take_a, ah, bh),
+                jnp.where(take_a, al, bl),
+            )
+
+        _, rh, rl = jax.lax.associative_scan(comb, (flags, k1, k2))
+        i = self._last_idx
+        return rh[i], rl[i]
 
 
 def distinct_first_mask(
@@ -346,26 +360,21 @@ def distinct_first_mask(
     among selected rows — the dedup pass behind DISTINCT aggregates
     (reference: ``MarkDistinctOperator.java`` / distinct accumulators).
 
-    Sort-based: lexicographically sort (sel, keys..., value), mark rows where
-    any component differs from the previous row, and restore original row
-    order with a second (scatter-free) sort on the permutation.
+    Sort-based: one narrow bit-packed sort of (sel, keys..., value), mark
+    rows where any packed lane differs from the previous row, and restore
+    original row order with a scatter-free inverse-permutation sort.
     """
+    from trino_tpu.ops import keypack as KP
+
     n = sel.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
-    ops = _sortable_keys(list(keys) + [value], sel)
-    num_keys = len(ops)
-    sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=num_keys)
-    perm = sorted_ops[-1]
-    s_sel = ~sorted_ops[0]
+    s_lanes, perm, s_sel = KP.grouping_sort(list(keys) + [value], sel, n)
     changed = idx == 0
-    for k in sorted_ops[:num_keys]:
+    for k in s_lanes:
         prev = jnp.concatenate([k[:1], k[:-1]])
         changed = changed | (k != prev)
     first_sorted = changed & s_sel
-    # invert the permutation with a second sort (scatter-free): sorting
-    # (perm, mask) by perm restores original row order for the mask
-    _, out = jax.lax.sort((perm, first_sorted), num_keys=1)
-    return out
+    return KP.inverse_permute_mask(perm, first_sorted)
 
 
 def global_aggregate(

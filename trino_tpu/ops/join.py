@@ -59,7 +59,11 @@ def build_side(key_hash: jnp.ndarray, valid: jnp.ndarray, sel: jnp.ndarray):
     maxv = jnp.iinfo(jnp.int64).max
     keyed = jnp.where(use, key_hash, maxv)
     idx = jnp.arange(n, dtype=jnp.int32)
-    sorted_keys, sorted_idx = jax.lax.sort((keyed, idx), num_keys=1)
+    # idx as a second sort KEY (not payload): deterministic tie order
+    # without is_stable, which doubles XLA:TPU sort compile time
+    sorted_keys, sorted_idx = jax.lax.sort(
+        (keyed, idx), num_keys=2, is_stable=False
+    )
     count = jnp.sum(use.astype(jnp.int32))
     return sorted_keys, sorted_idx, count
 
@@ -125,7 +129,8 @@ def probe_join(
         emit = counts
     else:
         raise NotImplementedError(join_type)
-    offsets = jnp.cumsum(emit) - emit  # exclusive prefix
+    from trino_tpu.ops.aggregation import _prefix_sum
+    offsets = _prefix_sum(emit) - emit  # exclusive prefix
     total = offsets[-1] + emit[-1] if emit.shape[0] else jnp.int32(0)
     overflow = total > out_capacity
 

@@ -1,0 +1,417 @@
+"""Bit-packing of multi-column sort keys into minimal integer lanes.
+
+XLA:TPU's ``lax.sort`` compile time grows roughly linearly with the
+number of sort operands (~4s per int32 lane, ~12s per int64 lane at 2^20
+on v5e, doubled again by ``is_stable``) — a sort carrying one bool
+selection lane, per-key validity lanes, key lanes and payload lanes
+compiles for minutes.  The reference engine has no analogous constraint
+(its comparator chains are virtual calls — ``PagesIndex``/
+``OrderingCompiler.java``), so this packing tier is pure TPU design:
+
+- every bool/validity/int key is turned into an order-preserving
+  unsigned bit-field (ints are offset-binary: ``x XOR signbit``);
+- fields are concatenated MSB-first into 63-bit int64 lanes (31-bit
+  int32 when everything fits) so ONE unstable single-lane sort realizes
+  the full lexicographic multi-key order;
+- payload columns RIDE the sort (a post-sort random gather costs ~35ms
+  per column at 2^21 rows on v5e — more than the narrow sort itself);
+  only the group-key OUTPUTS are recovered by G-sized bit extraction
+  from the packed lanes (:class:`KeyPlan`).
+
+Values must already be in *storage* form (int64 bigints, int32 dates,
+dictionary codes, bool). Floats cannot be packed (no f64 bitcast under
+TPU x64 rewriting) and stay native lanes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LANE_BITS = 63  # int64 lanes, MSB kept zero so signed order == unsigned
+_LANE32_BITS = 31
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    """One order-preserving unsigned bit-field (value < 2**nbits)."""
+
+    bits: jnp.ndarray  # uint64 (or uint32 when nbits <= 31)
+    nbits: int
+
+
+def bool_field(b: jnp.ndarray) -> Field:
+    """False sorts before True."""
+    return Field(b.astype(jnp.uint32), 1)
+
+
+def int_field(x: jnp.ndarray, nbits: int | None = None) -> Field:
+    """Signed/unsigned integer -> offset-binary unsigned field.
+
+    ``nbits`` narrows the field when the value range is known (e.g.
+    dictionary codes in [−1, len) fit in ``len.bit_length()+1`` bits —
+    the +1 covering the −1 null/miss code after biasing).
+    """
+    w = np.dtype(x.dtype).itemsize * 8
+    if x.dtype == jnp.bool_:
+        return bool_field(x)
+    if nbits is None or nbits >= w:
+        if w <= 31:
+            # bias to non-negative: offset binary preserves signed order
+            return Field((x.astype(jnp.int64) + (1 << (w - 1))).astype(jnp.uint32), w)
+        if w == 64:
+            return Field(
+                (x.astype(jnp.int64) ^ jnp.int64(-(1 << 63))).astype(jnp.uint64), 64
+            )
+        return Field((x.astype(jnp.int64) + (1 << (w - 1))).astype(jnp.uint64), w)
+    # narrowed: bias by 2^(nbits-1) so negatives (e.g. -1 codes) still order
+    u = (x.astype(jnp.int64) + (1 << (nbits - 1))).astype(
+        jnp.uint32 if nbits <= 31 else jnp.uint64
+    )
+    return Field(u, nbits)
+
+
+def masked(f: Field, valid: jnp.ndarray) -> Field:
+    """Zero the field on invalid rows (canonical null bits)."""
+    return Field(jnp.where(valid, f.bits, jnp.zeros_like(f.bits)), f.nbits)
+
+
+def pack(fields: Sequence[Field]) -> list[jnp.ndarray]:
+    """Concatenate fields MSB-first into sortable integer lanes.
+
+    Returns a list of arrays (int32 single lane when total bits <= 31,
+    else int64 lanes); sorting the lanes lexicographically ascending ==
+    sorting the original field tuple lexicographically ascending.
+    """
+    total = sum(f.nbits for f in fields)
+    if total <= _LANE32_BITS:
+        lane = None
+        used = 0
+        for f in fields:
+            b = f.bits.astype(jnp.uint32)
+            lane = b if lane is None else (lane << f.nbits) | b
+            used += f.nbits
+        return [lane.astype(jnp.int32)]
+    lanes: list = []
+    cur = None
+    rem = _LANE_BITS
+    for f in fields:
+        bits = f.bits.astype(jnp.uint64)
+        nb = f.nbits
+        while nb > 0:
+            if cur is None:
+                cur = jnp.zeros(bits.shape, jnp.uint64)
+                rem = _LANE_BITS
+            take = min(rem, nb)
+            part = (bits >> (nb - take)) if nb > take else bits
+            if take < 64:
+                part = part & jnp.uint64((1 << take) - 1)
+            cur = (cur << take) | part
+            rem -= take
+            nb -= take
+            if rem == 0:
+                lanes.append(cur)
+                cur = None
+    if cur is not None:
+        lanes.append(cur << rem)  # left-align the tail lane
+    return [ln.astype(jnp.int64) for ln in lanes]
+
+
+def sort_permutation(
+    fields: Sequence[Field], n: int, extra_payload: Sequence[jnp.ndarray] = ()
+):
+    """Sort rows by ``fields`` (lexicographic, ascending, deterministic:
+    ties broken by row index) and return ``(sorted_lanes, perm)`` where
+    ``perm`` is the permutation (int32) and ``sorted_lanes`` are the
+    packed key lanes in sorted order (WITHOUT the index field).
+
+    ``extra_payload`` lanes ride the sort unmodified (for callers whose
+    payload is cheaper to move than to gather).
+
+    Also returns ``first_bit``: the sorted first field's bit per row
+    (the ``~sel`` lane when fields came from :func:`key_fields`) — free
+    to read from the packed lane, where a ``sel[perm]`` gather would
+    cost as much as the sort itself.
+    """
+    idx_bits = max(1, (n - 1).bit_length())
+    iota = jax.lax.iota(jnp.uint32, n)
+    base = sum(f.nbits for f in fields)
+    all_fields = list(fields)
+    if base + idx_bits > _LANE32_BITS:
+        # keep the index field inside ONE 63-bit lane: a straddling index
+        # could not be extracted (or cleared) with simple shifts
+        rem = base % _LANE_BITS
+        if rem + idx_bits > _LANE_BITS:
+            filler = _LANE_BITS - rem
+            all_fields.append(Field(jnp.zeros(n, jnp.uint32), filler))
+            base += filler
+    all_fields.append(Field(iota, idx_bits))
+    total = base + idx_bits
+    lanes = pack(all_fields)
+    if total <= _LANE32_BITS:
+        tail_pad = 0
+    else:
+        rem = total % _LANE_BITS
+        tail_pad = 0 if rem == 0 else _LANE_BITS - rem
+    out = jax.lax.sort(
+        tuple(lanes) + tuple(extra_payload),
+        num_keys=len(lanes),
+        is_stable=False,
+    )
+    s_lanes = list(out[: len(lanes)])
+    last = s_lanes[-1]
+    if last.dtype == jnp.int32:
+        perm = (last.astype(jnp.uint32) & jnp.uint32((1 << idx_bits) - 1)).astype(
+            jnp.int32
+        )
+        cleared = last & jnp.int32(~((1 << idx_bits) - 1))
+        top = total - 1
+        first_bit = ((s_lanes[0] >> top) & 1).astype(jnp.bool_)
+    else:
+        u = last.astype(jnp.uint64) >> jnp.uint64(tail_pad)
+        perm = (u & jnp.uint64((1 << idx_bits) - 1)).astype(jnp.int32)
+        cleared = last & jnp.int64(~(((1 << idx_bits) - 1) << tail_pad))
+        first_bit = ((s_lanes[0] >> (_LANE_BITS - 1)) & 1).astype(jnp.bool_)
+    # returned key lanes have the index-tiebreak bits zeroed, so equality
+    # between adjacent sorted rows means "all key fields equal"
+    s_lanes[-1] = cleared
+    return s_lanes, perm, list(out[len(lanes):]), first_bit
+
+
+def key_fields(
+    keys: Sequence[tuple[jnp.ndarray, jnp.ndarray | None]],
+    sel: jnp.ndarray | None,
+) -> tuple[list[Field], list[jnp.ndarray]]:
+    """Standard grouping-key field list: selection first (selected rows
+    sort to the front), then per key (null-first bit, value bits); wide
+    DECIMAL (n,2) keys contribute 128 value bits.  Mirrors the operand
+    discipline of the old ``_sortable_keys`` with ~6x fewer sort lanes.
+
+    Returns ``(fields, native_lanes)``: float columns cannot be packed
+    (no f64 bitcast under TPU x64 rewriting) and come back as separate
+    native sort operands (null-masked to 0)."""
+    # the field ORDER and widths are owned by KeyPlan (single layout
+    # authority): building from fields_meta keeps pack()'s lane layout and
+    # KeyPlan.segments in agreement by construction
+    plan = KeyPlan(keys, sel_present=sel is not None)
+    return plan.build_fields(keys, sel)
+
+
+class KeyPlan:
+    """Static packing plan for a grouping-key tuple: remembers which bits
+    of which lane hold each field, so group-key values can be recovered
+    from packed lanes gathered at G segment-start positions (G-sized
+    bit ops instead of full-length payload gathers)."""
+
+    def __init__(self, keys, sel_present: bool):
+        self.sel_present = sel_present
+        self.fields_meta: list = []  # ('sel',)|('valid',ki)|('data',ki,lane,nbits,dtype)
+        widths: list[int] = []
+        if sel_present:
+            self.fields_meta.append(("sel",))
+            widths.append(1)
+        for ki, (data, valid) in enumerate(keys):
+            if valid is not None:
+                self.fields_meta.append(("valid", ki))
+                widths.append(1)
+            if getattr(data, "ndim", 1) == 2:
+                for lane in range(2):
+                    self.fields_meta.append(("data", ki, lane, 64, data.dtype))
+                    widths.append(64)
+            elif np.issubdtype(np.dtype(data.dtype), np.floating):
+                self.fields_meta.append(("native", ki))
+                widths.append(0)  # separate operand, no bits
+            else:
+                w = 1 if data.dtype == jnp.bool_ else np.dtype(data.dtype).itemsize * 8
+                self.fields_meta.append(("data", ki, 0, w, data.dtype))
+                widths.append(w)
+        total = sum(widths)
+        self.lane32 = total <= _LANE32_BITS
+        lane_bits = _LANE32_BITS if self.lane32 else _LANE_BITS
+        # bit positions (MSB-first walk, matching pack())
+        self.segments: list[list[tuple[int, int, int]]] = []  # per field: (lane, shift, nbits)
+        pos = 0
+        for w in widths:
+            segs = []
+            rem = w
+            while rem > 0:
+                lane = pos // lane_bits
+                used = pos % lane_bits
+                avail = lane_bits - used
+                take = min(avail, rem)
+                segs.append((lane, used, take))
+                pos += take
+                rem -= take
+            self.segments.append(segs)
+        self.num_lanes = (pos + lane_bits - 1) // lane_bits if pos else (1 if total else 0)
+        self.lane_bits = lane_bits
+        self.total_bits = pos
+        # int32 single lane is RIGHT-aligned (pack() shifts as it fills);
+        # int64 lanes are full except the LAST, which is LEFT-aligned
+        self.tail_pad = 0 if self.lane32 else (lane_bits - (pos % lane_bits)) % lane_bits
+
+    def build_fields(self, keys, sel):
+        """Materialize the Field list (and native float lanes) in the
+        exact order recorded by ``fields_meta`` — the one walk that both
+        ``pack()`` and ``segments`` describe."""
+        fields: list[Field] = []
+        native: list[jnp.ndarray] = []
+        for m in self.fields_meta:
+            if m[0] == "sel":
+                fields.append(bool_field(~sel))
+            elif m[0] == "valid":
+                fields.append(bool_field(~keys[m[1]][1]))
+            elif m[0] == "native":
+                data, valid = keys[m[1]]
+                native.append(
+                    data if valid is None
+                    else jnp.where(valid, data, jnp.zeros_like(data))
+                )
+            else:
+                _, ki, lane, nbits, _dt = m
+                data, valid = keys[ki]
+                col = data[:, lane] if getattr(data, "ndim", 1) == 2 else data
+                f = int_field(col)
+                fields.append(f if valid is None else masked(f, valid))
+        return fields, native
+
+    def extract(self, lanes: Sequence[jnp.ndarray], field_idx: int) -> jnp.ndarray:
+        """Recover a field's unsigned bits from packed lanes (any shape)."""
+        segs = self.segments[field_idx]
+        total_bits = sum(s[2] for s in segs)
+        out = None
+        for lane, used, take in segs:
+            ln = lanes[lane]
+            if self.lane32:
+                u = ln.astype(jnp.uint32)
+                # right-aligned single lane: field offset counts from the
+                # top of the CONTENT (total_bits), not the lane width
+                shift = self.total_bits - used - take
+            else:
+                # the last lane is left-aligned (pack() shifts its tail up),
+                # which exactly cancels the missing fill: the piece sits at
+                # lane_bits - used - take in EVERY lane
+                u = ln.astype(jnp.uint64)
+                shift = self.lane_bits - used - take
+            piece = (u >> shift) & ((1 << take) - 1)
+            if total_bits > 31:
+                piece = piece.astype(jnp.uint64)
+            out = piece if out is None else ((out << take) | piece)
+        return out
+
+    def field_index(self, kind, ki=None):
+        for i, m in enumerate(self.fields_meta):
+            if m[0] == kind and (ki is None or (len(m) > 1 and m[1] == ki)):
+                return i
+        return None
+
+    def sel_bit(self, lane0: jnp.ndarray) -> jnp.ndarray:
+        """True where the row is SELECTED (the packed field is ~sel)."""
+        bit = self.extract(lanes=[lane0] + [lane0] * (self.num_lanes - 1), field_idx=0)
+        return bit == 0
+
+    def key_output(self, keys, lanes_at, native_at, ki: int):
+        """(data, valid) for key ki recovered at gathered positions."""
+        data, valid = keys[ki]
+        vi = self.field_index("valid", ki)
+        kv = None if vi is None else (self.extract(lanes_at, vi) == 0)
+        if getattr(data, "ndim", 1) == 2:
+            lanes2 = []
+            for lane in range(2):
+                fi = self._data_field(ki, lane)
+                bits = self.extract(lanes_at, fi).astype(jnp.uint64)
+                lanes2.append(
+                    (bits ^ jnp.uint64(1 << 63)).astype(jnp.int64)
+                )
+            return jnp.stack(lanes2, axis=1).astype(data.dtype), kv
+        if np.issubdtype(np.dtype(data.dtype), np.floating):
+            g = native_at[self._native_pos(ki)]
+            return g, kv
+        fi = self._data_field(ki, 0)
+        meta = self.fields_meta[fi]
+        nbits = meta[3]
+        bits = self.extract(lanes_at, fi)
+        if data.dtype == jnp.bool_:
+            return bits.astype(jnp.bool_), kv
+        if nbits == 64:
+            val = (bits.astype(jnp.uint64) ^ jnp.uint64(1 << 63)).astype(jnp.int64)
+        else:
+            val = bits.astype(jnp.int64) - (1 << (nbits - 1))
+        return val.astype(data.dtype), kv
+
+    def _data_field(self, ki, lane):
+        for i, m in enumerate(self.fields_meta):
+            if m[0] == "data" and m[1] == ki and m[2] == lane:
+                return i
+        raise KeyError((ki, lane))
+
+    def _native_pos(self, ki):
+        pos = 0
+        for m in self.fields_meta:
+            if m[0] == "native":
+                if m[1] == ki:
+                    return pos
+                pos += 1
+        raise KeyError(ki)
+
+
+def grouping_sort(
+    keys: Sequence[tuple[jnp.ndarray, jnp.ndarray | None]],
+    sel: jnp.ndarray | None,
+    n: int,
+):
+    """Sort rows so equal (sel, keys...) tuples are adjacent, selected
+    rows first.  Returns ``(eq_lanes, perm, s_sel)`` where adjacent-row
+    equality over ``eq_lanes`` means all keys equal and ``s_sel`` is the
+    sorted selection mask, read from the packed lane (no gather).  Float
+    keys ride as native operands (their position in the significance
+    order doesn't matter for grouping, only adjacency)."""
+    fields, native = key_fields(keys, sel)
+    if not native:
+        s_lanes, perm, _, first_bit = sort_permutation(fields, n)
+        return s_lanes, perm, ~first_bit
+    lanes = pack(fields) if fields else []
+    plan = KeyPlan(keys, sel_present=sel is not None)
+    iota = jax.lax.iota(jnp.int32, n)
+    ops = tuple(lanes) + tuple(native) + (iota,)
+    out = jax.lax.sort(ops, num_keys=len(ops), is_stable=False)
+    eq_lanes = list(out[: len(lanes) + len(native)])
+    s_sel = plan.sel_bit(eq_lanes[0])
+    return eq_lanes, out[-1], s_sel
+
+
+def compact_front_positions(flags: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Positions (ascending) of True ``flags`` compacted to the front —
+    one single-lane unstable sort of ``(~flag, index)`` packed together.
+    Rows beyond the True count hold junk positions."""
+    idx_bits = max(1, (n - 1).bit_length())
+    iota = jax.lax.iota(jnp.uint32, n)
+    if idx_bits + 1 <= _LANE32_BITS:
+        lane = ((~flags).astype(jnp.uint32) << idx_bits) | iota
+        s = jax.lax.sort((lane.astype(jnp.int32),), num_keys=1, is_stable=False)[0]
+        return (s.astype(jnp.uint32) & jnp.uint32((1 << idx_bits) - 1)).astype(
+            jnp.int32
+        )
+    lane = ((~flags).astype(jnp.uint64) << jnp.uint64(idx_bits)) | iota.astype(
+        jnp.uint64
+    )
+    s = jax.lax.sort((lane.astype(jnp.int64),), num_keys=1, is_stable=False)[0]
+    return (s.astype(jnp.uint64) & jnp.uint64((1 << idx_bits) - 1)).astype(jnp.int32)
+
+
+def inverse_permute_mask(perm: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Scatter-free inverse permutation of a bool mask: returns ``out``
+    with ``out[perm[i]] = mask[i]`` via one single-lane sort of
+    ``(perm << 1) | mask``."""
+    n = perm.shape[0]
+    if n < (1 << 30):
+        lane = (perm.astype(jnp.int32) << 1) | mask.astype(jnp.int32)
+        s = jax.lax.sort((lane,), num_keys=1, is_stable=False)[0]
+        return (s & 1).astype(jnp.bool_)
+    lane = (perm.astype(jnp.int64) << 1) | mask.astype(jnp.int64)
+    s = jax.lax.sort((lane,), num_keys=1, is_stable=False)[0]
+    return (s & 1).astype(jnp.bool_)

@@ -76,12 +76,54 @@ def sort_indices(
     sel: jnp.ndarray,
     ranks_per_key: Sequence[np.ndarray | None] | None = None,
 ) -> jnp.ndarray:
-    """Return permutation putting selected rows first in key order."""
+    """Return permutation putting selected rows first in key order
+    (deterministic: ties broken by original row index).
+
+    Integer/dictionary/bool keys are bit-packed into 1-2 sort lanes
+    (ops/keypack.py — XLA:TPU sort compile time is ~linear in operand
+    count); float keys stay native operands in their significance slot.
+    """
     n = sel.shape[0]
-    ops = [~sel]
+    ops: list = [~sel]
     for i, ((data, valid), k) in enumerate(zip(key_arrays, keys)):
         ranks = ranks_per_key[i] if ranks_per_key else None
         ops.extend(sortable_key(data, valid, k, ranks))
+    return packed_perm(ops, n)
+
+
+def packed_perm(oriented_ops: Sequence[jnp.ndarray], n: int) -> jnp.ndarray:
+    """Sort permutation over pre-oriented operand arrays (ascending
+    lexicographic, deterministic via row-index tiebreak), with runs of
+    bool/int operands bit-packed into minimal integer lanes and float
+    operands kept native in their significance slot."""
+    from trino_tpu.ops import keypack as KP
+
+    runs: list = []  # ('f', [Field...]) | ('n', lane) in significance order
+
+    def add_field(f):
+        if runs and runs[-1][0] == "f":
+            runs[-1][1].append(f)
+        else:
+            runs.append(("f", [f]))
+
+    for op in oriented_ops:
+        if np.issubdtype(np.dtype(op.dtype), np.floating):
+            runs.append(("n", op))
+        elif op.dtype == jnp.bool_:
+            add_field(KP.bool_field(op))
+        else:
+            add_field(KP.int_field(op))
+    if len(runs) == 1 and runs[0][0] == "f":
+        _, perm, _, _ = KP.sort_permutation(runs[0][1], n)
+        return perm
+    lanes: list = []
+    for kind, payload in runs:
+        if kind == "f":
+            lanes.extend(KP.pack(payload))
+        else:
+            lanes.append(payload)
     idx = jnp.arange(n, dtype=jnp.int32)
-    out = jax.lax.sort(tuple(ops) + (idx,), num_keys=len(ops), is_stable=True)
+    out = jax.lax.sort(
+        tuple(lanes) + (idx,), num_keys=len(lanes) + 1, is_stable=False
+    )
     return out[-1]

@@ -108,6 +108,8 @@ def compute_windows(
     spec. Returns a list of (data, valid) pairs aligned to ORIGINAL row
     positions (garbage at unselected rows — caller keeps its sel mask).
     """
+    from trino_tpu.ops.sort import packed_perm
+
     n = sel.shape[0]
     ops: list[jnp.ndarray] = [~sel]
     for i, (data, valid) in enumerate(partition_keys):
@@ -115,8 +117,7 @@ def compute_windows(
     for i, ((data, valid), sk) in enumerate(zip(order_keys, order_specs)):
         ops.extend(sortable_key(data, valid, sk, order_ranks[i]))
     idx = jnp.arange(n, dtype=jnp.int32)
-    sorted_ops = jax.lax.sort(tuple(ops) + (idx,), num_keys=len(ops), is_stable=True)
-    perm = sorted_ops[-1]
+    perm = packed_perm(ops, n)
     s_sel = sel[perm]
 
     # partition boundaries (NULLs equal inside a partition key)
@@ -192,7 +193,8 @@ def compute_windows(
             posc = jnp.clip(pos, 0, n - 1)
             out = (sd[posc], sv[posc] & visible)
         elif fn.kind == "dense_rank":
-            c = jnp.cumsum(peer_start.astype(jnp.int64))
+            from trino_tpu.ops.aggregation import _prefix_sum
+            c = _prefix_sum(peer_start.astype(jnp.int32)).astype(jnp.int64)
             c_at_seg = jax.lax.associative_scan(
                 jnp.maximum, jnp.where(seg_start, c, 0)
             )

@@ -64,9 +64,17 @@ def hash_repartition(
         local_n = khash.shape[0]
         dest = (khash % n).astype(jnp.int32)
         dest = jnp.where(s, dest, n)  # dead rows -> virtual dest n (dropped)
-        # stable sort rows by destination
-        order = jnp.argsort(dest, stable=True)
-        d_sorted = dest[order]
+        # stable sort rows by destination: dest and row index packed into
+        # ONE int32 lane (dest <= n fits above the index bits), so the
+        # unstable single-operand sort is deterministic — is_stable or a
+        # second operand would double XLA:TPU's sort compile time
+        idx_bits = max(1, (local_n - 1).bit_length())
+        wide = idx_bits + (n + 1).bit_length() > 31
+        lt = jnp.int64 if wide else jnp.int32
+        lane = (dest.astype(lt) << idx_bits) | jnp.arange(local_n, dtype=lt)
+        s_lane = jax.lax.sort((lane,), num_keys=1, is_stable=False)[0]
+        order = (s_lane & ((1 << idx_bits) - 1)).astype(jnp.int32)
+        d_sorted = (s_lane >> idx_bits).astype(jnp.int32)
         # position of each row within its destination run
         counts = jnp.bincount(d_sorted, length=n + 1)
         starts = jnp.cumsum(counts) - counts
