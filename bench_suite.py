@@ -12,15 +12,23 @@ Reference harness shape:
 ``testing/trino-benchto-benchmarks/src/main/resources/benchmarks/presto/
 tpch.yaml`` (6 runs, prewarm) — here: one warm run then median of 3.
 
+HANG-PROOFING: ``run_suite`` executes every measurement in its OWN
+subprocess with a hard timeout — one pathological XLA compile cannot
+wedge the chip for the rest of the suite (a SIGTERM'd compile leaves a
+native thread holding the TPU, so the poisoned child is SIGKILLed and
+the next child gets a fresh client). A timed-out entry reports
+``{"timeout": <seconds>}`` instead of wedging.
+
 Run directly for a readable report, or let bench.py embed the dict in
-its one-line JSON. Each timing is a median; rerunning should stay within
-~20% (the compile caches make the warm path deterministic up to device
-timing noise).
+its one-line JSON.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 
@@ -48,24 +56,20 @@ def tpch_sf1(queries=(1, 3, 5, 10)) -> dict:
     return out
 
 
-def tpcds_baseline() -> dict:
-    """Config 3: the full Q64 and Q95 texts (trino_tpu.benchmarks.tpcds)."""
+def tpcds_q(qnum: int) -> dict:
+    """Config 3: one TPC-DS query by number (full corpus text)."""
     from trino_tpu.benchmarks.tpcds import queries as corpus
     from trino_tpu.testing import LocalQueryRunner
 
     runner = LocalQueryRunner()
     runner.session.set("execution_mode", "distributed")
     texts = corpus("tpcds.tiny")
-    return {
-        "q64_s": round(_median_time(runner, texts[64]), 3),
-        "q95_s": round(_median_time(runner, texts[95]), 3),
-    }
+    return {f"q{qnum}_s": round(_median_time(runner, texts[qnum]), 3)}
 
 
 def columnar_scan_rates(sf: float = 0.1) -> dict:
     """Write dbgen lineitem once as parquet and ORC, then measure the
     engine's scan+decode rate over the files (config 5 shape)."""
-    import os
     import tempfile
 
     from trino_tpu.testing import LocalQueryRunner
@@ -117,12 +121,52 @@ def columnar_scan_rates(sf: float = 0.1) -> dict:
     return out
 
 
+def _subprocess_entry(call: str, timeout_s: int) -> dict:
+    """Run ``bench_suite.<call>`` in a fresh python, hard-killed on
+    timeout (a cancelled XLA compile holds the chip: the child must DIE,
+    not linger)."""
+    code = (
+        "import json, sys; sys.path.insert(0, %r); "
+        "import bench_suite as B; print('@@'+json.dumps(B.%s))"
+        % (os.path.dirname(os.path.abspath(__file__)), call)
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except subprocess.TimeoutExpired:
+        return {"timeout": timeout_s}
+    for line in proc.stdout.splitlines():
+        if line.startswith("@@"):
+            return json.loads(line[2:])
+    tail = (proc.stderr or "").strip().splitlines()
+    return {"error": tail[-1][:200] if tail else f"exit {proc.returncode}"}
+
+
 def run_suite() -> dict:
-    suite = {}
+    suite: dict = {}
     t0 = time.time()
-    suite["tpch_sf1"] = tpch_sf1()
-    suite["tpcds"] = tpcds_baseline()
-    suite["columnar"] = columnar_scan_rates()
+    tpch: dict = {}
+    for q in (1, 3, 5, 10):
+        r = _subprocess_entry(f"tpch_sf1(queries=({q},))", 420)
+        if "timeout" in r or "error" in r:
+            tpch[f"q{q:02d}_s"] = r  # explicit per-query failure marker
+        else:
+            tpch.update(r)
+    suite["tpch_sf1"] = tpch
+    ds: dict = {}
+    for q in (95, 64):
+        r = _subprocess_entry(f"tpcds_q({q})", 420)
+        if "timeout" in r or "error" in r:
+            ds[f"q{q}_s"] = r
+        else:
+            ds.update(r)
+    suite["tpcds"] = ds
+    suite["columnar"] = _subprocess_entry("columnar_scan_rates()", 420)
     suite["suite_wall_s"] = round(time.time() - t0, 1)
     return suite
 

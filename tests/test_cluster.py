@@ -365,8 +365,13 @@ class TestSchedulerPolicies:
         ids = [n.node_id for n in picks]
         # b absorbs the imbalance: 3 of 4 new tasks land there
         assert ids.count("b") == 3 and ids.count("a") == 1
+        # selection IS reservation: the 4 picks are already counted, so a
+        # concurrent select sees them (no dog-piling between fragments)
+        assert ns._assigned["a"] == 4 and ns._assigned["b"] == 3
+        picks2 = ns.select([a, b], 1)
+        assert picks2[0].node_id == "b"
         ns.release(a)
-        assert ns._assigned["a"] == 2
+        assert ns._assigned["a"] == 3
 
     def test_phased_order_builds_before_probes(self):
         """PhasedExecutionSchedule analog: among one join's feeding

@@ -132,12 +132,45 @@ class FileConnector(Connector):
             )
 
     def insert(self, schema, table, batch: Batch) -> int:
+        return self.insert_part(schema, table, batch)[0]
+
+    def insert_part(self, schema, table, batch: Batch) -> tuple[int, str]:
+        """Insert returning (rows, part-file name) so scaled-writer
+        coordinators can roll back committed parts when a sibling writer
+        fails (reference: TableWriterOperator fragment IDs +
+        TableFinishOperator commit)."""
         ts = self.get_table(schema, table)
         if ts is None:
             raise KeyError(f"table not found: {schema}.{table}")
-        return self._write_part_into(self._table_dir(schema, table), ts, batch)
+        d = self._table_dir(schema, table)
+        rows, part = self._write_part_into(d, ts, batch)
+        return rows, part
 
-    def _write_part_into(self, d: str, ts: TableSchema, batch: Batch) -> int:
+    def delete_parts(self, schema, table, parts) -> None:
+        """Best-effort removal of named part files (+ their stats
+        entries) — the scaled-INSERT abort path."""
+        d = self._table_dir(schema, table)
+        for part in parts:
+            if not part:
+                continue
+            try:
+                os.remove(os.path.join(d, part))
+            except OSError:
+                pass
+        stats_path = os.path.join(d, _STATS_FILE)
+        try:
+            with open(stats_path) as f:
+                all_stats = json.load(f)
+            for part in parts:
+                all_stats.pop(part, None)
+            tmp = f"{stats_path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(all_stats, f)
+            os.replace(tmp, stats_path)
+        except OSError:
+            pass
+
+    def _write_part_into(self, d: str, ts: TableSchema, batch: Batch) -> tuple[int, str]:
         """Write one part file + stats into an explicit directory (used by
         both the live-table insert path and replace_data staging)."""
         import uuid
@@ -167,7 +200,7 @@ class FileConnector(Connector):
         with open(tmp, "w") as f:  # atomic swap: a crash never truncates
             json.dump(all_stats, f)
         os.replace(tmp, stats_path)
-        return compacted.num_rows
+        return compacted.num_rows, part
 
     def truncate(self, schema, table):
         d = self._table_dir(schema, table)

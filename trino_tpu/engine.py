@@ -518,7 +518,10 @@ class Engine:
             _slice_rows(batch, lo, min(lo + rows_per, batch.num_rows))
             for lo in range(0, batch.num_rows, rows_per)
         ]
-        total = conn.insert(schema, table, chunks[0])  # schema anchor
+        if hasattr(conn, "insert_part"):
+            total, anchor_part = conn.insert_part(schema, table, chunks[0])
+        else:
+            total, anchor_part = conn.insert(schema, table, chunks[0]), ""
         import threading
         import urllib.parse
         import urllib.request
@@ -528,6 +531,7 @@ class Engine:
         )
         errors: list[Exception] = []
         counts: list[int] = []
+        parts: list[str] = [anchor_part]
 
         def write(node, chunk):
             try:
@@ -543,7 +547,10 @@ class Engine:
                     headers=auth.headers(),
                 )
                 with urllib.request.urlopen(req, timeout=300) as r:
-                    counts.append(_json.loads(r.read().decode())["rows"])
+                    reply = _json.loads(r.read().decode())
+                    counts.append(reply["rows"])
+                    if reply.get("part"):
+                        parts.append(reply["part"])
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
 
@@ -555,14 +562,27 @@ class Engine:
             t.start()
         for t in threads:
             t.join(timeout=600)
+        for node in placements:
+            self.cluster_scheduler.node_scheduler.release(node)
+
+        def abort(msg):
+            # a failed scaled INSERT must not leave partial rows behind
+            # (a retry would duplicate them): best-effort delete of every
+            # part the successful writers committed — shared storage, so
+            # the coordinator's connector can remove them directly
+            if hasattr(conn, "delete_parts"):
+                try:
+                    conn.delete_parts(schema, table, parts)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise ExecutionError(msg)
+
         if any(t.is_alive() for t in threads):
-            raise ExecutionError(
-                "scaled write failed: a writer task did not complete"
-            )
+            abort("scaled write failed: a writer task did not complete")
         if errors:
-            raise ExecutionError(f"scaled write failed: {errors[0]}")
+            abort(f"scaled write failed: {errors[0]}")
         if len(counts) != len(threads):
-            raise ExecutionError(
+            abort(
                 f"scaled write failed: {len(threads) - len(counts)} writer "
                 f"tasks reported no row count"
             )

@@ -1083,9 +1083,21 @@ class LocalExecutor:
             for j in range(n_fns):
                 col = sub_out.batch.columns[base_width + j]
                 data, valid = col.to_numpy()
+                if data.ndim != 1:
+                    # 2-D (wide DECIMAL) outputs can't scatter into the
+                    # 1-D merge buffer: recompute without spilling
+                    return self._window_result(node, res)
                 if out_data[j] is None:
                     out_data[j] = np.zeros(b.capacity, dtype=data.dtype)
                     out_cols_proto[j] = col
+                elif (
+                    col.dictionary is not out_cols_proto[j].dictionary
+                    or data.dtype != out_data[j].dtype
+                ):
+                    # a partition-local dictionary (or dtype drift) would
+                    # decode wrong strings through the shared buffer:
+                    # fall back to the unspilled path
+                    return self._window_result(node, res)
                 out_data[j][rows] = data
                 out_valid[j][rows] = valid
         cols = list(b.columns)

@@ -203,15 +203,23 @@ class ClusterMemoryManager:
         used = sum(totals.values())
         if used <= self.limit or not totals:
             return None
-        victim = max(totals, key=lambda q: totals[q])
-        message = (
-            f"Query killed by the cluster memory manager: cluster memory "
-            f"used {used} bytes exceeds the limit {self.limit} bytes "
-            f"(this query reserved {totals[victim]} across the cluster)"
-        )
-        if self.kill_fn(victim, message):
-            self.kills.append(victim)
-            return victim
+        # walk candidates in descending reservation order until one kill
+        # lands: the largest query may have already finished while its
+        # reservations were still being reported by worker announces
+        # (reference: TotalReservationLowMemoryKiller skips completed
+        # queries and keeps looking for a live victim)
+        for victim in sorted(totals, key=lambda q: totals[q], reverse=True):
+            if victim in self.kills:
+                continue
+            message = (
+                f"Query killed by the cluster memory manager: cluster "
+                f"memory used {used} bytes exceeds the limit {self.limit} "
+                f"bytes (this query reserved {totals[victim]} across the "
+                f"cluster)"
+            )
+            if self.kill_fn(victim, message):
+                self.kills.append(victim)
+                return victim
         return None
 
     def info(self) -> dict:

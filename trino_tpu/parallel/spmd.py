@@ -268,6 +268,14 @@ class SpmdRunner:
             with self._cond:
                 self._done_seq = max(self._done_seq, seq - 1)
                 self._cond.notify_all()
+        # TOCTOU re-check: while this commit was blocked in _await_turn a
+        # SUCCESSOR's timeout may have advanced done_seq past this slot
+        # (declared it abandoned). Launching now would execute jitted
+        # programs out of launch order across processes — the aligned-
+        # stream invariant multi-host XLA collectives depend on.
+        with self._cond:
+            if self._done_seq >= seq:
+                return {"error": f"seq {seq} slot already passed"}
         try:
             if not go:
                 return {"skipped": True, "seq": seq}

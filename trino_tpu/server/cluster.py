@@ -128,13 +128,23 @@ class NodeScheduler:
 
     def select(self, nodes: list["WorkerNode"], count: int) -> list["WorkerNode"]:
         """Pick ``count`` placements (repeats allowed when count > nodes),
-        each time choosing the node with the fewest in-flight tasks."""
+        each time choosing the node with the fewest in-flight tasks.
+
+        Selection IS reservation: ``_assigned`` is bumped here, under the
+        same lock, so two fragments scheduling concurrently see each
+        other's placements instead of both dog-piling the least-loaded
+        node. Callers release via :meth:`release` when the task finishes
+        (or fails to start)."""
         out: list[WorkerNode] = []
         with self._lock:
-            load = {n.node_id: self._assigned.get(n.node_id, 0) for n in nodes}
             for _ in range(count):
-                best = min(nodes, key=lambda n: (load[n.node_id], n.node_id))
-                load[best.node_id] += 1
+                best = min(
+                    nodes,
+                    key=lambda n: (self._assigned.get(n.node_id, 0), n.node_id),
+                )
+                self._assigned[best.node_id] = (
+                    self._assigned.get(best.node_id, 0) + 1
+                )
                 out.append(best)
         return out
 
@@ -419,16 +429,17 @@ class ClusterScheduler:
                 task = HttpRemoteTask(
                     placements[p], f"{query_id}.{frag.id}.{p}", payload
                 )
-                task.start()  # acquire only after a successful start
-                self.node_scheduler.acquire(placements[p])
+                task.start()  # select() already reserved the slot
                 tasks.append(task)
         except Exception:
             # a mid-fragment failure leaves these tasks outside
             # remote_tasks, so the query-level release never sees them:
-            # cancel + release here to keep the load counters honest
+            # cancel started tasks and release EVERY reserved placement
+            # (started or not) to keep the load counters honest
             for t in tasks:
                 t.cancel()
-                self.node_scheduler.release(t.node)
+            for node in placements:
+                self.node_scheduler.release(node)
             raise
         return tasks
 

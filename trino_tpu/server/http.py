@@ -380,8 +380,16 @@ def _make_handler(server: TrinoTpuServer):
 
                     batch = deserialize_batch(payload)
                     conn = server.engine.catalogs.get(q["catalog"][0])
-                    n = conn.insert(q["schema"][0], q["table"][0], batch)
-                    return self._send_json({"rows": n})
+                    part = ""
+                    if hasattr(conn, "insert_part"):
+                        n, part = conn.insert_part(
+                            q["schema"][0], q["table"][0], batch
+                        )
+                    else:
+                        n = conn.insert(q["schema"][0], q["table"][0], batch)
+                    # part name lets the coordinator roll back committed
+                    # parts when a sibling scaled writer fails
+                    return self._send_json({"rows": n, "part": part})
                 except Exception as e:  # noqa: BLE001
                     return self._error(400, f"write failed: {e}")
             if path == "/v1/spmd":
