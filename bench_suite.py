@@ -152,7 +152,7 @@ def run_suite() -> dict:
     t0 = time.time()
     tpch: dict = {}
     for q in (1, 3, 5, 10):
-        r = _subprocess_entry(f"tpch_sf1(queries=({q},))", 420)
+        r = _subprocess_entry(f"tpch_sf1(queries=({q},))", 600)
         if "timeout" in r or "error" in r:
             tpch[f"q{q:02d}_s"] = r  # explicit per-query failure marker
         else:
