@@ -78,7 +78,9 @@ class StatementClient:
         req = urllib.request.Request(uri, data=body, method=method)
         for k, v in self._headers().items():
             req.add_header(k, v)
-        with urllib.request.urlopen(req, timeout=120) as resp:
+        with urllib.request.urlopen(
+            req, timeout=self.session.request_timeout
+        ) as resp:
             set_session = resp.headers.get(f"{HEADER}-Set-Session")
             if set_session and "=" in set_session:
                 k, v = set_session.split("=", 1)
@@ -165,6 +167,9 @@ class ClientSession:
     prepared_statements: dict[str, str] = dataclasses.field(default_factory=dict)
     # explicit transaction id (X-Trino-Transaction-Id roundtrip)
     transaction_id: Optional[str] = None
+    # per-request socket timeout (seconds) for the statement protocol
+    # (OkHttp client timeout analog; chaos tests shrink it)
+    request_timeout: float = 120.0
 
 
 class Connection:

@@ -89,6 +89,30 @@ class Session:
         # distributed mode: compile each plan fragment into one SPMD
         # program (exec/fragments.py); off -> materialized interpreter
         ("fragment_execution", True),
+        # --- fault tolerance (trino_tpu/ft/) ------------------------------
+        # NONE | TASK | QUERY (reference: io.trino.execution.RetryPolicy).
+        # TASK re-dispatches a failed fragment attempt to another worker
+        # over retained (materialized) exchange output; QUERY re-runs the
+        # whole statement on a fresh attempt id.
+        ("retry_policy", "NONE"),
+        ("task_retry_attempts", 4),  # total attempts per task (incl. first)
+        ("query_retry_attempts", 3),  # total attempts per query (incl. first)
+        ("retry_initial_delay_ms", 100),
+        ("retry_max_delay_ms", 2000),
+        # deterministic fault injection (chaos testing; ft/injection.py):
+        # all probabilities zero -> injection fully disabled
+        ("fault_injection_seed", 0),
+        ("fault_task_crash_p", 0.0),
+        ("fault_http_drop_p", 0.0),
+        ("fault_http_delay_ms", 0),
+        # --- internal HTTP tuning (chaos tests shrink these) --------------
+        ("http_request_timeout_s", 30.0),  # task POST/GET/DELETE calls
+        ("http_retry_attempts", 3),  # transient-error retries per request
+        ("exchange_timeout_s", 300.0),  # total page-exchange read budget
+        ("exchange_poll_s", 15.0),  # server-side long-poll hold per GET
+        # per-task output buffer cap; TASK retry retains delivered pages
+        # (materialized exchange), so give it headroom
+        ("exchange_buffer_bytes", 64 << 20),
     )
 
     def get(self, name: str) -> Any:

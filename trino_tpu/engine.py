@@ -40,6 +40,9 @@ class StatementResult:
     # transaction mutations (X-Trino-Started-Transaction-Id / Clear-...)
     started_transaction_id: Optional[str] = None
     cleared_transaction: bool = False
+    # cluster-mode retry/attempt counters (trino_tpu/ft): retry_policy,
+    # task_retries, task_attempts, query_attempts — surfaced in /v1/query
+    cluster_stats: dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 class Engine:
@@ -332,11 +335,17 @@ class Engine:
                     )
                 except SpmdUnsupported:
                     batch = None  # non-fusable: per-task scheduling below
+            cluster_stats: dict[str, Any] = {}
             if batch is None and self.cluster_scheduler is not None:
-                batch, names = self.cluster_scheduler.execute(plan, session)
+                batch, names = self.cluster_scheduler.execute(
+                    plan, session, stats_sink=cluster_stats
+                )
             if batch is not None:
                 return StatementResult(
-                    batch.to_pylist(), names, [c.type for c in batch.columns]
+                    batch.to_pylist(),
+                    names,
+                    [c.type for c in batch.columns],
+                    cluster_stats=cluster_stats,
                 )
         ctx = QueryMemoryContext(
             self.memory_pool,

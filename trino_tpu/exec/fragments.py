@@ -53,6 +53,41 @@ class FusedUnsupported(Exception):
     """Raised during tracing when a shape turns out not to be fusable."""
 
 
+class CapacityRetryExceeded(ExecutionError):
+    """Capacity-overflow retry budget exhausted.
+
+    Carries the failing fragment, the final (grown) capacities, and the
+    attempt count so operators see *where* growth diverged instead of a
+    bare message. ``retryable=False``: capacity growth is a pure function
+    of the data, so re-running on another worker (TASK retry) or from
+    scratch (QUERY retry) replays the same growth path — the new retry
+    policies treat this as fatal.
+    """
+
+    retryable = False
+
+    def __init__(
+        self,
+        site: str,
+        fragment_id=None,
+        capacities: Optional[dict] = None,
+        attempts: int = 0,
+    ):
+        self.site = site
+        self.fragment_id = fragment_id
+        self.capacities = dict(capacities or {})
+        self.attempts = attempts
+        caps_text = (
+            ", ".join(f"{k}={v}" for k, v in sorted(self.capacities.items()))
+            or "none recorded"
+        )
+        super().__init__(
+            f"{site} capacity retry limit exceeded"
+            f" (fragment={fragment_id if fragment_id is not None else '?'},"
+            f" attempts={attempts}, final capacities: {caps_text})"
+        )
+
+
 # --- fusability -------------------------------------------------------------
 
 _FUSABLE_NODES = (
@@ -226,6 +261,11 @@ class FragmentedExecutor(DistributedExecutor):
     def __init__(self, *args, programs: Optional[dict] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.programs: dict = {} if programs is None else programs
+        # chaos hook (trino_tpu/ft): per-fragment crash injection. None
+        # unless the session configures fault probabilities.
+        from trino_tpu.ft.injection import FaultInjector
+
+        self.fault_injector = FaultInjector.from_session(self.session)
 
     def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
         # reuse the fragmented plan across executions of a cached plan:
@@ -246,6 +286,22 @@ class FragmentedExecutor(DistributedExecutor):
             # formatting over unique values) — interpret instead
             return super().execute(node)
 
+    def _all_capacities(self) -> dict:
+        """Flattened view of every grown capacity in the program store,
+        for CapacityRetryExceeded diagnostics."""
+        out: dict[str, int] = {}
+        for key, val in self.programs.items():
+            if (
+                isinstance(key, tuple)
+                and key
+                and key[0] == "caps"
+                and isinstance(val, _Caps)
+            ):
+                scope = ".".join(str(k) for k in key[1:])
+                for nm, v in val.vals.items():
+                    out[f"{scope}:{nm}"] = v
+        return out
+
     # === fragment scheduling ============================================
 
     def _execute_fragments(self, sub: SubPlan) -> tuple[Batch, list[str]]:
@@ -255,6 +311,14 @@ class FragmentedExecutor(DistributedExecutor):
         def run(sp: SubPlan):
             for child in sp.children:
                 run(child)
+            if self.fault_injector is not None:
+                # fragment-level injection site: deterministic per
+                # (seed, fragment id); in a worker's fused path the
+                # crash surfaces as a task failure (fused_strict) or a
+                # visible interpreter fallback
+                self.fault_injector.maybe_crash_task(
+                    f"frag:{sp.fragment.id}"
+                )
             results[sp.fragment.id] = self._run_fragment(
                 sp.fragment, results, names_holder
             )
@@ -268,7 +332,12 @@ class FragmentedExecutor(DistributedExecutor):
         while True:
             attempts += 1
             if attempts > 12:
-                raise ExecutionError("capacity retry limit exceeded")
+                raise CapacityRetryExceeded(
+                    "fragmented-query",
+                    fragment_id=sub.fragment.id,
+                    capacities=self._all_capacities(),
+                    attempts=attempts - 1,
+                )
             self.deferred_flags = []
             results.clear()
             names_holder.clear()
@@ -455,7 +524,12 @@ class FragmentedExecutor(DistributedExecutor):
         while True:
             attempts += 1
             if attempts > 12:
-                raise ExecutionError("streaming capacity retry limit exceeded")
+                raise CapacityRetryExceeded(
+                    "streaming",
+                    fragment_id=frag.id,
+                    capacities=caps.vals,
+                    attempts=attempts - 1,
+                )
             try:
                 res = StreamingAggregator(
                     self, frag, agg, scan, caps,
@@ -526,7 +600,18 @@ class FragmentedExecutor(DistributedExecutor):
         while True:
             attempts += 1
             if attempts > 12:
-                raise ExecutionError("capacity retry limit exceeded")
+                raise CapacityRetryExceeded(
+                    "traced-program",
+                    fragment_id=(
+                        # keys are ("frag", frag.id, ...) / ("post", frag.id)
+                        program_key[1]
+                        if isinstance(program_key, tuple)
+                        and len(program_key) >= 2
+                        else None
+                    ),
+                    capacities=caps.vals,
+                    attempts=attempts - 1,
+                )
             if cached is not None:
                 jf, meta = cached
                 cached = None  # one shot: an overflow rebuilds below

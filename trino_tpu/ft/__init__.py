@@ -1,0 +1,34 @@
+"""Fault-tolerant execution: deterministic fault injection + retry policies.
+
+Reference: Trino's later fault-tolerant execution ("Tardigrade",
+``core/trino-main/.../execution/scheduler/faulttolerant/``) — retry
+policies NONE / TASK / QUERY over materialized (spooled) exchanges — and
+the chaos-style ``FailureInjector`` used by its test harness
+(``io.trino.execution.FailureInjector``). v356 itself has no mid-query
+retry; this subsystem is the cluster-level robustness layer the ROADMAP's
+preemptible-slice north star requires.
+"""
+
+from trino_tpu.ft.injection import (
+    FaultInjector,
+    InjectedFault,
+    injection_properties,
+)
+from trino_tpu.ft.retry import (
+    Backoff,
+    RetryPolicy,
+    TaskFailure,
+    TaskRetriesExhausted,
+    is_retryable,
+)
+
+__all__ = [
+    "Backoff",
+    "FaultInjector",
+    "InjectedFault",
+    "RetryPolicy",
+    "TaskFailure",
+    "TaskRetriesExhausted",
+    "injection_properties",
+    "is_retryable",
+]

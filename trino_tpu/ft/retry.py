@@ -1,0 +1,140 @@
+"""Retry policies, backoff, and retryable-error classification.
+
+Reference: Trino's ``retry-policy`` session property (NONE / TASK /
+QUERY, ``io.trino.execution.RetryPolicy``) plus the standard
+exponential-backoff-with-jitter schedule its task retries use
+(``faulttolerant/EventDrivenFaultTolerantQueryScheduler``). Jitter here
+is *deterministic* (seeded by attempt index) so chaos runs replay with
+identical timing decisions.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import urllib.error
+from typing import Optional
+
+
+class RetryPolicy:
+    """String constants (mirrors ``io.trino.execution.RetryPolicy``)."""
+
+    NONE = "NONE"
+    TASK = "TASK"
+    QUERY = "QUERY"
+
+    @classmethod
+    def of(cls, value) -> str:
+        v = str(value or cls.NONE).upper()
+        if v not in (cls.NONE, cls.TASK, cls.QUERY):
+            raise ValueError(f"unknown retry_policy: {value!r}")
+        return v
+
+    @classmethod
+    def from_session(cls, session) -> str:
+        try:
+            return cls.of(session.get("retry_policy"))
+        except KeyError:
+            return cls.NONE
+
+
+class TaskFailure(Exception):
+    """A remote task failed; carries the worker's retryable
+    classification so the query-level error is typed correctly."""
+
+    def __init__(self, task_id: str, node_id: str, error: Optional[str],
+                 retryable: bool):
+        self.task_id = task_id
+        self.node_id = node_id
+        self.error = error
+        self.retryable = retryable
+        super().__init__(
+            f"task {task_id} failed on {node_id}"
+            f" ({'retryable' if retryable else 'fatal'}): {error}"
+        )
+
+
+class TaskRetriesExhausted(TaskFailure):
+    """Every allowed attempt of one task failed. Not task-retryable by
+    construction (the budget is spent) but QUERY retry may still apply."""
+
+    def __init__(self, task_id: str, node_id: str, error: Optional[str],
+                 attempts: int):
+        super().__init__(task_id, node_id, error, retryable=False)
+        self.attempts = attempts
+        self.args = (
+            f"task {task_id} failed after {attempts} attempts"
+            f" (last on {node_id}): {error}",
+        )
+
+
+class Backoff:
+    """Exponential backoff with bounded, deterministic jitter.
+
+    ``delay(attempt)`` for attempt=1,2,3... grows initial * 2^(attempt-1)
+    up to ``max_delay``, scaled by a jitter factor in [0.5, 1.0] drawn
+    from (seed, attempt) so replays sleep identically.
+    """
+
+    def __init__(
+        self,
+        initial_ms: float = 100.0,
+        max_ms: float = 2000.0,
+        seed: int = 0,
+    ):
+        self.initial_ms = max(0.0, float(initial_ms))
+        self.max_ms = max(self.initial_ms, float(max_ms))
+        self.seed = int(seed)
+
+    @classmethod
+    def from_session(cls, session) -> "Backoff":
+        try:
+            return cls(
+                initial_ms=float(session.get("retry_initial_delay_ms")),
+                max_ms=float(session.get("retry_max_delay_ms")),
+                seed=int(session.get("fault_injection_seed")),
+            )
+        except KeyError:
+            return cls()
+
+    def delay(self, attempt: int) -> float:
+        """Seconds to sleep before retry number ``attempt`` (1-based)."""
+        if self.initial_ms <= 0:
+            return 0.0
+        base = min(self.max_ms, self.initial_ms * (2 ** max(0, attempt - 1)))
+        jitter = 0.5 + 0.5 * random.Random(f"{self.seed}:backoff:{attempt}").random()
+        return base * jitter / 1000.0
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Classify an exception for the retry policies.
+
+    Retryable: injected faults, network/timeout errors (the request may
+    succeed against a different worker or on a later attempt), and
+    node-local memory exhaustion (another node may have headroom).
+    Fatal: everything deterministic — SQL/semantic errors, capacity-
+    retry exhaustion (same data ⇒ same growth path on any node), and
+    exhausted task-retry budgets.
+    """
+    flagged = getattr(exc, "retryable", None)
+    if flagged is not None:
+        return bool(flagged)
+    if isinstance(
+        exc,
+        (
+            urllib.error.URLError,  # includes HTTPError; connection refused
+            ConnectionError,
+            TimeoutError,
+            socket.timeout,
+            OSError,
+        ),
+    ):
+        return True
+    try:
+        from trino_tpu.memory import ExceededMemoryLimitError
+
+        if isinstance(exc, ExceededMemoryLimitError):
+            return True
+    except ImportError:  # pragma: no cover
+        pass
+    return False

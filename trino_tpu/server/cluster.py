@@ -207,41 +207,93 @@ def phased_order(sub: "SubPlan") -> list["PlanFragment"]:
 
 
 class HttpRemoteTask:
-    """Coordinator-side handle of one worker task."""
+    """Coordinator-side handle of one worker task.
 
-    def __init__(self, node: WorkerNode, task_id: str, payload: dict):
+    Request timeouts come from the session (``http_request_timeout_s``)
+    and each call retries through transient failures — including injected
+    HTTP drops — with deterministic backoff. Injection sites are keyed by
+    ``fragment.partition[+attempt]`` (the task id minus the per-run query
+    counter) so chaos runs replay exactly.
+    """
+
+    def __init__(
+        self,
+        node: WorkerNode,
+        task_id: str,
+        payload: dict,
+        timeout: float = 30.0,
+        http_retries: int = 3,
+        injector=None,
+        backoff=None,
+    ):
+        from trino_tpu.ft.retry import Backoff
+
         self.node = node
         self.task_id = task_id
         self.payload = payload
         self.uri = f"{node.uri}/v1/task/{task_id}"
+        self.timeout = timeout
+        self.http_retries = max(1, int(http_retries))
+        self.injector = injector
+        self.backoff = backoff or Backoff()
+        # set instead of raising when a TASK-retry dispatch fails to start
+        self.start_error: Optional[str] = None
+
+    def _site_target(self) -> str:
+        # "cq7.3.0r1" -> "3.0r1": stable across runs, fresh per attempt
+        return self.task_id.split(".", 1)[-1]
+
+    def _request(
+        self,
+        op: str,
+        method: str,
+        uri: str,
+        body: Optional[bytes] = None,
+        timeout: Optional[float] = None,
+        parse: bool = True,
+    ):
+        from trino_tpu.ft.retry import is_retryable
+        from trino_tpu.server import auth
+
+        last: Optional[Exception] = None
+        for attempt in range(1, self.http_retries + 1):
+            try:
+                if self.injector is not None:
+                    site = self.injector.http_site(
+                        op, self._site_target(), attempt
+                    )
+                    self.injector.delay_http(site)
+                    self.injector.maybe_drop_http(site)
+                req = urllib.request.Request(
+                    uri, data=body, method=method, headers=auth.headers()
+                )
+                if body is not None:
+                    req.add_header("Content-Type", "application/json")
+                with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout
+                ) as r:
+                    return json.loads(r.read().decode()) if parse else None
+            except Exception as e:  # noqa: BLE001
+                last = e
+                if not is_retryable(e) or attempt >= self.http_retries:
+                    raise
+                time.sleep(self.backoff.delay(attempt))
+        raise last  # pragma: no cover — loop always returns or raises
 
     def start(self) -> None:
-        from trino_tpu.server import auth
-
-        body = json.dumps(self.payload).encode()
-        req = urllib.request.Request(
-            self.uri, data=body, method="POST", headers=auth.headers()
+        self._request(
+            "start", "POST", self.uri, body=json.dumps(self.payload).encode()
         )
-        req.add_header("Content-Type", "application/json")
-        with urllib.request.urlopen(req, timeout=30) as r:
-            json.loads(r.read().decode())
 
     def status(self, max_wait: float = 0.0) -> dict:
-        from trino_tpu.server import auth
-
         uri = self.uri + (f"?maxWait={max_wait}" if max_wait else "")
-        req = urllib.request.Request(uri, headers=auth.headers())
-        with urllib.request.urlopen(req, timeout=max(30, max_wait + 10)) as r:
-            return json.loads(r.read().decode())
+        return self._request(
+            "status", "GET", uri, timeout=max(self.timeout, max_wait + 10)
+        )
 
     def cancel(self) -> None:
-        from trino_tpu.server import auth
-
-        req = urllib.request.Request(
-            self.uri, method="DELETE", headers=auth.headers()
-        )
         try:
-            urllib.request.urlopen(req, timeout=10)
+            self._request("cancel", "DELETE", self.uri, timeout=10, parse=False)
         except Exception:  # noqa: BLE001 - best-effort
             pass
 
@@ -250,6 +302,20 @@ class ClusterScheduler:
     """Schedules a fragmented plan over the worker set and gathers output.
 
     One scheduler per coordinator; one `execute` per query.
+
+    Retry policies (``retry_policy`` session property, reference: Trino's
+    fault-tolerant execution / ``io.trino.execution.RetryPolicy``):
+
+    - NONE: v356 semantics — pipelined stages, any task failure fails the
+      query (now with a *classified* retryable/fatal error).
+    - TASK: stage-barrier execution over retained (materialized) task
+      output. Each fragment's tasks must finish before consumers launch;
+      a failed attempt is re-dispatched to a different worker with
+      exponential backoff + deterministic jitter, bounded by
+      ``task_retry_attempts``. Placement consults the failure detector's
+      ``active_nodes()`` so sick workers do not attract retries.
+    - QUERY is handled a level up (server/querymanager.py): the whole
+      statement re-runs on a fresh attempt salt.
     """
 
     def __init__(self, engine, node_manager: ClusterNodeManager):
@@ -257,14 +323,40 @@ class ClusterScheduler:
         self.node_manager = node_manager
         self.node_scheduler = NodeScheduler(node_manager)
 
-    def execute(self, plan: P.PlanNode, session: Session):
-        """Returns (Batch, column_names)."""
+    def _http_opts(self, session: Session) -> dict:
+        """Per-query HTTP tuning + chaos hooks for remote-task calls."""
+        from trino_tpu.ft.injection import FaultInjector
+        from trino_tpu.ft.retry import Backoff
+
+        try:
+            timeout = float(session.get("http_request_timeout_s"))
+            retries = int(session.get("http_retry_attempts"))
+        except KeyError:
+            timeout, retries = 30.0, 3
+        return {
+            "timeout": timeout,
+            "http_retries": retries,
+            "injector": FaultInjector.from_session(session),
+            "backoff": Backoff.from_session(session),
+        }
+
+    def execute(self, plan: P.PlanNode, session: Session, stats_sink=None):
+        """Returns (Batch, column_names). ``stats_sink`` (dict) receives
+        retry/attempt counters for query stats and /v1/query."""
+        from trino_tpu.ft.retry import RetryPolicy
+
         sub = fragment_plan(plan)
         nodes = self.node_manager.active_nodes()
         if not nodes:
             raise ExecutionError("no active workers in the cluster")
         n = len(nodes)
         query_id = f"cq{next(_task_counter)}"
+        policy = RetryPolicy.from_session(session)
+        stats = stats_sink if stats_sink is not None else {}
+        stats.setdefault("retry_policy", policy)
+        stats.setdefault("task_retries", 0)
+        stats.setdefault("task_attempts", {})
+        http = self._http_opts(session)
 
         fragments = {f.id: f for f in sub.all_fragments()}
         # execution policy: all-at-once launches in simple bottom-up order;
@@ -315,10 +407,27 @@ class ClusterScheduler:
                     remote_tasks,
                     session_json,
                     fragments,
+                    policy=policy,
+                    http=http,
                 )
-            return self._execute_root(
-                sub.fragment, session, remote_tasks, task_counts
+                if policy == RetryPolicy.TASK:
+                    # stage barrier: producers must FINISH (with retained
+                    # output) before consumers launch, so a consumer only
+                    # ever sees the surviving attempt's URIs and retained
+                    # pages stay re-pullable by retried consumers
+                    self._await_fragment(
+                        query_id, frag, remote_tasks[frag.id],
+                        session, stats, http,
+                    )
+            result = self._execute_root(
+                sub.fragment, session, remote_tasks, task_counts, policy
             )
+            if policy == RetryPolicy.TASK:
+                # retained buffers never free on ack; release them now
+                for tasks in remote_tasks.values():
+                    for t in tasks:
+                        t.cancel()
+            return result
         except Exception:
             for tasks in remote_tasks.values():
                 for t in tasks:
@@ -377,8 +486,13 @@ class ClusterScheduler:
         remote_tasks: dict[int, list[HttpRemoteTask]],
         session_json: dict,
         fragments: dict[int, PlanFragment],
+        policy: str = "NONE",
+        http: Optional[dict] = None,
     ) -> list[HttpRemoteTask]:
+        from trino_tpu.ft.retry import RetryPolicy, is_retryable
         from trino_tpu.planner.serde import fragment_to_json
+
+        http = http or {}
 
         n_tasks = task_counts[frag.id]
         consumer = consumer_of.get(frag.id)
@@ -425,11 +539,24 @@ class ClusterScheduler:
                         frag, p, remote_tasks, fragments
                     ),
                     "output_partitions": output_partitions,
+                    # materialized exchange: retained pages survive acks so
+                    # a retried consumer attempt can re-pull them
+                    "retain_output": policy == RetryPolicy.TASK,
                 }
                 task = HttpRemoteTask(
-                    placements[p], f"{query_id}.{frag.id}.{p}", payload
+                    placements[p], f"{query_id}.{frag.id}.{p}", payload, **http
                 )
-                task.start()  # select() already reserved the slot
+                if policy == RetryPolicy.TASK:
+                    # a dispatch failure is just attempt 1 failing: defer
+                    # to the stage barrier, which retries it elsewhere
+                    try:
+                        task.start()
+                    except Exception as e:  # noqa: BLE001
+                        if not is_retryable(e):
+                            raise
+                        task.start_error = str(e)
+                else:
+                    task.start()  # select() already reserved the slot
                 tasks.append(task)
         except Exception:
             # a mid-fragment failure leaves these tasks outside
@@ -443,6 +570,121 @@ class ClusterScheduler:
             raise
         return tasks
 
+    # --- stage barrier + task retry (retry_policy=TASK) -------------------
+
+    def _retry_node(self, exclude: str) -> WorkerNode:
+        """Placement for a re-dispatched attempt: prefer a *different*
+        worker with positive health evidence from the failure detector;
+        fall back to any active node (single-worker clusters retry in
+        place rather than fail). ``select()`` reserves the slot."""
+        active = self.node_manager.active_nodes()
+        healthy = set(self.node_manager.failure_detector.active_nodes())
+        candidates = [
+            n for n in active
+            if n.node_id != exclude and (not healthy or n.node_id in healthy)
+        ]
+        if not candidates:
+            candidates = [n for n in active if n.node_id != exclude] or active
+        if not candidates:
+            raise ExecutionError("no active workers available for task retry")
+        return self.node_scheduler.select(candidates, 1)[0]
+
+    def _await_fragment(
+        self,
+        query_id: str,
+        frag: PlanFragment,
+        tasks: list[HttpRemoteTask],
+        session: Session,
+        stats: dict,
+        http: dict,
+    ) -> None:
+        """Block until every task of ``frag`` is FINISHED, re-dispatching
+        failed attempts (``{qid}.{frag}.{p}`` -> ``...{p}r{k}``) to other
+        workers with backoff, bounded by ``task_retry_attempts``.
+
+        Mutates ``tasks`` in place so consumers scheduled afterwards see
+        the surviving attempt's URIs. Raises :class:`TaskFailure` for a
+        fatal error, :class:`TaskRetriesExhausted` when the budget is
+        spent (QUERY retry may still apply a level up)."""
+        from trino_tpu.ft.retry import (
+            Backoff,
+            TaskFailure,
+            TaskRetriesExhausted,
+            is_retryable,
+        )
+
+        try:
+            max_attempts = max(1, int(session.get("task_retry_attempts")))
+        except KeyError:
+            max_attempts = 4
+        try:
+            stage_budget = float(session.get("exchange_timeout_s"))
+        except KeyError:
+            stage_budget = 300.0
+        backoff = http.get("backoff") or Backoff.from_session(session)
+        attempts = [1] * len(tasks)
+        # per-attempt deadline: a hung-but-responsive worker must not
+        # stall the stage barrier forever — overrun counts as a
+        # retryable attempt failure
+        deadlines = [time.time() + stage_budget] * len(tasks)
+        pending = set(range(len(tasks)))
+        while pending:
+            for i in sorted(pending):
+                t = tasks[i]
+                if t.start_error is not None:
+                    failure, retryable = t.start_error, True
+                elif time.time() > deadlines[i]:
+                    failure = f"task attempt exceeded {stage_budget}s stage budget"
+                    retryable = True
+                else:
+                    try:
+                        st = t.status(max_wait=1.0)
+                    except Exception as e:  # noqa: BLE001
+                        if not is_retryable(e):
+                            raise
+                        # worker unreachable through all HTTP retries:
+                        # treat the attempt as lost
+                        failure, retryable = f"unreachable: {e}", True
+                    else:
+                        state = st.get("state")
+                        if state == "FINISHED":
+                            pending.discard(i)
+                            continue
+                        if state != "FAILED":
+                            continue  # still queued/running
+                        failure = st.get("error")
+                        r = st.get("retryable")
+                        retryable = True if r is None else bool(r)
+                if not retryable:
+                    raise TaskFailure(
+                        t.task_id, t.node.node_id, failure, retryable=False
+                    )
+                if attempts[i] >= max_attempts:
+                    raise TaskRetriesExhausted(
+                        t.task_id, t.node.node_id, failure, attempts[i]
+                    )
+                # release the failed attempt, back off, re-dispatch
+                t.cancel()
+                self.node_scheduler.release(t.node)
+                time.sleep(backoff.delay(attempts[i]))
+                node = self._retry_node(exclude=t.node.node_id)
+                attempts[i] += 1
+                base = f"{query_id}.{frag.id}.{i}"
+                new_id = f"{base}r{attempts[i] - 1}"
+                stats["task_retries"] = stats.get("task_retries", 0) + 1
+                stats.setdefault("task_attempts", {})[base] = attempts[i]
+                retry = HttpRemoteTask(node, new_id, t.payload, **http)
+                # swap in before start(): the query-level cleanup releases
+                # whatever sits in ``tasks``, and the old node is released
+                tasks[i] = retry
+                deadlines[i] = time.time() + stage_budget
+                try:
+                    retry.start()
+                except Exception as e:  # noqa: BLE001
+                    if not is_retryable(e):
+                        raise
+                    retry.start_error = str(e)
+
     # --- root fragment on the coordinator --------------------------------
 
     def _execute_root(
@@ -451,7 +693,9 @@ class ClusterScheduler:
         session: Session,
         remote_tasks: dict[int, list[HttpRemoteTask]],
         task_counts: dict[int, int],
+        policy: str = "NONE",
     ):
+        from trino_tpu.ft.retry import RetryPolicy, TaskFailure
         from trino_tpu.server.task import WorkerExecutor
 
         sources = {
@@ -467,18 +711,51 @@ class ClusterScheduler:
                 local_session.properties[k] = v
         executor = WorkerExecutor(self.engine.catalogs, local_session, {}, sources)
         root = frag.root
-        if isinstance(root, P.Output):
-            batch, names = executor.execute(root)
-        else:
-            res = executor._exec(root)
-            batch = res.batch.compact()
-            names = [s.name for s in root.output_symbols]
-        # surface any worker failure even if results looked complete
+        try:
+            if isinstance(root, P.Output):
+                batch, names = executor.execute(root)
+            else:
+                res = executor._exec(root)
+                batch = res.batch.compact()
+                names = [s.name for s in root.output_symbols]
+        except Exception as e:  # noqa: BLE001
+            # the coordinator-side symptom (empty exchange, timeout) is
+            # usually downstream of a worker task failure — surface the
+            # root cause with the worker's retryable classification
+            failed = self._first_failed_status(remote_tasks)
+            if failed is not None:
+                t, st = failed
+                raise TaskFailure(
+                    st.get("taskId") or t.task_id,
+                    t.node.node_id,
+                    st.get("error"),
+                    retryable=bool(st.get("retryable", True)),
+                ) from e
+            raise
+        # surface any worker failure even if results looked complete; the
+        # TASK stage barrier already verified every producer FINISHED
+        if policy != RetryPolicy.TASK:
+            failed = self._first_failed_status(remote_tasks)
+            if failed is not None:
+                t, st = failed
+                raise TaskFailure(
+                    st.get("taskId") or t.task_id,
+                    t.node.node_id,
+                    st.get("error"),
+                    retryable=bool(st.get("retryable", True)),
+                )
+        return batch, names
+
+    @staticmethod
+    def _first_failed_status(
+        remote_tasks: dict[int, list[HttpRemoteTask]],
+    ) -> Optional[tuple[HttpRemoteTask, dict]]:
         for tasks in remote_tasks.values():
             for t in tasks:
-                st = t.status()
+                try:
+                    st = t.status()
+                except Exception:  # noqa: BLE001 - unreachable worker
+                    continue
                 if st.get("state") == "FAILED":
-                    raise ExecutionError(
-                        f"task {st.get('taskId')} failed: {st.get('error')}"
-                    )
-        return batch, names
+                    return t, st
+        return None

@@ -4,8 +4,10 @@ Reference: ``core/trino-main/.../failuredetector/HeartbeatFailureDetector.java:7
 — the coordinator periodically pings every discovered service; an
 exponentially-decayed failure ratio above a threshold marks the node
 failed, and schedulers exclude failed nodes. Recovery is automatic when
-pings succeed again. (v356 has no mid-query retry: a lost worker fails
-its queries — same here.)
+pings succeed again. (v356 has no mid-query retry — a lost worker fails
+its queries; here ``trino_tpu/ft`` adds TASK/QUERY retry on top, and its
+retry placement consults :meth:`HeartbeatFailureDetector.active_nodes`
+to steer re-dispatched attempts away from sick workers.)
 """
 
 from __future__ import annotations
@@ -28,6 +30,13 @@ class NodeState:
     last_update: float = 0.0
     last_seen: Optional[float] = None
     consecutive_failures: int = 0
+
+    @property
+    def known(self) -> bool:
+        """Whether this node has ever been pinged. A registered-but-
+        never-pinged node has no evidence either way; it must not be
+        reported as healthy on the strength of its initial 0.0 ratio."""
+        return self.last_update > 0.0
 
     def record(self, success: bool, now: float) -> None:
         # exponential decay toward the new observation
@@ -76,6 +85,11 @@ class HeartbeatFailureDetector:
             self._nodes.pop(node_id, None)
 
     def start(self) -> "HeartbeatFailureDetector":
+        if self._thread is not None and self._thread.is_alive():
+            return self  # already running
+        # a restarted detector must not inherit the previous stop() — a
+        # set event makes the new loop exit before its first ping
+        self._stop.clear()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
@@ -101,16 +115,26 @@ class HeartbeatFailureDetector:
             n.record(ok, now)
 
     def is_failed(self, node_id: str) -> bool:
+        """Positive evidence of failure. A never-pinged node is NOT
+        failed (no evidence) — but neither is it active; membership
+        freshness (announce timeout) covers it until the first ping."""
         with self._lock:
             n = self._nodes.get(node_id)
         if n is None:
             return True
-        return n.failure_ratio > self.threshold
+        return n.known and n.failure_ratio > self.threshold
 
     def active_nodes(self) -> list[str]:
+        """Nodes with positive evidence of health: pinged at least once
+        and below the failure threshold. Retry placement uses this —
+        never-pinged nodes are unknown, not healthy."""
         with self._lock:
             nodes = list(self._nodes.values())
-        return [n.node_id for n in nodes if n.failure_ratio <= self.threshold]
+        return [
+            n.node_id
+            for n in nodes
+            if n.known and n.failure_ratio <= self.threshold
+        ]
 
     def info(self) -> list[dict]:
         with self._lock:
@@ -120,7 +144,8 @@ class HeartbeatFailureDetector:
                 "nodeId": n.node_id,
                 "uri": n.uri,
                 "failureRatio": round(n.failure_ratio, 4),
-                "failed": n.failure_ratio > self.threshold,
+                "known": n.known,
+                "failed": n.known and n.failure_ratio > self.threshold,
                 "lastSeen": n.last_seen,
             }
             for n in nodes

@@ -43,6 +43,9 @@ class ErrorInfo:
     error_name: str = "GENERIC_INTERNAL_ERROR"
     error_type: str = "INTERNAL_ERROR"
     stack: str = ""
+    # ft classification: would a retry (different worker / fresh attempt)
+    # plausibly succeed? Drives QUERY retry and is surfaced to clients.
+    retryable: bool = False
 
     def to_json(self) -> dict:
         return {
@@ -50,6 +53,7 @@ class ErrorInfo:
             "errorCode": self.error_code,
             "errorName": self.error_name,
             "errorType": self.error_type,
+            "retryable": self.retryable,
             "failureInfo": {"type": self.error_name, "message": self.message,
                             "stack": self.stack.splitlines()},
         }
@@ -71,6 +75,7 @@ class ManagedQuery:
         self.end_time: Optional[float] = None
         self.last_access = time.time()  # protocol touch; guards history GC
         self._cancelled = threading.Event()
+        self.query_attempts = 1  # >1 under retry_policy=QUERY
 
     def touch(self) -> None:
         self.last_access = time.time()
@@ -78,15 +83,46 @@ class ManagedQuery:
     # --- lifecycle --------------------------------------------------------
 
     def run(self, engine: Engine) -> None:
+        from trino_tpu.ft.retry import Backoff, RetryPolicy, is_retryable
+
         if self._cancelled.is_set():
             return
         self.start_time = time.time()
         self.state.set(QueryState.PLANNING)
+        # retry_policy=QUERY: the whole statement re-runs on a fresh
+        # attempt salt (fault_attempt_salt keys the injector's draws, so a
+        # deterministic chaos run does not replay the exact same faults on
+        # attempt 2). Reference: Trino's QUERY retry policy.
+        policy = RetryPolicy.from_session(self.session)
+        if policy == RetryPolicy.QUERY:
+            try:
+                max_attempts = max(1, int(self.session.get("query_retry_attempts")))
+            except KeyError:
+                max_attempts = 3
+        else:
+            max_attempts = 1
+        backoff = Backoff.from_session(self.session)
         try:
             if self._cancelled.is_set():
                 return
             self.state.set(QueryState.RUNNING)
-            self.result = engine.execute_statement(self.sql, self.session)
+            attempt = 1
+            while True:
+                try:
+                    if attempt > 1:
+                        self.session.properties["fault_attempt_salt"] = attempt
+                    self.result = engine.execute_statement(self.sql, self.session)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    if (
+                        attempt >= max_attempts
+                        or self._cancelled.is_set()
+                        or not is_retryable(e)
+                    ):
+                        raise
+                    time.sleep(backoff.delay(attempt))
+                    attempt += 1
+                    self.query_attempts = attempt
             self.state.set(QueryState.FINISHING)
             self.state.set(QueryState.FINISHED)
         except Exception as e:  # noqa: BLE001 — any failure fails the query
@@ -104,7 +140,10 @@ class ManagedQuery:
                 code, name, typ = 2, "SEMANTIC_ERROR", "USER_ERROR"
             else:
                 code, name, typ = 65536, "GENERIC_INTERNAL_ERROR", "INTERNAL_ERROR"
-            self.error = ErrorInfo(str(e), code, name, typ, traceback.format_exc())
+            self.error = ErrorInfo(
+                str(e), code, name, typ, traceback.format_exc(),
+                retryable=is_retryable(e),
+            )
             self.state.set(QueryState.FAILED)
         finally:
             self.end_time = time.time()
@@ -134,6 +173,7 @@ class ManagedQuery:
     def info(self) -> dict:
         st = self.state.get()
         elapsed = (self.end_time or time.time()) - self.create_time
+        cluster_stats = self.result.cluster_stats if self.result else {}
         return {
             "queryId": self.query_id,
             "state": st.value,
@@ -144,6 +184,14 @@ class ManagedQuery:
             "endTime": self.end_time,
             "peakMemoryBytes": self.result.peak_memory_bytes if self.result else 0,
             "updateType": self.result.update_type if self.result else None,
+            # ft counters (trino_tpu/ft): retry policy + attempt accounting
+            "retryPolicy": cluster_stats.get(
+                "retry_policy",
+                self.session.properties.get("retry_policy", "NONE"),
+            ),
+            "queryAttempts": self.query_attempts,
+            "taskRetries": cluster_stats.get("task_retries", 0),
+            "taskAttempts": cluster_stats.get("task_attempts", {}),
             "error": self.error.to_json() if self.error else None,
         }
 

@@ -47,14 +47,28 @@ class OutputBuffer:
     token N acknowledges (and frees) every page below N, releasing the
     producer. At-least-once delivery: unacknowledged pages are re-served on
     retry with the same token.
+
+    ``retain=True`` (TASK retry policy) switches to materialized-exchange
+    semantics (reference: Tardigrade's spooled exchange): acks no longer
+    free pages, so a *retried consumer attempt* can re-pull the stream
+    from token 0 bit-identically. Memory is released when the coordinator
+    deletes the task after the consuming stage finishes. Retained buffers
+    skip producer backpressure — blocking would deadlock a stage-barrier
+    schedule where consumers only start after producers finish.
     """
 
-    def __init__(self, n_partitions: int, max_buffered_bytes: int = 64 << 20):
+    def __init__(
+        self,
+        n_partitions: int,
+        max_buffered_bytes: int = 64 << 20,
+        retain: bool = False,
+    ):
         self.n = n_partitions
         self._pages: list[list[bytes]] = [[] for _ in range(n_partitions)]
         self._base: list[int] = [0] * n_partitions  # first unacked token
         self._buffered = 0
         self.max_buffered_bytes = max_buffered_bytes
+        self.retain = retain
         self._complete = False
         self._aborted = False
         self.dropped_unacked = False  # abort() discarded undelivered pages
@@ -64,7 +78,8 @@ class OutputBuffer:
         with self._lock:
             # backpressure: block until consumers ack enough pages
             while (
-                self._buffered + len(page) > self.max_buffered_bytes
+                not self.retain
+                and self._buffered + len(page) > self.max_buffered_bytes
                 and self._buffered > 0
                 and not self._aborted
             ):
@@ -101,7 +116,7 @@ class OutputBuffer:
         with self._lock:
             # acknowledge everything below `token`
             base = self._base[partition]
-            if token > base:
+            if token > base and not self.retain:
                 drop = token - base
                 dropped = self._pages[partition][:drop]
                 del self._pages[partition][:drop]
@@ -124,13 +139,91 @@ class ExchangeClient:
 
     Reference: ``operator/ExchangeClient.java:56,149`` — one buffer client
     per upstream location, token-advancing GETs until complete.
+
+    Timeouts come from the session (``exchange_timeout_s`` /
+    ``exchange_poll_s``) so chaos tests can shrink them. Each GET is
+    retried through transient connection errors (and injected HTTP drops)
+    with deterministic backoff: token-addressed reads are idempotent —
+    the producer re-serves unacknowledged pages at the same token — so a
+    replayed pull cannot duplicate or lose rows.
     """
 
-    def __init__(self, locations: list[str], partition: int, timeout: float = 300.0):
+    def __init__(
+        self,
+        locations: list[str],
+        partition: int,
+        timeout: float = 300.0,
+        poll_wait: float = 15.0,
+        injector=None,
+        http_retries: int = 3,
+        backoff=None,
+    ):
+        from trino_tpu.ft.retry import Backoff
+
         self.locations = locations
         self.partition = partition
         self.timeout = timeout
-        self.poll_wait = 15.0  # server-side long-poll hold per GET
+        self.poll_wait = poll_wait  # server-side long-poll hold per GET
+        self.injector = injector
+        self.http_retries = max(1, int(http_retries))
+        self.backoff = backoff or Backoff()
+
+    @classmethod
+    def for_session(
+        cls, session, locations: list[str], partition: int, injector=None
+    ) -> "ExchangeClient":
+        """Injector may be passed in to share one event log / counter set
+        with the caller (the owning task); otherwise it is derived from
+        the session."""
+        from trino_tpu.ft.injection import FaultInjector
+        from trino_tpu.ft.retry import Backoff
+
+        try:
+            return cls(
+                locations,
+                partition,
+                timeout=float(session.get("exchange_timeout_s")),
+                poll_wait=float(session.get("exchange_poll_s")),
+                injector=injector or FaultInjector.from_session(session),
+                http_retries=int(session.get("http_retry_attempts")),
+                backoff=Backoff.from_session(session),
+            )
+        except KeyError:  # sessions predating the ft properties
+            return cls(locations, partition, injector=injector)
+
+    def _get_json(self, loc: str, uri: str, token: int, deadline: float) -> dict:
+        """One token read, retried through transient errors. The site key
+        strips per-run identifiers (host:port, query counter) so injected
+        drops replay deterministically."""
+        from trino_tpu.ft.retry import is_retryable
+
+        task_tail = loc.rsplit("/", 1)[-1].split(".", 1)[-1]
+        last: Optional[Exception] = None
+        for attempt in range(1, self.http_retries + 1):
+            if time.time() > deadline and last is not None:
+                break
+            from trino_tpu.server import auth
+
+            try:
+                if self.injector is not None:
+                    site = self.injector.http_site(
+                        "results",
+                        f"{task_tail}.p{self.partition}.k{token}",
+                        attempt,
+                    )
+                    self.injector.delay_http(site)
+                    self.injector.maybe_drop_http(site)
+                req = urllib.request.Request(uri, headers=auth.headers())
+                with urllib.request.urlopen(
+                    req, timeout=self.poll_wait + 30
+                ) as r:
+                    return json.loads(r.read().decode())
+            except Exception as e:  # noqa: BLE001
+                if not is_retryable(e) or attempt >= self.http_retries:
+                    raise
+                last = e
+                time.sleep(self.backoff.delay(attempt))
+        raise last  # deadline exceeded mid-retry
 
     def read_all(self) -> list[Batch]:
         batches: list[Batch] = []
@@ -149,11 +242,7 @@ class ExchangeClient:
                         f"{loc}/results/{self.partition}/{token}"
                         f"?maxWait={self.poll_wait}"
                     )
-                    req = urllib.request.Request(uri, headers=auth.headers())
-                    with urllib.request.urlopen(
-                        req, timeout=self.poll_wait + 30
-                    ) as r:
-                        payload = json.loads(r.read().decode())
+                    payload = self._get_json(loc, uri, token, deadline)
                     for b64 in payload["pages"]:
                         batch = deserialize_batch(base64.b64decode(b64))
                         with lock:
@@ -238,7 +327,9 @@ class WorkerExecutor(LocalExecutor):
             batches = self._prefetched[node.fragment_id]
         else:
             src = self._sources[node.fragment_id]
-            client = ExchangeClient(src["locations"], src["partition"])
+            client = ExchangeClient.for_session(
+                self.session, src["locations"], src["partition"]
+            )
             batches = client.read_all()
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         nonempty = [b for b in batches if b.num_rows > 0]
@@ -523,7 +614,6 @@ class SqlTask:
             int(k): v for k, v in payload.get("sources", {}).items()
         }
         self.n_output_partitions = payload.get("output_partitions", 1)
-        self.buffer = OutputBuffer(self.n_output_partitions)
         s = payload.get("session", {})
         self.session = Session(
             user=s.get("user", "worker"),
@@ -534,6 +624,23 @@ class SqlTask:
             self.session.properties[k] = v
         # interpreter fallback runs single-node on this fragment
         self.session.properties["execution_mode"] = "local"
+        try:
+            buffer_bytes = int(self.session.get("exchange_buffer_bytes"))
+        except (KeyError, TypeError, ValueError):
+            buffer_bytes = 64 << 20
+        # TASK retry: the coordinator asks for materialized (retained)
+        # output so a retried consumer attempt can re-pull this stream
+        self.buffer = OutputBuffer(
+            self.n_output_partitions,
+            max_buffered_bytes=buffer_bytes,
+            retain=bool(payload.get("retain_output")),
+        )
+        from trino_tpu.ft.injection import FaultInjector
+
+        self.injector = FaultInjector.from_session(self.session)
+        # worker-side retryable classification of a FAILED state; None
+        # while RUNNING/FINISHED (TaskFailure consumes this coordinator-side)
+        self.retryable: Optional[bool] = None
         self.execution_path = "pending"
         self.stats: dict[str, Any] = {}
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -550,8 +657,11 @@ class SqlTask:
 
         def pull(fid: int, src: dict):
             try:
-                out[fid] = ExchangeClient(
-                    src["locations"], src["partition"]
+                out[fid] = ExchangeClient.for_session(
+                    self.session,
+                    src["locations"],
+                    src["partition"],
+                    injector=self.injector,
                 ).read_all()
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
@@ -591,6 +701,13 @@ class SqlTask:
         self._reserved = 0
         try:
             prefetched = self._prefetch_sources()
+            if self.injector is not None:
+                # crash AFTER the sources were pulled: a retried attempt
+                # must be able to re-pull them (retained buffers / unacked
+                # token windows make the replay idempotent)
+                from trino_tpu.ft.injection import task_site
+
+                self.injector.maybe_crash_task(task_site(self.task_id))
             from trino_tpu.memory import batch_nbytes
 
             self._account(
@@ -611,9 +728,14 @@ class SqlTask:
             self._emit(result)
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001
+            from trino_tpu.ft.retry import is_retryable
+
             self.error = f"{e}\n{traceback.format_exc()}"
+            self.retryable = is_retryable(e)
             self.state = "FAILED"
         finally:
+            if self.injector is not None and self.injector.total_injected:
+                self.stats["faults_injected"] = self.injector.total_injected
             self.buffer.set_complete()
             if self._reserved:
                 self.engine.memory_pool.free(self.query_id, self._reserved)
@@ -714,6 +836,9 @@ class SqlTask:
             "taskId": self.task_id,
             "state": self.state,
             "error": self.error,
+            # worker-side classification for the coordinator's retry
+            # policy; None unless FAILED
+            "retryable": self.retryable,
             "fragment": self.fragment_id,
             "elapsed": time.time() - self.created,
             "executionPath": self.execution_path,
