@@ -35,3 +35,56 @@ except Exception:
     pass  # older jax without persistent-cache config
 
 import trino_tpu  # noqa: E402,F401  (enables x64)
+
+import pytest  # noqa: E402
+
+# Generated-table cache shared across Engine instances. Every
+# LocalQueryRunner builds a fresh Engine (fresh connectors), so without
+# this each test module re-runs dbgen for the same tiny-schema tables —
+# the dominant cost of the tier-1 tail (ROADMAP open item). The caches
+# live at session scope and are installed once, before the first runner.
+_shared_tpch_batches: dict = {}
+_shared_tpch_dicts: dict = {}
+_shared_tpcds_batches: dict = {}
+_shared_tpcds_dicts: dict = {}
+
+
+@pytest.fixture(scope="session", autouse=True)
+def shared_dbgen_cache():
+    from trino_tpu.connectors import tpcds as _tpcds_mod
+    from trino_tpu.connectors import tpch as _tpch_mod
+
+    tpch_init = _tpch_mod.TpchConnector.__init__
+
+    def shared_tpch_init(self, *a, **kw):
+        tpch_init(self, *a, **kw)
+        self._batch_cache = _shared_tpch_batches
+        self._dict_cache = _shared_tpch_dicts
+
+    tpcds_init = _tpcds_mod.TpcdsConnector.__init__
+
+    def shared_tpcds_init(self, *a, **kw):
+        tpcds_init(self, *a, **kw)
+        self._dict_cache = _shared_tpcds_dicts
+
+    # TpcdsConnector has no batch cache of its own: memoize read_split
+    # (split generation is deterministic — seeded rngs keyed on the split)
+    tpcds_read = _tpcds_mod.TpcdsConnector.read_split
+
+    def cached_tpcds_read(self, schema, table, columns, split):
+        key = (schema, table, tuple(columns), split.index, split.total)
+        hit = _shared_tpcds_batches.get(key)
+        if hit is None:
+            hit = tpcds_read(self, schema, table, columns, split)
+            _shared_tpcds_batches[key] = hit
+        return hit
+
+    _tpch_mod.TpchConnector.__init__ = shared_tpch_init
+    _tpcds_mod.TpcdsConnector.__init__ = shared_tpcds_init
+    _tpcds_mod.TpcdsConnector.read_split = cached_tpcds_read
+    try:
+        yield
+    finally:
+        _tpch_mod.TpchConnector.__init__ = tpch_init
+        _tpcds_mod.TpcdsConnector.__init__ = tpcds_init
+        _tpcds_mod.TpcdsConnector.read_split = tpcds_read

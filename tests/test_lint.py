@@ -1,0 +1,199 @@
+"""The jit-safety lint is part of tier-1: the repo must stay clean
+relative to the suppression baseline, and each rule must actually fire
+on a seeded bad pattern."""
+
+import textwrap
+
+from trino_tpu.lint import (
+    compare_to_baseline,
+    lint_paths,
+    load_baseline,
+    main,
+)
+
+
+def _lint_source(tmp_path, source: str):
+    mod = tmp_path / "seeded.py"
+    mod.write_text(textwrap.dedent(source))
+    return lint_paths([mod])
+
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+def test_repo_is_clean_against_baseline():
+    """CI gate: the whole package, new violations only."""
+    violations = lint_paths(["trino_tpu"])
+    new, _stale = compare_to_baseline(violations, load_baseline())
+    assert not new, "new jit-safety violations:\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_cli_exit_codes(tmp_path):
+    assert main(["trino_tpu"]) == 0
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def f(x):\n"
+        "    return float(jnp.sum(x))\n"
+    )
+    assert main([str(bad)]) != 0
+
+
+def test_host_roundtrip_item(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(x):
+            return x.sum().item()
+        """,
+    )
+    assert "JIT001" in _rules(vs)
+
+
+def test_host_cast_on_jnp(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(x):
+            return int(jnp.max(x))
+        """,
+    )
+    assert "JIT002" in _rules(vs)
+
+
+def test_branch_on_traced_value(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(x):
+            if jnp.any(x > 0):
+                return x
+            return -x
+        """,
+    )
+    assert "JIT003" in _rules(vs)
+
+
+def test_branch_on_static_dtype_predicate_is_fine(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(x):
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            return x.astype(jnp.float32)
+        """,
+    )
+    assert "JIT003" not in _rules(vs)
+
+
+def test_float_literal_widening(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f():
+            return jnp.array([0.5, 1.5])
+        """,
+    )
+    assert "JIT004" in _rules(vs)
+
+
+def test_float_literal_with_dtype_is_fine(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f():
+            return jnp.array([0.5, 1.5], dtype=jnp.float32)
+        """,
+    )
+    assert "JIT004" not in _rules(vs)
+
+
+def test_set_iteration_order(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(parts):
+            return jnp.concatenate([parts[k] for k in set(parts)])
+        """,
+    )
+    assert "JIT005" in _rules(vs)
+
+
+def test_sorted_set_iteration_is_fine(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(parts):
+            return jnp.concatenate([parts[k] for k in sorted(set(parts))])
+        """,
+    )
+    assert "JIT005" not in _rules(vs)
+
+
+def test_np_compute_in_jnp_function(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import numpy as np
+        import jax.numpy as jnp
+        def f(x):
+            y = jnp.cumsum(x)
+            return np.argsort(y)
+        """,
+    )
+    assert "JIT006" in _rules(vs)
+
+
+def test_np_in_pure_host_function_is_fine(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import numpy as np
+        def f(x):
+            return np.argsort(x)
+        """,
+    )
+    assert "JIT006" not in _rules(vs)
+
+
+def test_inline_suppression_comment(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(x):
+            return x.sum().item()  # lint: ignore[JIT001]
+        """,
+    )
+    assert "JIT001" not in _rules(vs)
+
+
+def test_baseline_comparison_counts(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+        def f(x):
+            a = x.sum().item()
+            b = x.max().item()
+            return a, b
+        """,
+    )
+    only_jit1 = [v for v in vs if v.rule == "JIT001"]
+    assert len(only_jit1) == 2
+    baseline = {"version": 1, "entries": {only_jit1[0].key: 1}}
+    new, stale = compare_to_baseline(only_jit1, baseline)
+    assert len(new) == 1  # one allowed, one new
+    assert not stale

@@ -1,0 +1,248 @@
+"""Malformed-plan corpus: one hand-built broken plan per sanity checker.
+
+Each test asserts the *specific* checker name travels in the typed
+``PlanValidationError`` — the whole point of the battery is that a broken
+rewrite names its checker and plan-node path instead of surfacing as a
+wrong answer (or a shape error) at execution time.
+"""
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.config import Session
+from trino_tpu.ir import Call, Constant, Variable
+from trino_tpu.planner import plan as P
+from trino_tpu.planner.fragmenter import (
+    SINGLE,
+    Partitioning,
+    PlanFragment,
+    SubPlan,
+)
+from trino_tpu.planner.sanity import (
+    PlanSanityChecker,
+    PlanValidationError,
+    validation_enabled,
+)
+
+
+def _values(name: str, type_=T.BIGINT) -> P.Values:
+    return P.Values([P.Symbol(name, type_)], [[1]])
+
+
+# === one broken plan per checker ===========================================
+
+
+def test_dangling_symbol_names_dependencies_checker():
+    # Filter predicate references a symbol its source never produces
+    bad = P.Filter(
+        _values("a"),
+        Call(T.BOOLEAN, "eq", (Variable(T.BIGINT, "missing"), Constant(T.BIGINT, 1))),
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "test")
+    assert ei.value.checker == "ValidateDependenciesChecker"
+    assert "missing" in str(ei.value)
+    assert "Filter" in ei.value.path
+
+
+def test_type_mismatch_names_type_validator():
+    # variable declares double but its producer outputs bigint
+    bad = P.Filter(
+        _values("a"),
+        Call(T.BOOLEAN, "eq", (Variable(T.DOUBLE, "a"), Constant(T.DOUBLE, 1.0))),
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "test")
+    assert ei.value.checker == "TypeValidator"
+
+
+def test_nonboolean_predicate_names_type_validator():
+    bad = P.Filter(_values("a"), Variable(T.BIGINT, "a"))
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "test")
+    assert ei.value.checker == "TypeValidator"
+
+
+def test_aliased_subtree_names_duplicate_checker():
+    # the same node object wired into both join sides (a rewrite that
+    # forgot to clone — what planner/plan.py instantiate() prevents)
+    shared = _values("a")
+    bad = P.Join("CROSS", shared, shared, [])
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "test")
+    assert ei.value.checker == "NoDuplicatePlanNodesChecker"
+
+
+def test_bad_agg_dtype_names_aggregation_checker():
+    # sum(varchar): invalid input dtype for the aggregate function
+    src = _values("s", T.VARCHAR)
+    bad = P.Aggregate(
+        src,
+        [],
+        [(P.Symbol("x", T.VARCHAR),
+          P.AggFunction("sum", Variable(T.VARCHAR, "s"), T.VARCHAR))],
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "test")
+    assert ei.value.checker == "AggregationChecker"
+
+
+def test_unknown_agg_kind_names_aggregation_checker():
+    src = _values("a")
+    bad = P.Aggregate(
+        src,
+        [],
+        [(P.Symbol("x", T.BIGINT),
+          P.AggFunction("median", Variable(T.BIGINT, "a"), T.BIGINT))],
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "test")
+    assert ei.value.checker == "AggregationChecker"
+
+
+def test_wrong_decimal_scale_names_decimal_checker():
+    # decimal(10,2) * decimal(10,2) must carry scale 4, not 3 — a dropped
+    # rescale in the decimal128 lowering shifts every value by 10x
+    d = T.decimal(10, 2)
+    src = P.Values([P.Symbol("d1", d)], [[100]])
+    bad = P.Project(
+        src,
+        [(P.Symbol("p", T.decimal(21, 3)),
+          Call(T.decimal(21, 3), "multiply",
+               (Variable(d, "d1"), Variable(d, "d1"))))],
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "test")
+    assert ei.value.checker == "Decimal128Checker"
+
+
+def test_oversized_decimal_constant_names_decimal_checker():
+    src = P.Values([P.Symbol("a", T.BIGINT)], [[1]])
+    bad = P.Filter(
+        src,
+        Call(T.BOOLEAN, "eq",
+             (Variable(T.BIGINT, "a"),
+              Constant(T.decimal(3, 1), 123456))),  # 6 digits in decimal(3,1)
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "test")
+    assert ei.value.checker == "Decimal128Checker"
+
+
+def test_keyless_hash_exchange_names_exchange_checker():
+    bad = P.Output(
+        P.Exchange(_values("a"), "hash", []),  # hash with no keys
+        ["a"],
+        [P.Symbol("a", T.BIGINT)],
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_final(bad)
+    assert ei.value.checker == "ExchangeConsistencyChecker"
+
+
+def test_fragment_partitioning_mismatch_names_exchange_checker():
+    # RemoteSource declares a hash exchange; the feeding fragment ships
+    # 'single' — rows would land unsharded on one consumer
+    sym = P.Symbol("a", T.BIGINT)
+    child = PlanFragment(
+        1, _values("a"), Partitioning(SINGLE), output_exchange="single",
+    )
+    root = PlanFragment(
+        0,
+        P.Output(P.RemoteSource(1, [sym], "hash", [sym]), ["a"], [sym]),
+        Partitioning(SINGLE),
+    )
+    sub = SubPlan(root, [SubPlan(child)])
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_fragments(sub)
+    assert ei.value.checker == "ExchangeConsistencyChecker"
+    assert "hash" in str(ei.value)
+
+
+def test_fragment_hash_key_disagreement_names_exchange_checker():
+    sym_a = P.Symbol("a", T.BIGINT)
+    sym_b = P.Symbol("b", T.BIGINT)
+    child = PlanFragment(
+        1,
+        P.Values([sym_a, sym_b], [[1, 2]]),
+        Partitioning(SINGLE),
+        output_exchange="hash",
+        output_keys=[sym_b],
+    )
+    root = PlanFragment(
+        0,
+        P.Output(
+            P.RemoteSource(1, [sym_a, sym_b], "hash", [sym_a]),
+            ["a", "b"],
+            [sym_a, sym_b],
+        ),
+        Partitioning(SINGLE),
+    )
+    sub = SubPlan(root, [SubPlan(child)])
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_fragments(sub)
+    assert ei.value.checker == "ExchangeConsistencyChecker"
+
+
+def test_remote_source_unknown_fragment():
+    sym = P.Symbol("a", T.BIGINT)
+    root = PlanFragment(
+        0,
+        P.Output(P.RemoteSource(7, [sym], "single"), ["a"], [sym]),
+        Partitioning(SINGLE),
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_fragments(SubPlan(root))
+    assert ei.value.checker == "ExchangeConsistencyChecker"
+    assert "unknown fragment" in str(ei.value)
+
+
+# === error shape and gating ================================================
+
+
+def test_error_carries_checker_path_and_stage():
+    bad = P.Filter(
+        _values("a"),
+        Call(T.BOOLEAN, "eq", (Variable(T.BIGINT, "gone"), Constant(T.BIGINT, 1))),
+    )
+    with pytest.raises(PlanValidationError) as ei:
+        PlanSanityChecker.validate_intermediate(bad, "push_down_predicates")
+    e = ei.value
+    assert e.stage == "push_down_predicates"
+    assert e.path.startswith("Filter")
+    assert "[ValidateDependenciesChecker]" in str(e)
+    assert "push_down_predicates" in str(e)
+
+
+def test_session_property_gates_validation():
+    s = Session()
+    assert validation_enabled(s)  # on by default
+    s.set("plan_validation", False)
+    assert not validation_enabled(s)
+    assert validation_enabled(None)  # no session: validate
+
+
+def test_valid_plan_passes_every_entry_point():
+    sym = P.Symbol("a", T.BIGINT)
+    plan = P.Output(
+        P.Filter(
+            _values("a"),
+            Call(T.BOOLEAN, "gt", (Variable(T.BIGINT, "a"), Constant(T.BIGINT, 0))),
+        ),
+        ["a"],
+        [sym],
+    )
+    PlanSanityChecker.validate_intermediate(plan, "test")
+    PlanSanityChecker.validate_final(plan)
+    frag = PlanFragment(0, plan, Partitioning(SINGLE))
+    PlanSanityChecker.validate_fragments(SubPlan(frag))
+    PlanSanityChecker.validate_deserialized(frag)
+
+
+def test_queries_run_with_validation_disabled():
+    from trino_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner()
+    r.session.set("plan_validation", False)
+    rows, _ = r.execute("SELECT count(*) FROM region")
+    assert rows == [(5,)]

@@ -35,6 +35,7 @@ class TestProtocol:
         assert rows == [(1, "a")]
         assert names == ["x", "s"]
 
+    @pytest.mark.slow  # agg-over-protocol; the agg itself is suite-covered
     def test_tpch_aggregation(self, conn):
         rows, names = conn.execute(
             "select o_orderpriority, count(*) c from tpch.tiny.orders "
@@ -210,6 +211,7 @@ class TestWebUi:
 
 
 class TestVerifier:
+    @pytest.mark.slow  # local-vs-distributed agreement also in test_cluster
     def test_local_vs_distributed(self, tmp_path):
         from trino_tpu.verifier import verify
 

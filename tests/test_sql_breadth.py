@@ -25,6 +25,7 @@ class TestCorrelatedSubqueries:
             [(5,)],
         )
 
+    @pytest.mark.slow  # Q21/Q22 in test_tpch_suite cover NOT EXISTS
     def test_correlated_not_exists(self, runner):
         # TPC-H Q22 shape: customers with no orders
         rows, _ = runner.execute(
@@ -56,6 +57,7 @@ class TestCorrelatedSubqueries:
         assert sum(counts.values()) == 2  # nations 0 and 1
         assert 0 in counts.values()  # some region has none -> 0 not NULL
 
+    @pytest.mark.slow  # full Q17 runs in test_tpch_suite
     def test_correlated_scalar_in_where_q17_shape(self, runner):
         rows, _ = runner.execute(
             "select sum(l_extendedprice) from tpch.tiny.lineitem l1 "
@@ -65,6 +67,7 @@ class TestCorrelatedSubqueries:
         )
         assert rows[0][0] is not None
 
+    @pytest.mark.slow  # full Q4 runs in test_tpch_suite
     def test_correlated_exists_q4_shape(self, runner):
         rows, _ = runner.execute(
             "select o_orderpriority, count(*) from tpch.tiny.orders o "

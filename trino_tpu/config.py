@@ -60,6 +60,9 @@ class Session:
         # rows above which join/group-by switch to partitioned host-spill
         ("spill_threshold_rows", 1 << 23),
         ("tpu_enabled", True),
+        # plan sanity checkers after each optimizer stage, fragmentation,
+        # and worker-side deserialization (reference PlanSanityChecker)
+        ("plan_validation", True),
         ("execution_mode", "local"),  # local | distributed (mesh SPMD)
         # cluster worker tasks: 'fused' compiles the fragment onto the
         # worker's local devices; 'interpreter' forces the CPU fallback

@@ -779,6 +779,7 @@ class LocalExecutor:
                 fd, fv = fc.to_numpy()
                 fmask = fd & fv
             groups: dict = {k: [] for k in out_keys}
+            dvals = d.tolist()  # python scalars in one pass, not per-row
             for i in np.nonzero(sel & fmask)[0]:
                 k = key_of(i)
                 if k not in groups:
@@ -788,9 +789,7 @@ class LocalExecutor:
                 elif c.dictionary is not None:
                     groups[k].append(c.dictionary.decode(int(d[i])))
                 else:
-                    groups[k].append(
-                        d[i].item() if hasattr(d[i], "item") else d[i]
-                    )
+                    groups[k].append(dvals[i])
             tuples: list = [()] * max(ng, 1)
             valid_out = np.zeros(max(ng, 1), dtype=bool)
             for k, gi in out_keys.items():

@@ -1,0 +1,17 @@
+"""Static analysis for JAX jit-safety (``python -m trino_tpu.lint``).
+
+See ``jit_safety.py`` for the rule catalogue and ``baseline.json`` for the
+suppression baseline: CI fails only on violations *new* relative to the
+baseline, so pre-existing debt is visible but non-blocking.
+"""
+
+from trino_tpu.lint.jit_safety import (  # noqa: F401
+    DEFAULT_PATHS,
+    RULES,
+    Violation,
+    compare_to_baseline,
+    lint_paths,
+    load_baseline,
+    main,
+    to_baseline,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from trino_tpu.lint.jit_safety import main
+
+sys.exit(main())

@@ -1,0 +1,375 @@
+"""AST lint for JAX tracer-safety in kernel code.
+
+Inside ``jax.jit``-traced code, several perfectly ordinary Python idioms
+become correctness or performance bugs:
+
+- ``JIT001`` host round-trip — ``.item()`` forces a device→host sync and
+  fails outright on a tracer.
+- ``JIT002`` host cast — ``float()``/``int()``/``bool()`` on a ``jnp``
+  expression concretizes the tracer (``ConcretizationTypeError`` under
+  jit; silent host sync outside it).
+- ``JIT003`` traced branch — Python ``if``/``while`` on a ``jnp``
+  expression needs a concrete boolean; under jit this is a tracer leak,
+  outside jit it blocks on the device.
+- ``JIT004`` float-literal widening — ``jnp.array(0.5)`` & friends
+  without ``dtype=`` produce float64 when x64 is enabled, silently
+  widening downstream kernels and doubling memory traffic.
+- ``JIT005`` unordered iteration — iterating a ``set`` to build a
+  concat/collective operand order is nondeterministic across processes
+  (hash randomization), which deadlocks or mis-shards SPMD collectives.
+- ``JIT006`` numpy-on-device — ``np.*`` compute calls inside a
+  ``jnp``-using function pull values to the host and break tracing; use
+  ``jnp.*`` (or hoist the host work out of the kernel).
+
+Violations are keyed against a checked-in suppression baseline
+(``baseline.json``) so CI fails only on *new* violations. A line comment
+``# lint: ignore[JIT00x]`` suppresses a single finding at source level.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import sys
+from pathlib import Path
+from typing import Iterable, Optional
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+BASELINE_PATH = Path(__file__).resolve().parent / "baseline.json"
+
+# the whole package; the kernel-heavy dirs (ops/, exec/, parallel/) are
+# where the rules bite, but host-side modules get the same scan
+DEFAULT_PATHS = ("trino_tpu",)
+
+RULES = {
+    "JIT001": "host round-trip: .item() syncs device→host and fails on tracers",
+    "JIT002": "host cast: float()/int()/bool() on a jnp expression leaks the tracer",
+    "JIT003": "python branch on a traced jnp expression (if/while)",
+    "JIT004": "float literal constructor without dtype= widens to float64 under x64",
+    "JIT005": "iteration over an unordered set feeds collective/concat order",
+    "JIT006": "np.* compute on device values inside a jnp-using function",
+}
+
+# np.* attrs that compute over array *values* (vs constructors/dtype meta,
+# which are legitimate host-side prep even in device code)
+_NP_COMPUTE = frozenset(
+    {
+        "sum", "mean", "prod", "cumsum", "cumprod", "dot", "matmul",
+        "where", "nonzero", "flatnonzero", "argsort", "sort", "unique",
+        "concatenate", "stack", "vstack", "hstack", "split", "take",
+        "searchsorted", "bincount", "add", "subtract", "multiply",
+        "divide", "minimum", "maximum", "clip", "abs", "sign", "sqrt",
+        "exp", "log", "floor", "ceil", "round", "logical_and",
+        "logical_or", "logical_not", "isnan", "isin", "equal",
+        "not_equal", "less", "greater", "argmax", "argmin",
+    }
+)
+
+_FLOAT_CONSTRUCTORS = frozenset({"array", "asarray", "full", "arange", "linspace"})
+
+# jnp.* calls that return *static* host values at trace time (dtype
+# metadata) — branching on these is trace-safe, not a tracer leak
+_JNP_STATIC = frozenset(
+    {"issubdtype", "isdtype", "iinfo", "finfo", "result_type",
+     "promote_types", "can_cast", "dtype"}
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str  # repo-relative when under the repo, else as given
+    rule: str
+    func: str  # enclosing function qualname, or "<module>"
+    lineno: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}::{self.func}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.lineno}: {self.rule} [{self.func}] {self.message}"
+
+
+def _aliases(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(jnp aliases, np aliases) bound by this module's imports."""
+    jnp: set[str] = set()
+    np: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                if a.name in ("jax.numpy", "jnp"):
+                    jnp.add(a.asname or "jnp")
+                elif a.name == "numpy":
+                    np.add(name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        jnp.add(a.asname or "numpy")
+            elif node.module == "jax.numpy":
+                jnp.add("__jnp_from_import__")  # from jax.numpy import x — rare
+    return jnp, np
+
+
+def _rooted_at(node: ast.expr, aliases: set[str]) -> bool:
+    """True when the attribute chain bottoms out at one of `aliases`."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in aliases
+
+
+def _mentions(node: ast.AST, aliases: set[str]) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id in aliases for n in ast.walk(node)
+    )
+
+
+def _has_float_literal(node: ast.expr) -> bool:
+    return any(
+        isinstance(n, ast.Constant) and isinstance(n.value, float)
+        for n in ast.walk(node)
+    )
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, source_lines: list[str], jnp: set[str], np: set[str]):
+        self.path = path
+        self.lines = source_lines
+        self.jnp = jnp
+        self.np = np
+        self.stack: list[str] = []  # enclosing function names
+        self.fn_uses_jnp: list[bool] = []
+        self.out: list[Violation] = []
+
+    # --- helpers ----------------------------------------------------------
+
+    def _func(self) -> str:
+        return ".".join(self.stack) if self.stack else "<module>"
+
+    def _suppressed(self, lineno: int, rule: str) -> bool:
+        if 1 <= lineno <= len(self.lines):
+            line = self.lines[lineno - 1]
+            return f"lint: ignore[{rule}]" in line or "lint: ignore-all" in line
+        return False
+
+    def _flag(self, node: ast.AST, rule: str, detail: str = "") -> None:
+        lineno = getattr(node, "lineno", 0)
+        if self._suppressed(lineno, rule):
+            return
+        msg = RULES[rule] + (f" ({detail})" if detail else "")
+        self.out.append(Violation(self.path, rule, self._func(), lineno, msg))
+
+    # --- scope tracking ---------------------------------------------------
+
+    def _visit_fn(self, node) -> None:
+        self.stack.append(node.name)
+        self.fn_uses_jnp.append(_mentions(node, self.jnp))
+        self.generic_visit(node)
+        self.fn_uses_jnp.pop()
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    # --- rules ------------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # JIT001: x.item()
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+            self._flag(node, "JIT001")
+        # JIT002: float()/int()/bool() over a jnp expression
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in ("float", "int", "bool")
+            and node.args
+            and any(_mentions(a, self.jnp) for a in node.args)
+        ):
+            self._flag(node, "JIT002", f"{fn.id}() on jnp value")
+        # JIT004: jnp.array(0.5, ...) without dtype=
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _FLOAT_CONSTRUCTORS
+            and _rooted_at(fn, self.jnp)
+            and not any(k.arg == "dtype" for k in node.keywords)
+            and any(_has_float_literal(a) for a in node.args)
+        ):
+            self._flag(node, "JIT004", f"jnp.{fn.attr}")
+        # JIT006: np compute inside a jnp-using function
+        if (
+            self.fn_uses_jnp
+            and self.fn_uses_jnp[-1]
+            and isinstance(fn, ast.Attribute)
+            and fn.attr in _NP_COMPUTE
+            and _rooted_at(fn, self.np)
+        ):
+            self._flag(node, "JIT006", f"np.{fn.attr}")
+        self.generic_visit(node)
+
+    def _check_branch(self, node) -> None:
+        # JIT003: the branch condition contains a call rooted at jnp
+        # (jnp.any/jnp.all/arithmetic...) — attribute reads alone (dtype,
+        # shape metadata) are static and fine
+        for sub in ast.walk(node.test):
+            if (
+                isinstance(sub, ast.Call)
+                and _rooted_at(sub.func, self.jnp)
+                and not (
+                    isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _JNP_STATIC
+                )
+            ):
+                self._flag(node, "JIT003", "condition computes a jnp value")
+                break
+        self.generic_visit(node)
+
+    visit_If = _check_branch
+    visit_While = _check_branch
+
+    def _check_iter(self, node, iter_expr: ast.expr) -> None:
+        # JIT005: for x in {…} / set(…) / frozenset(…) / set comprehension
+        bad = isinstance(iter_expr, (ast.Set, ast.SetComp)) or (
+            isinstance(iter_expr, ast.Call)
+            and isinstance(iter_expr.func, ast.Name)
+            and iter_expr.func.id in ("set", "frozenset")
+        )
+        if bad:
+            self._flag(node, "JIT005")
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_iter(node, node.iter)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node) -> None:
+        for gen in node.generators:
+            self._check_iter(node, gen.iter)
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+
+def lint_file(path: Path) -> list[Violation]:
+    try:
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        return [
+            Violation(_rel(path), "JIT000", "<module>", 0, f"unparseable: {e}")
+        ]
+    jnp, np = _aliases(tree)
+    v = _Visitor(_rel(path), source.splitlines(), jnp, np)
+    v.visit(tree)
+    return v.out
+
+
+def _rel(path: Path) -> str:
+    try:
+        return path.resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return str(path)
+
+
+def lint_paths(paths: Iterable[str | Path]) -> list[Violation]:
+    out: list[Violation] = []
+    for p in paths:
+        p = Path(p)
+        if not p.is_absolute() and not p.exists():
+            p = REPO_ROOT / p
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            out.extend(lint_file(f))
+    return sorted(out, key=lambda v: (v.path, v.lineno, v.rule))
+
+
+# === suppression baseline ===================================================
+
+
+def to_baseline(violations: Iterable[Violation]) -> dict:
+    counts: dict[str, int] = {}
+    for v in violations:
+        counts[v.key] = counts.get(v.key, 0) + 1
+    return {"version": 1, "entries": dict(sorted(counts.items()))}
+
+
+def load_baseline(path: Path = BASELINE_PATH) -> dict:
+    if not path.exists():
+        return {"version": 1, "entries": {}}
+    return json.loads(path.read_text())
+
+
+def compare_to_baseline(
+    violations: list[Violation], baseline: dict
+) -> tuple[list[Violation], list[str]]:
+    """(new violations beyond baseline, stale baseline keys)."""
+    allowed: dict[str, int] = dict(baseline.get("entries", {}))
+    seen: dict[str, int] = {}
+    new: list[Violation] = []
+    for v in violations:
+        seen[v.key] = seen.get(v.key, 0) + 1
+        if seen[v.key] > allowed.get(v.key, 0):
+            new.append(v)
+    stale = [k for k, n in allowed.items() if seen.get(k, 0) < n]
+    return new, stale
+
+
+# === CLI ====================================================================
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m trino_tpu.lint",
+        description="JAX jit-safety lint (see trino_tpu/lint/jit_safety.py)",
+    )
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS))
+    ap.add_argument("--baseline", type=Path, default=BASELINE_PATH)
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every violation, ignoring the suppression baseline",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline to the current violation set and exit 0",
+    )
+    args = ap.parse_args(argv)
+
+    violations = lint_paths(args.paths)
+
+    if args.update_baseline:
+        fresh = to_baseline(violations)
+        if args.baseline.exists():  # keep human-written per-entry notes
+            old = json.loads(args.baseline.read_text())
+            if "notes" in old:
+                fresh["notes"] = old["notes"]
+        args.baseline.write_text(json.dumps(fresh, indent=2) + "\n")
+        print(f"baseline updated: {len(violations)} suppressed violations "
+              f"-> {args.baseline}")
+        return 0
+
+    baseline = {"version": 1, "entries": {}} if args.no_baseline else load_baseline(args.baseline)
+    new, stale = compare_to_baseline(violations, baseline)
+    for v in new:
+        print(v.render())
+    for k in stale:
+        print(f"note: stale baseline entry (violation fixed?): {k}")
+    if new:
+        print(f"\n{len(new)} new violation(s) "
+              f"({len(violations)} total, {len(violations) - len(new)} baselined)")
+        return 1
+    print(f"clean: 0 new violations ({len(violations)} baselined)")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

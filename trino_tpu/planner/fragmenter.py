@@ -86,10 +86,15 @@ class SubPlan:
         return out
 
 
-def fragment_plan(root: P.PlanNode) -> SubPlan:
+def fragment_plan(root: P.PlanNode, session=None) -> SubPlan:
     """AddExchanges + createSubPlans: the full fragmentation pipeline."""
+    from trino_tpu.planner.sanity import PlanSanityChecker, validation_enabled
+
     with_exchanges, _ = _add_exchanges(root)
-    return _split(with_exchanges)
+    sub = _split(with_exchanges)
+    if validation_enabled(session):
+        PlanSanityChecker.validate_fragments(sub)
+    return sub
 
 
 # === AddExchanges ===========================================================

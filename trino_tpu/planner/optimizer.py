@@ -39,20 +39,35 @@ from trino_tpu.planner import plan as P
 
 def optimize(root: P.PlanNode, session: Session, catalogs) -> P.PlanNode:
     from trino_tpu.planner.joins import determine_join_distribution, reorder_joins
+    from trino_tpu.planner.sanity import PlanSanityChecker, validation_enabled
     from trino_tpu.planner.stats import StatsCalculator
 
     from trino_tpu.planner.iterative import run_default
 
-    root = push_down_predicates(root)
-    root = push_into_scans(root)
+    # Reference: PlanSanityChecker.validateIntermediatePlan after every
+    # optimizer stage — a broken rewrite fails fast, typed, at plan time.
+    validate = validation_enabled(session)
+
+    def checked(stage: str, node: P.PlanNode) -> P.PlanNode:
+        if validate:
+            PlanSanityChecker.validate_intermediate(node, stage)
+        return node
+
+    root = checked("push_down_predicates", push_down_predicates(root))
+    root = checked("push_into_scans", push_into_scans(root))
     # iterative rule tier (Memo + pattern rules): simplification, limit
     # merging/TopN creation, connector applyLimit/applyTopN/applyAggregation
-    root = run_default(root, session, catalogs)
+    root = checked("iterative_rules", run_default(root, session, catalogs))
     stats = StatsCalculator(catalogs)
     if session.get("join_reordering_strategy") == "AUTOMATIC":
-        root = reorder_joins(root, stats, session)
-    root = determine_join_distribution(root, stats, session)
+        root = checked("reorder_joins", reorder_joins(root, stats, session))
+    root = checked(
+        "determine_join_distribution",
+        determine_join_distribution(root, stats, session),
+    )
     root = prune_columns(root)
+    if validate:
+        PlanSanityChecker.validate_final(root, "prune_columns")
     return root
 
 

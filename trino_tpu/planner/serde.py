@@ -416,11 +416,18 @@ def fragment_to_json(f: PlanFragment) -> dict:
     }
 
 
-def fragment_from_json(d: dict) -> PlanFragment:
-    return PlanFragment(
+def fragment_from_json(d: dict, validate: bool = False) -> PlanFragment:
+    frag = PlanFragment(
         d["id"],
         node_from_json(d["root"]),
         Partitioning(d["partitioning"]["kind"], tuple(d["partitioning"]["keys"])),
         d["output_exchange"],
         [_sym_from(s) for s in d["output_keys"]],
     )
+    if validate:
+        # worker-side trust boundary: a fragment off the wire gets the same
+        # sanity battery as the coordinator-side plan before it executes
+        from trino_tpu.planner.sanity import PlanSanityChecker
+
+        PlanSanityChecker.validate_deserialized(frag)
+    return frag

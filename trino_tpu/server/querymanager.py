@@ -128,12 +128,17 @@ class ManagedQuery:
         except Exception as e:  # noqa: BLE001 — any failure fails the query
             from trino_tpu.analyzer import SemanticError
             from trino_tpu.memory import ExceededMemoryLimitError
+            from trino_tpu.planner.sanity import PlanValidationError
             from trino_tpu.sql.lexer import SqlSyntaxError
 
             if isinstance(e, SqlSyntaxError):
                 code, name, typ = 1, "SYNTAX_ERROR", "USER_ERROR"
             elif isinstance(e, SemanticError):
                 code, name, typ = 2, "SEMANTIC_ERROR", "USER_ERROR"
+            elif isinstance(e, PlanValidationError):
+                # a sanity checker rejected the plan: an engine bug, not a
+                # user error — name the checker in the /v1/query error
+                code, name, typ = 65537, "PLAN_VALIDATION_ERROR", "INTERNAL_ERROR"
             elif isinstance(e, ExceededMemoryLimitError):
                 code, name, typ = 131075, "EXCEEDED_MEMORY_LIMIT", "INSUFFICIENT_RESOURCES"
             elif isinstance(e, KeyError):

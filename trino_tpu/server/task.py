@@ -606,14 +606,6 @@ class SqlTask:
         self.error: Optional[str] = None
         self.created = time.time()
         self.fragment_id = payload["fragment"]["id"]
-        from trino_tpu.planner.serde import fragment_from_json
-
-        self.fragment: PlanFragment = fragment_from_json(payload["fragment"])
-        self.splits: dict[str, list[dict]] = payload.get("splits", {})
-        self.sources: dict[int, dict] = {
-            int(k): v for k, v in payload.get("sources", {}).items()
-        }
-        self.n_output_partitions = payload.get("output_partitions", 1)
         s = payload.get("session", {})
         self.session = Session(
             user=s.get("user", "worker"),
@@ -622,6 +614,17 @@ class SqlTask:
         )
         for k, v in s.get("properties", {}).items():
             self.session.properties[k] = v
+        from trino_tpu.planner.sanity import validation_enabled
+        from trino_tpu.planner.serde import fragment_from_json
+
+        self.fragment: PlanFragment = fragment_from_json(
+            payload["fragment"], validate=validation_enabled(self.session)
+        )
+        self.splits: dict[str, list[dict]] = payload.get("splits", {})
+        self.sources: dict[int, dict] = {
+            int(k): v for k, v in payload.get("sources", {}).items()
+        }
+        self.n_output_partitions = payload.get("output_partitions", 1)
         # interpreter fallback runs single-node on this fragment
         self.session.properties["execution_mode"] = "local"
         try:
