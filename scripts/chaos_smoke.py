@@ -25,6 +25,12 @@ Q1 = """select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
        group by l_returnflag, l_linestatus
        order by l_returnflag, l_linestatus"""
 
+# skewed partitioned join: least() collapses ~93% of order rows onto one
+# join key, so the heavy-hitter path (ops/skew.py + salted exchange) and
+# fault injection are exercised together
+Q_SKEW = """select count(*) as c, sum(o.o_totalprice * c.c_custkey) as chk
+       from orders o join customer c on least(o.o_custkey, 100) = c.c_custkey"""
+
 
 def main() -> int:
     # default seed 3: both partitions of Q1's scan fragment draw below
@@ -38,9 +44,14 @@ def main() -> int:
         "retry_initial_delay_ms": 20,
         "retry_max_delay_ms": 200,
     }
+    skew_props = {"join_distribution_type": "PARTITIONED"}
     with MultiProcessQueryRunner(n_workers=2) as runner:
         clean, _ = runner.execute(Q1)
         chaotic, _ = runner.execute(Q1, session_properties=chaos)
+        skew_clean, _ = runner.execute(Q_SKEW, session_properties=skew_props)
+        skew_chaotic, _ = runner.execute(
+            Q_SKEW, session_properties={**chaos, **skew_props}
+        )
         from trino_tpu.server import auth
 
         req = urllib.request.Request(
@@ -53,9 +64,12 @@ def main() -> int:
     if chaotic != clean:
         print("FAIL: chaotic result differs from fault-free result")
         return 1
+    if skew_chaotic != skew_clean:
+        print("FAIL: skewed-join chaotic result differs from fault-free")
+        return 1
     if retries == 0:
         print("WARN: no retries at this seed — injection never fired")
-    print("OK: bit-identical under 30% task-crash injection")
+    print("OK: bit-identical under 30% task-crash injection (incl. skewed join)")
     return 0
 
 

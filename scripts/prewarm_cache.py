@@ -1,0 +1,114 @@
+"""Pre-warm the persistent JAX compile cache (ROADMAP tier-1 runtime item).
+
+Traces and compiles the fragment/kernel shapes the test suite hits most —
+scan→agg, partitioned join (skewed and plain), streaming group-by — so a
+CI rerun that points ``JAX_COMPILATION_CACHE_DIR`` at the same directory
+skips those compiles. Run from the repo root:
+
+    JAX_COMPILATION_CACHE_DIR=.jax_cache python scripts/prewarm_cache.py
+
+The suite's conftest honors the same variable, so tests reuse the warmed
+entries. Idempotent: re-running only adds missing entries.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def main() -> None:
+    import jax
+
+    cache_dir = os.path.abspath(
+        os.environ.get("JAX_COMPILATION_CACHE_DIR") or ".jax_cache"
+    )
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # write EVERY compile: the suite reads entries regardless of its own
+    # write threshold, and CPU-CI compiles are individually fast but
+    # collectively the tier-1 tail
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    import numpy as np
+
+    from trino_tpu import types as T  # noqa: F401 — import applies config
+
+    # trino_tpu's import hook re-applies cache config; restore ours after
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    from trino_tpu import types as T
+    from trino_tpu.columnar import Batch, Column
+    from trino_tpu.config import Session
+    from trino_tpu.connectors.api import ColumnSchema, TableSchema
+    from trino_tpu.testing import LocalQueryRunner
+
+    t0 = time.time()
+    runner = LocalQueryRunner()
+    mem = runner.catalogs.get("memory")
+    rng = np.random.default_rng(0)
+    n = 1 << 16
+    keys = (rng.zipf(1.2, size=6 * n)[: 6 * n] % 64 + 1)[:n].astype(np.int64)
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    mem.create_table(
+        "default", "warm_facts",
+        TableSchema("warm_facts", (ColumnSchema("k", T.BIGINT),
+                                   ColumnSchema("v", T.BIGINT))),
+    )
+    mem.insert("default", "warm_facts",
+               Batch([Column(T.BIGINT, keys), Column(T.BIGINT, vals)], n))
+    dk = np.arange(1, 65, dtype=np.int64)
+    mem.create_table(
+        "default", "warm_dims",
+        TableSchema("warm_dims", (ColumnSchema("k", T.BIGINT),
+                                  ColumnSchema("name", T.BIGINT))),
+    )
+    mem.insert("default", "warm_dims",
+               Batch([Column(T.BIGINT, dk), Column(T.BIGINT, dk * 10)], 64))
+
+    shapes = [
+        # scan -> global agg (single exchange)
+        ("select count(*), sum(v) from memory.default.warm_facts", {}),
+        # scan -> group-by (hash exchange + final agg)
+        ("select k, sum(v) from memory.default.warm_facts group by k", {}),
+        # partitioned join, skew path on (detect + salt programs)
+        ("select sum(f.v * d.name) from memory.default.warm_facts f "
+         "join memory.default.warm_dims d on f.k = d.k",
+         {"join_distribution_type": "PARTITIONED"}),
+        # same join, plain two-tier path
+        ("select sum(f.v * d.name) from memory.default.warm_facts f "
+         "join memory.default.warm_dims d on f.k = d.k",
+         {"join_distribution_type": "PARTITIONED", "skew_handling": False}),
+        # TPC-H tiny shapes the suites lean on
+        ("select l_returnflag, sum(l_quantity) from tpch.tiny.lineitem "
+         "group by l_returnflag", {}),
+    ]
+    for sql, props in shapes:
+        for mode in ("local", "distributed"):
+            s = Session(properties={"execution_mode": mode, **props})
+            try:
+                runner.engine.execute_statement(sql, s)
+                print(f"warmed [{mode}] {sql.split(chr(10))[0][:60]}")
+            except Exception as e:  # noqa: BLE001 — warm what we can
+                print(f"skip   [{mode}] {type(e).__name__}: {e}")
+    n_entries = (
+        len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
+    )
+    print(
+        f"cache dir {cache_dir}: {n_entries} entries, "
+        f"{time.time() - t0:.1f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -26,8 +26,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", _platform)
 
 # Persistent compile cache: shape-bucketed SQL workloads recompile heavily;
-# caching across runs keeps the suite wall time honest.
-_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+# caching across runs keeps the suite wall time honest. CI points
+# JAX_COMPILATION_CACHE_DIR at a pre-warmed dir (scripts/prewarm_cache.py).
+_cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+    os.path.dirname(__file__), "..", ".jax_cache"
+)
 try:
     jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)

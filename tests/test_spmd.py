@@ -52,6 +52,34 @@ Q10 = """select c_custkey, c_name,
        order by revenue desc limit 20"""
 
 
+def _backend_has_multiprocess_collectives() -> bool:
+    """CPU backends only span processes when a cross-process CPU
+    collectives implementation (Gloo/MPI) is configured; without one,
+    jax.distributed fails with "Multiprocess computations aren't
+    implemented on the CPU backend". TPU/GPU backends always have it."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return True
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value in (
+            "gloo",
+            "mpi",
+        ) or bool(xla_bridge._CPU_ENABLE_GLOO_COLLECTIVES.value)
+    except Exception:  # noqa: BLE001 — unknown jax layout: let tests try
+        return True
+
+
+requires_multiprocess_collectives = pytest.mark.skipif(
+    not _backend_has_multiprocess_collectives(),
+    reason="Multiprocess computations aren't implemented on the CPU "
+    "backend without Gloo/MPI collectives "
+    "(set jax_cpu_collectives_implementation=gloo)",
+)
+
+
 @pytest.fixture(scope="module")
 def spmd_cluster():
     with MultiProcessQueryRunner(n_workers=2, spmd=True) as runner:
@@ -71,6 +99,7 @@ def check(cluster, local, sql):
     )
 
 
+@requires_multiprocess_collectives
 class TestSpmdQueries:
     def test_q1(self, spmd_cluster, local):
         check(spmd_cluster, local, Q1)

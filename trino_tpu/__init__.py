@@ -29,9 +29,13 @@ def _enable_compile_cache() -> None:
     try:
         import jax
 
-        cache = os.environ.get(
-            "TRINO_TPU_COMPILE_CACHE",
-            os.path.join(os.path.expanduser("~"), ".cache", "trino_tpu_xla"),
+        # JAX_COMPILATION_CACHE_DIR (the upstream variable; CI points it at
+        # a dir pre-warmed by scripts/prewarm_cache.py) wins over the
+        # package-specific override and the home-dir default
+        cache = (
+            os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or os.environ.get("TRINO_TPU_COMPILE_CACHE")
+            or os.path.join(os.path.expanduser("~"), ".cache", "trino_tpu_xla")
         )
         if cache:
             jax.config.update("jax_compilation_cache_dir", cache)

@@ -116,6 +116,16 @@ class Session:
         # per-task output buffer cap; TASK retry retains delivered pages
         # (materialized exchange), so give it headroom
         ("exchange_buffer_bytes", 64 << 20),
+        # --- skew-aware exchange (ops/skew.py, parallel/exchange.py) ------
+        # detect heavy-hitter join keys and route them on a salted path
+        # (hot build keys replicated, hot probe rows kept local)
+        ("skew_handling", True),
+        # seed _Caps defaults from planner/stats.py estimates per
+        # exchange/join/agg site (provenance recorded in /v1/query)
+        ("stats_capacity_seeding", True),
+        ("skew_hot_k", 16),  # top-k candidates per shard in the sketch
+        # hot iff global count > frac * (total_rows / n_shards)
+        ("skew_hot_threshold_frac", 0.5),
     )
 
     def get(self, name: str) -> Any:

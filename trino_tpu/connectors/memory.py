@@ -10,8 +10,23 @@ from __future__ import annotations
 
 from typing import Sequence
 
-from trino_tpu.columnar import Batch, concat_batches
+from trino_tpu.columnar import Batch, Column, concat_batches
 from trino_tpu.connectors.api import Connector, Split, TableSchema
+
+
+def _slice_rows(b: Batch, lo: int, hi: int) -> Batch:
+    """Row-range view [lo, hi) of a stored batch (host-side slicing; row
+    slices on axis 0 cover wide-decimal 2-D lanes too)."""
+    cols = [
+        Column(
+            c.type,
+            c.data[lo:hi],
+            None if c.valid is None else c.valid[lo:hi],
+            c.dictionary,
+        )
+        for c in b.columns
+    ]
+    return Batch(cols, hi - lo, None if b.sel is None else b.sel[lo:hi])
 
 
 class MemoryConnector(Connector):
@@ -145,23 +160,63 @@ class MemoryConnector(Connector):
 
     def get_splits(self, schema, table, target_splits, constraint=None):
         parts = self._data.get((schema, table), [])
-        n = max(1, len(parts))
-        splits = [Split(table, i, n) for i in range(n)]
+        if not parts:
+            return self.prune_splits(
+                schema, table, [Split(table, 0, 1)], constraint
+            )
+        # subdivide large stored batches into row ranges so a table built
+        # from one big INSERT still fans out across target_splits workers
+        # (without this, a 2M-row single-part table lands on one shard and
+        # every other shard pads to its full capacity)
+        total = sum(b.num_rows for b in parts)
+        chunk = max(4096, -(-total // max(1, target_splits)))
+        ranges: list[tuple[int, int, int]] = []
+        for i, b in enumerate(parts):
+            lo = 0
+            while True:
+                hi = min(b.num_rows, lo + chunk)
+                ranges.append((i, lo, hi))
+                lo = hi
+                if lo >= b.num_rows:
+                    break
+        splits = [
+            Split(table, j, len(ranges), info=r)
+            for j, r in enumerate(ranges)
+        ]
         return self.prune_splits(schema, table, splits, constraint)
 
+    @staticmethod
+    def _split_range(split, parts):
+        """(part_index, row_lo, row_hi) for a split; legacy splits without
+        ``info`` cover their whole stored batch. Accepts a list too: the
+        cluster wire round-trips ``info`` through JSON."""
+        if isinstance(split.info, (tuple, list)) and len(split.info) == 3:
+            part, lo, hi = split.info
+            return int(part), int(lo), int(hi)
+        i = split.index
+        return i, 0, parts[i].num_rows if i < len(parts) else 0
+
     def split_stats(self, schema, table, split):
-        """Per-stored-batch min/max over numeric/date columns, computed
-        lazily and cached (reference: MemoryMetadata#getTableStatistics)."""
+        """Per-split (stored-batch row range) min/max over numeric/date
+        columns, computed lazily and cached (reference:
+        MemoryMetadata#getTableStatistics)."""
         parts = self._data.get((schema, table))
-        if not parts or split.index >= len(parts):
+        if not parts:
+            return None
+        part, lo, hi = self._split_range(split, parts)
+        if part >= len(parts):
             return None
         cache = self._stats.setdefault((schema, table), {})
-        if split.index not in cache:
+        key = (part, lo, hi)
+        if key not in cache:
             from trino_tpu.connectors.api import batch_column_stats
 
             ts = self._tables[(schema, table)]
-            cache[split.index] = batch_column_stats(ts.columns, parts[split.index])
-        return cache[split.index]
+            b = parts[part]
+            if (lo, hi) != (0, b.num_rows):
+                b = _slice_rows(b, lo, hi)
+            cache[key] = batch_column_stats(ts.columns, b)
+        return cache[key]
 
     def read_split(self, schema, table, columns: Sequence[str], split):
         ts = self._tables[(schema, table)]
@@ -178,6 +233,9 @@ class MemoryConnector(Connector):
                 for c in columns
             ]
             return Batch(cols, 0)
-        b = parts[split.index]
+        part, lo, hi = self._split_range(split, parts)
+        b = parts[part]
+        if (lo, hi) != (0, b.num_rows):
+            b = _slice_rows(b, lo, hi)
         cols = [b.columns[name_to_idx[c]] for c in columns]
         return Batch(cols, b.num_rows, b.sel)

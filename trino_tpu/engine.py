@@ -43,6 +43,9 @@ class StatementResult:
     # cluster-mode retry/attempt counters (trino_tpu/ft): retry_policy,
     # task_retries, task_attempts, query_attempts — surfaced in /v1/query
     cluster_stats: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # skew-aware exchange counters (shuffle rows/bytes, padding ratio,
+    # overflow retries, hot/salted keys) — surfaced in /v1/query
+    exchange_stats: Optional[dict[str, Any]] = None
 
 
 class Engine:
@@ -356,12 +359,19 @@ class Engine:
             executor = self._executor(session, ctx, programs=programs)
             executor.stats_collector = collector
             batch, names = executor.execute(plan)
+            snap = getattr(executor, "exchange_stats_snapshot", None)
+            exchange_stats = snap() if callable(snap) else (
+                dict(executor.exchange_stats)
+                if getattr(executor, "exchange_stats", None)
+                else None
+            )
             return StatementResult(
                 batch.to_pylist(),
                 names,
                 [c.type for c in batch.columns],
                 peak_memory_bytes=ctx.peak_bytes,
                 dynamic_filters=len(executor.dynamic_filters),
+                exchange_stats=exchange_stats,
             )
         finally:
             ctx.close()

@@ -146,7 +146,9 @@ def grow_or_raise(name: str, caps: "_Caps") -> None:
     rows) and fail the query."""
     if name.startswith("err!"):
         raise ExecutionError(name[4:])
-    caps.grow(name, 4 if name.startswith("agg") else 2)
+    # spill/hot tiers are deliberately small (the cold bucket absorbs the
+    # common case), so when they do overflow, converge in few retries
+    caps.grow(name, 4 if name.startswith(("agg", "spill", "hot")) else 2)
 
 
 def query_fusable(sub: SubPlan) -> bool:
@@ -209,16 +211,47 @@ def fragment_fusable(frag: PlanFragment) -> bool:
 
 
 class _Caps:
-    """Capacity knobs, grown on overflow (shape-bucketed)."""
+    """Capacity knobs, grown on overflow (shape-bucketed).
+
+    ``provenance`` records where each value came from (``default`` /
+    ``seeded`` from planner stats / ``+grown`` suffix after an overflow
+    retry) — surfaced in the per-query exchange counters so capacity
+    decisions are auditable."""
 
     def __init__(self):
         self.vals: dict[str, int] = {}
+        self.provenance: dict[str, str] = {}
+        self._seed_floor: dict[str, int] = {}
 
     def get(self, name: str, default: int) -> int:
-        return self.vals.setdefault(name, default)
+        if name not in self.vals:
+            floor = self._seed_floor.pop(name, None)
+            if floor is not None and floor > default:
+                self.vals[name] = floor
+                self.provenance[name] = "seeded"
+            else:
+                self.vals[name] = default
+                self.provenance.setdefault(name, "default")
+        return self.vals[name]
+
+    def seed(self, name: str, value: int, floor_only: bool = False) -> None:
+        """Install a stats-derived starting value. ``floor_only`` seeds
+        take effect only when above the site's built-in default (used for
+        join caps, where shrinking below the data-derived default trades a
+        recompile-retry for padding)."""
+        if name in self.vals:
+            return
+        if floor_only:
+            self._seed_floor[name] = value
+        else:
+            self.vals[name] = value
+            self.provenance[name] = "seeded"
 
     def grow(self, name: str, factor: int = 2) -> None:
         self.vals[name] = self.vals[name] * factor
+        prev = self.provenance.get(name, "default")
+        if not prev.endswith("+grown"):
+            self.provenance[name] = prev + "+grown"
 
 
 @dataclasses.dataclass
@@ -229,6 +262,10 @@ class _Meta:
     column_meta: Optional[list[tuple[T.SqlType, Optional[Dictionary]]]] = None
     overflow_names: Optional[list[str]] = None
     output_names: Optional[list[str]] = None
+    # exchange observability: names of traced counters riding the output,
+    # plus statically-known per-execution stats (wire slots, bytes)
+    counter_names: Optional[list[str]] = None
+    exchange_static: Optional[dict] = None
 
     def capture(self, res: Result, tracer) -> None:
         self.layout = dict(res.layout)
@@ -236,12 +273,16 @@ class _Meta:
             (c.type, c.dictionary) for c in res.batch.columns
         ]
         self.overflow_names = [nm for nm, _ in tracer.overflows]
+        self.counter_names = [nm for nm, _ in tracer.counters]
+        self.exchange_static = dict(tracer.exchange_static)
         self._tracer = tracer
 
     def outputs(self, res: Result):
         flags = tuple(f for _, f in self._tracer.overflows)
+        counters = tuple(c for _, c in self._tracer.counters)
+        aux = tuple(self._tracer.aux_out)
         data = tuple((c.data, c.valid) for c in res.batch.columns)
-        return data, res.batch.selection_mask(), flags
+        return data, res.batch.selection_mask(), flags, counters, aux
 
 
 class FragmentedExecutor(DistributedExecutor):
@@ -257,10 +298,15 @@ class FragmentedExecutor(DistributedExecutor):
     # overflow flags queued during _execute_fragments (None outside it,
     # e.g. when worker tasks call run_fragment_program directly)
     deferred_flags: Optional[list] = None
+    # exchange counters queued alongside: (names, stacked int64, static)
+    deferred_counters: Optional[list] = None
 
     def __init__(self, *args, programs: Optional[dict] = None, **kwargs):
         super().__init__(*args, **kwargs)
         self.programs: dict = {} if programs is None else programs
+        # per-query: replicated hot-key tables exported by probe-side
+        # exchanges, keyed by producer fragment id (device arrays)
+        self._hot_sets: dict[int, tuple] = {}
         # chaos hook (trino_tpu/ft): per-fragment crash injection. None
         # unless the session configures fault probabilities.
         from trino_tpu.ft.injection import FaultInjector
@@ -302,6 +348,157 @@ class FragmentedExecutor(DistributedExecutor):
                     out[f"{scope}:{nm}"] = v
         return out
 
+    # === skew / stats-seeding / observability ===========================
+
+    def _skew_roles(self) -> dict[int, dict]:
+        """Map producer-fragment id -> skew role for every partitioned
+        (hash/hash) equi-join. The fragmenter cuts ``Join.left`` before
+        ``Join.right``, so the probe producer always executes first — its
+        exchange detects heavy hitters over the probe-side key hashes
+        (build sides are typically near-unique, so probe frequencies are
+        where Zipf skew is visible) and the build producer salts with the
+        resulting hot set. SEMI/ANTI and single-row joins are left on the
+        plain two-tier path."""
+        roles = self.programs.get("__skewroles__")
+        if roles is None:
+            roles = {}
+            sub = self.programs.get("__subplan__")
+            if sub is not None and bool(self.session.get("skew_handling")):
+                for frag in sub.all_fragments():
+                    for node in P.walk_plan(frag.root):
+                        if (
+                            isinstance(node, P.Join)
+                            and node.join_type in ("INNER", "LEFT")
+                            and node.criteria
+                            and not node.single_row
+                            and isinstance(node.left, P.RemoteSource)
+                            and node.left.exchange_type == "hash"
+                            and isinstance(node.right, P.RemoteSource)
+                            and node.right.exchange_type == "hash"
+                        ):
+                            roles[node.left.fragment_id] = {"role": "probe"}
+                            roles[node.right.fragment_id] = {
+                                "role": "build",
+                                "peer": node.left.fragment_id,
+                            }
+            self.programs["__skewroles__"] = roles
+        return roles
+
+    def _seed_caps(self, frag: PlanFragment, caps: "_Caps") -> None:
+        """Stats-seeded capacity defaults: planner NDV/row-count estimates
+        pick realistic starting buckets per agg/join/exchange site, so
+        cold runs skip the overflow-retry-recompile ladder. Site names use
+        the (possibly dynamic-filter-rewritten) node ids of THIS trace, so
+        stats are computed over the rewritten root; upstream fragment
+        cardinalities come from the once-per-plan subplan stats."""
+        if not bool(self.session.get("stats_capacity_seeding")):
+            return
+        try:
+            from trino_tpu.planner import stats as PStats
+
+            sub = self.programs.get("__subplan__")
+            root_stats = self.programs.get("__fragstats__")
+            if root_stats is None and sub is not None:
+                root_stats = PStats.fragment_output_stats(sub, self.catalogs)
+                self.programs["__fragstats__"] = root_stats
+            calc = PStats.FragmentStatsCalculator(
+                self.catalogs, root_stats or {}
+            )
+            n = max(int(self.mesh.devices.size), 1)
+            for node in P.walk_plan(frag.root):
+                if isinstance(node, P.Aggregate) and node.group_keys:
+                    est = calc.stats(node).row_count
+                    if est and est > 0:
+                        groups = est / n if node.step == "final" else est
+                        caps.seed(
+                            f"agg{id(node)}",
+                            min(
+                                1 << 16,
+                                bucket_capacity(
+                                    max(256, int(4 * groups)), minimum=256
+                                ),
+                            ),
+                            floor_only=True,
+                        )
+                elif (
+                    isinstance(node, P.Join)
+                    and node.criteria
+                    and node.join_type in ("INNER", "LEFT")
+                    and not node.single_row
+                ):
+                    est = calc.stats(node).row_count
+                    if est and est > 0:
+                        caps.seed(
+                            f"join{id(node)}",
+                            min(
+                                1 << 20,
+                                bucket_capacity(
+                                    max(1024, int(4 * est) // n),
+                                    minimum=1024,
+                                ),
+                            ),
+                            floor_only=True,
+                        )
+            if frag.output_exchange == "hash":
+                est = calc.stats(frag.root).row_count
+                if est and est > 0:
+                    # floor_only everywhere: stats may pre-grow a site the
+                    # retry ladder would otherwise have to discover, but
+                    # never shrink an engineered default — estimates miss
+                    # per-shard amplification (partial-agg outputs exceed
+                    # the fragment's global row count) and a low seed
+                    # re-creates the overflow-retry-recompile ladder.
+                    # Salted exchanges route the heavy mass off the cold
+                    # path, so their cold seed is half the plain one
+                    # (mirrors the salted default in apply_output_exchange)
+                    mult = 1 if frag.id in self._skew_roles() else 2
+                    caps.seed(
+                        f"exch{frag.id}",
+                        bucket_capacity(
+                            max(64, int(mult * est) // (n * n)), minimum=64
+                        ),
+                        floor_only=True,
+                    )
+        except Exception:  # noqa: BLE001 — seeding is best-effort
+            pass
+
+    def _accumulate_exchange(self, names, vals, static) -> None:
+        st = self.exchange_stats
+        for k, v in (static or {}).items():
+            st[k] = st.get(k, 0) + v
+        for nm, v in zip(names or (), vals):
+            if nm.startswith("sent"):
+                st["shuffle_rows"] += int(v)
+            elif nm.startswith("salted"):
+                st["salted_rows"] += int(v)
+            elif nm.startswith("hotkeys"):
+                st["hot_keys"] += int(v)
+
+    def exchange_stats_snapshot(self) -> dict:
+        """Finalized per-query exchange counters (engine attaches this to
+        the statement result; /v1/query serves it as ``exchangeStats``)."""
+        st = dict(self.exchange_stats)
+        st["padding_ratio"] = round(
+            st.get("padded_shuffle_rows", 0) / max(1, st.get("shuffle_rows", 0)),
+            4,
+        )
+        caps: dict[str, dict] = {}
+        for key, val in self.programs.items():
+            if (
+                isinstance(key, tuple)
+                and key
+                and key[0] == "caps"
+                and isinstance(val, _Caps)
+            ):
+                scope = ".".join(str(k) for k in key[1:])
+                for nm, v in val.vals.items():
+                    caps[f"{scope}:{nm}"] = {
+                        "value": v,
+                        "provenance": val.provenance.get(nm, "default"),
+                    }
+        st["capacities"] = caps
+        return st
+
     # === fragment scheduling ============================================
 
     def _execute_fragments(self, sub: SubPlan) -> tuple[Batch, list[str]]:
@@ -339,8 +536,10 @@ class FragmentedExecutor(DistributedExecutor):
                     attempts=attempts - 1,
                 )
             self.deferred_flags = []
+            self.deferred_counters = []
             results.clear()
             names_holder.clear()
+            self._hot_sets.clear()
             run(sub)
             root = results[sub.fragment.id]
             if jax.process_count() > 1:
@@ -353,15 +552,19 @@ class FragmentedExecutor(DistributedExecutor):
                 )(root.batch)
                 root = Result(rep, root.layout)
             deferred = self.deferred_flags
+            dcounters = self.deferred_counters
             self.deferred_flags = None
-            # the overflow flags ride the SAME packed pull as the root
-            # batch (optimistic: the output of an overflowed run is
-            # discarded and the query reruns with grown budgets)
-            host_root, flag_vals = root.batch.to_host(
-                extras=[
-                    jnp.ravel(f.astype(jnp.int32)) for _, _, f, _ in deferred
-                ]
-            )
+            self.deferred_counters = None
+            # the overflow flags (and exchange counters) ride the SAME
+            # packed pull as the root batch (optimistic: the output of an
+            # overflowed run is discarded and the query reruns with grown
+            # budgets; counters only accumulate on the surviving attempt)
+            extras = [
+                jnp.ravel(f.astype(jnp.int32)) for _, _, f, _ in deferred
+            ] + [jnp.ravel(c) for _, c, _ in dcounters if c is not None]
+            host_root, extra_vals = root.batch.to_host(extras=extras)
+            flag_vals = extra_vals[: len(deferred)]
+            counter_vals = list(extra_vals[len(deferred):])
             overflowed = False
             for (key, names, _, caps), seg in zip(deferred, flag_vals):
                 seg = np.atleast_1d(np.asarray(seg))
@@ -372,8 +575,16 @@ class FragmentedExecutor(DistributedExecutor):
                 if seg.any() and key is not None:
                     self.programs.pop(key, None)
             if not overflowed:
+                for names, stacked, static in dcounters:
+                    vals = (
+                        np.atleast_1d(np.asarray(counter_vals.pop(0)))
+                        if stacked is not None
+                        else ()
+                    )
+                    self._accumulate_exchange(names, vals, static)
                 root = Result(host_root, root.layout)
                 break
+            self.exchange_stats["overflow_retries"] += 1
         out = root.batch.compact()
         names = names_holder.get(sub.fragment.id) or [
             s.name for s in sub.fragment.root.output_symbols
@@ -453,10 +664,33 @@ class FragmentedExecutor(DistributedExecutor):
                 input_layouts[f"remote{n.fragment_id}"] = res.layout
             elif isinstance(n, P.Output):
                 names_holder[frag.id] = list(n.column_names)
+        # skew handling: the probe-side producer of a partitioned join
+        # detects heavy hitters inside its exchange program and exports
+        # the hot-key tables; the build-side producer (which runs after
+        # it) receives them as a traced input and salts its exchange
+        skew = None
+        role = self._skew_roles().get(frag.id)
+        if role is not None:
+            if role["role"] == "probe":
+                skew = {
+                    "detect": (
+                        max(1, int(self.session.get("skew_hot_k"))),
+                        float(self.session.get("skew_hot_threshold_frac")),
+                    )
+                }
+            else:
+                hs = self._hot_sets.get(role["peer"])
+                if hs is not None:
+                    skew = {"salt": True}
+                    inputs["__hotset__"] = (hs[0], hs[1])
         sink = {} if self.stats_collector is not None else None
         out = self.run_fragment_program(
-            frag, inputs, input_layouts, stats_sink=sink, defer=True
+            frag, inputs, input_layouts, stats_sink=sink, defer=True,
+            skew=skew,
         )
+        aux = getattr(self, "_last_aux", ())
+        if aux:
+            self._hot_sets[frag.id] = aux
         if self.stats_collector is not None:
             self.stats_collector.record_fragment(
                 frag.id,
@@ -596,6 +830,7 @@ class FragmentedExecutor(DistributedExecutor):
         cached = (
             self.programs.get(program_key) if program_key is not None else None
         )
+        self._last_aux = ()
         attempts = 0
         while True:
             attempts += 1
@@ -619,12 +854,28 @@ class FragmentedExecutor(DistributedExecutor):
                 meta = _Meta()
                 jf = jax.jit(build_fn(meta))
             t0 = _time.perf_counter()
-            data, sel, flags = jf(*args)
+            data, sel, flags, counters, aux = jf(*args)
+            self._last_aux = aux
             if defer and getattr(self, "deferred_flags", None) is not None:
                 if flags:
                     stacked = jnp.stack([jnp.reshape(f, ()) for f in flags])
                     self.deferred_flags.append(
                         (program_key, list(meta.overflow_names), stacked, caps)
+                    )
+                if (counters or meta.exchange_static) and getattr(
+                    self, "deferred_counters", None
+                ) is not None:
+                    cstack = (
+                        jnp.stack([jnp.reshape(c, ()) for c in counters])
+                        if counters
+                        else None
+                    )
+                    self.deferred_counters.append(
+                        (
+                            list(meta.counter_names),
+                            cstack,
+                            dict(meta.exchange_static),
+                        )
                     )
                 if program_key is not None:
                     self.programs[program_key] = (jf, meta)
@@ -650,7 +901,23 @@ class FragmentedExecutor(DistributedExecutor):
             if not any(flags_np):
                 if program_key is not None:
                     self.programs[program_key] = (jf, meta)
+                if counters or meta.exchange_static:
+                    vals = (
+                        np.atleast_1d(
+                            np.asarray(
+                                jnp.stack(
+                                    [jnp.reshape(c, ()) for c in counters]
+                                )
+                            )
+                        )
+                        if counters
+                        else ()
+                    )
+                    self._accumulate_exchange(
+                        meta.counter_names, vals, meta.exchange_static
+                    )
                 break
+            self.exchange_stats["overflow_retries"] += 1
             for nm, f in zip(meta.overflow_names, flags_np):
                 if f:
                     grow_or_raise(nm, caps)
@@ -671,6 +938,7 @@ class FragmentedExecutor(DistributedExecutor):
         apply_exchange: bool = True,
         stats_sink: Optional[dict] = None,
         defer: bool = False,
+        skew: Optional[dict] = None,
     ) -> Result:
         """Compile + run one fragment as a single jitted SPMD program.
 
@@ -678,13 +946,20 @@ class FragmentedExecutor(DistributedExecutor):
         device batches. With ``apply_exchange=False`` the fragment's output
         exchange is skipped — callers that ship pages across processes
         (worker tasks) partition on the host instead. ``stats_sink``
-        receives per-fragment compile/run timings when provided.
+        receives per-fragment compile/run timings when provided. ``skew``
+        configures the output exchange's skew handling (see
+        ``_FragmentTracer.apply_output_exchange``); the hot-key tables
+        themselves travel as the ``__hotset__`` input so cached programs
+        never bake a stale hot set in as constants.
         """
         caps = self.programs.setdefault(("caps", frag.id), _Caps())
+        self._seed_caps(frag, caps)
 
         def build(meta: _Meta):
             def fn(inp: dict[str, Batch]):
-                tracer = _FragmentTracer(self, inp, input_layouts, caps)
+                tracer = _FragmentTracer(
+                    self, inp, input_layouts, caps, skew=skew
+                )
                 res = tracer._exec(frag.root)
                 if apply_exchange:
                     res = tracer.apply_output_exchange(frag, res)
@@ -698,7 +973,9 @@ class FragmentedExecutor(DistributedExecutor):
             build,
             (inputs,),
             stats_sink=stats_sink,
-            input_rows=sum(b.capacity for b in inputs.values()),
+            input_rows=sum(
+                b.capacity for b in inputs.values() if isinstance(b, Batch)
+            ),
             # the rewritten root's identity is part of the key: dynamic
             # filtering rebuilds fragment nodes per attempt, and a program
             # traced against old node ids must not serve new inputs (the
@@ -794,12 +1071,26 @@ class _FragmentTracer(DistributedExecutor):
     capacities come from the shared :class:`_Caps`, and data-dependent
     overflow is reported via traced flags instead of host retries."""
 
-    def __init__(self, base: DistributedExecutor, inputs, input_layouts, caps):
+    def __init__(
+        self,
+        base: DistributedExecutor,
+        inputs,
+        input_layouts,
+        caps,
+        skew: Optional[dict] = None,
+    ):
         super().__init__(base.catalogs, base.session, base.mesh, memory_ctx=None)
         self._inputs = inputs
         self._input_layouts = input_layouts
         self.caps = caps
+        self.skew = skew or {}
         self.overflows: list[tuple[str, jax.Array]] = []
+        # exchange observability: traced int64 scalars pulled with the
+        # overflow flags, plus statically-known wire-slot accounting
+        self.counters: list[tuple[str, jax.Array]] = []
+        self.exchange_static: dict[str, int] = {}
+        # replicated hot-key tables exported for the peer build exchange
+        self.aux_out: tuple = ()
         self._memo: dict[int, Result] = {}
 
     @property
@@ -1655,16 +1946,73 @@ class _FragmentTracer(DistributedExecutor):
             return Result(
                 Batch(cols, cols[0].data.shape[0], out_sel), res.layout
             )
-        # hash: repartition by output key hash
+        # hash: two-tier repartition by output key hash — a small cold
+        # bucket per (src,dst) plus a shared spill tier, optionally with a
+        # salted hot region for heavy-hitter keys (see exchange.py)
         key_pairs = [res.pair(s) for s in frag.output_keys]
         khash, _ = J.hash_keys(key_pairs)
+        n = max(self.n, 1)
+        detect = self.skew.get("detect")
+        hot_set = (
+            self._inputs.get("__hotset__") if self.skew.get("salt") else None
+        )
+        salted = detect is not None or hot_set is not None
+        # cold tier: ~2x the uniform per-(src,dst) share; when a hot set
+        # routes the heavy mass away from the cold path, half that
+        per_pair = b.capacity // max(n * n, 1)
         default_bucket = bucket_capacity(
-            max(256, 2 * b.capacity // max(self.n, 1)), minimum=256
+            max(64, per_pair if salted else 2 * per_pair), minimum=64
         )
         bucket = self.caps.get(f"exch{frag.id}", default_bucket)
-        out, out_sel, ovf = X.hash_repartition(
-            self.mesh, arrays + [khash], khash, sel, bucket
+        spill = self.caps.get(f"spill{frag.id}", max(64, bucket // 2))
+        if detect is not None:
+            # probe side: detect heavy hitters in-program; hot rows stay
+            # on their source shard (zero wire cost), so the hot region
+            # is safely sized at the full per-shard row count
+            hot_mode = "local"
+            hot_cap = self.caps.get(
+                f"hot{frag.id}",
+                bucket_capacity(max(64, b.capacity // n), minimum=64),
+            )
+        elif hot_set is not None:
+            # build side: replicate just the hot slice (partial
+            # broadcast); near-unique build keys make this slice tiny
+            hot_mode = "replicate"
+            hot_cap = self.caps.get(
+                f"hot{frag.id}",
+                bucket_capacity(max(64, per_pair), minimum=64),
+            )
+        else:
+            hot_mode, hot_cap = None, 0
+        out, out_sel, (sp_ovf, hot_ovf), (sent, hot_rows, hot_keys), hotset = (
+            X.skewed_repartition(
+                self.mesh, arrays, khash, sel, bucket, spill,
+                hot_mode=hot_mode, hot_cap=hot_cap, hot_set=hot_set,
+                detect=detect,
+            )
         )
-        self.overflows.append((f"exch{frag.id}", ovf))
+        self.overflows.append((f"spill{frag.id}", sp_ovf))
+        if hot_mode is not None:
+            self.overflows.append((f"hot{frag.id}", hot_ovf))
+            self.counters.append((f"salted{frag.id}", hot_rows))
+        if detect is not None:
+            self.aux_out = hotset
+            self.counters.append((f"hotkeys{frag.id}", hot_keys))
+        self.counters.append((f"sent{frag.id}", sent))
+        # wire accounting is static: slots each source ships per attempt
+        wire_slots = n * bucket + spill + (
+            hot_cap if hot_mode == "replicate" else 0
+        )
+        row_bytes = sum(int(a.dtype.itemsize) for a in arrays)
+        self.exchange_static["exchanges"] = (
+            self.exchange_static.get("exchanges", 0) + 1
+        )
+        self.exchange_static["padded_shuffle_rows"] = (
+            self.exchange_static.get("padded_shuffle_rows", 0) + n * wire_slots
+        )
+        self.exchange_static["shuffle_bytes"] = (
+            self.exchange_static.get("shuffle_bytes", 0)
+            + n * wire_slots * row_bytes
+        )
         cols = rebuild(out)
         return Result(Batch(cols, cols[0].data.shape[0], out_sel), res.layout)

@@ -1,0 +1,112 @@
+"""Heavy-hitter (skew) detection: on-device top-k frequency sketch.
+
+A skewed join key (Zipf customers, hot dates) makes ``hash_repartition``
+size every (src,dst) block for the hottest destination and overflow-retry
+its way up — the cliff described for hash joins in "Design Trade-offs for
+a Robust Dynamic Hybrid Hash Join" (arxiv 2112.02480). The TPU/SPMD
+translation here: a cheap, static-shape sketch run *inside* the existing
+shard_map programs finds keys hot enough to threaten a per-destination
+bucket, so the exchange can route them on a separate path
+(``parallel/exchange.py::skewed_repartition``).
+
+Sketch: each shard sorts its live key hashes, takes its local top-k
+distinct keys by run length, all_gathers the n*k candidates, and psums
+exact global counts for every candidate. A key is *hot* when its global
+count exceeds ``threshold_frac`` of the per-shard fair share
+(``total_rows / n_shards``). The sketch can miss a key only when it is
+outside the top-k of every shard; such a key simply stays on the cold
+path (and is caught by the spill tier), so misses cost padding, never
+correctness.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as PS
+
+from trino_tpu.parallel.mesh import AXIS, smap
+
+# int64 max marks dead rows / empty candidate slots; it sorts last and is
+# excluded from hotness explicitly (dead rows would otherwise form a run)
+_SENTINEL = jnp.iinfo(jnp.int64).max
+
+
+def hot_key_sketch(khash, sel, k: int, threshold_frac: float, axis: str = AXIS):
+    """Per-shard kernel — call inside a shard_map over ``axis``.
+
+    Args:
+      khash: local [m] int64 key hashes (``ops.join.hash_keys`` lane).
+      sel: local [m] bool liveness.
+      k: candidates kept per shard (static).
+      threshold_frac: hot iff global count > frac * total_live / n_shards.
+
+    Returns ``(hot_hashes, hot_valid, n_hot, total_live)``: a sorted
+    candidate table of static shape [n*k] replicated across shards (dupes
+    and cold/empty slots masked by ``hot_valid``), the hot-key count, and
+    the global live-row count (both int64 scalars).
+    """
+    m = khash.shape[0]
+    n = jax.lax.psum(1, axis)
+    skey = jax.lax.sort(
+        (jnp.where(sel, khash, _SENTINEL),), num_keys=1, is_stable=False
+    )[0]
+    pos = jnp.arange(m, dtype=jnp.int32)
+    left = jnp.searchsorted(skey, skey, side="left").astype(jnp.int32)
+    right = jnp.searchsorted(skey, skey, side="right").astype(jnp.int32)
+    # one candidate per distinct key: its first occurrence carries the run
+    # length; everything else competes with count 0
+    cand_count = jnp.where((pos == left) & (skey != _SENTINEL), right - left, 0)
+    neg_sorted, cand = jax.lax.sort((-cand_count, skey), num_keys=1, is_stable=False)
+    kk = min(k, m)
+    top = jnp.where(-neg_sorted[:kk] > 0, cand[:kk], _SENTINEL)
+    if kk < k:
+        top = jnp.concatenate([top, jnp.full((k - kk,), _SENTINEL, dtype=jnp.int64)])
+    gcand = jax.lax.all_gather(top, axis, axis=0, tiled=True)  # [n*k]
+    # exact global count for every candidate (psum of local run lengths)
+    lo = jnp.searchsorted(skey, gcand, side="left")
+    hi = jnp.searchsorted(skey, gcand, side="right")
+    gcount = jax.lax.psum((hi - lo).astype(jnp.int64), axis)
+    total = jax.lax.psum(jnp.sum(sel.astype(jnp.int64)), axis)
+    hot = (gcount.astype(jnp.float64) * n > threshold_frac * total.astype(jnp.float64))
+    hot = hot & (gcand != _SENTINEL)
+    # sort candidates by hash for searchsorted membership; duplicates of a
+    # hash share one global count (and thus one hot flag), so keeping only
+    # the first occurrence loses nothing
+    sh, hflag = jax.lax.sort((gcand, hot.astype(jnp.int32)), num_keys=1, is_stable=False)
+    first = jnp.arange(sh.shape[0], dtype=jnp.int32) == jnp.searchsorted(
+        sh, sh, side="left"
+    ).astype(jnp.int32)
+    hvalid = first & (hflag > 0)
+    n_hot = jnp.sum(hvalid.astype(jnp.int64))
+    return sh, hvalid, n_hot, total
+
+
+def is_hot(hot_hashes, hot_valid, khash):
+    """Membership of each ``khash`` row in the sketch's hot set.
+
+    ``hot_hashes`` must be the sorted table from ``hot_key_sketch`` (first
+    occurrence of each hash carries validity).
+    """
+    idx = jnp.searchsorted(hot_hashes, khash, side="left")
+    idx = jnp.minimum(idx, hot_hashes.shape[0] - 1).astype(jnp.int32)
+    return (hot_hashes[idx] == khash) & hot_valid[idx]
+
+
+def hot_key_hashes(mesh: Mesh, key_hash, sel, k: int, threshold_frac: float):
+    """Eager mesh-level wrapper (interpreter path): sketch over global
+    row-sharded ``key_hash``/``sel``. Returns replicated
+    ``(hot_hashes, hot_valid, n_hot, total_live)``."""
+
+    @partial(
+        smap,
+        mesh=mesh,
+        in_specs=(PS(AXIS), PS(AXIS)),
+        out_specs=(PS(), PS(), PS(), PS()),
+    )
+    def go(khash, s):
+        return hot_key_sketch(khash, s, k, threshold_frac)
+
+    return go(key_hash, sel)

@@ -51,6 +51,18 @@ class DistributedExecutor(LocalExecutor):
     ):
         super().__init__(catalogs, session, memory_ctx=memory_ctx)
         self.mesh = mesh or make_mesh()
+        # per-query exchange observability (surfaced via /v1/query as
+        # exchangeStats); the fused executor adds traced counters, the
+        # interpreter path bumps these host-side
+        self.exchange_stats: dict = {
+            "exchanges": 0,
+            "shuffle_rows": 0,
+            "padded_shuffle_rows": 0,
+            "shuffle_bytes": 0,
+            "hot_keys": 0,
+            "salted_rows": 0,
+            "overflow_retries": 0,
+        }
 
     @property
     def n_shards(self) -> int:
@@ -555,13 +567,27 @@ class DistributedExecutor(LocalExecutor):
         rsel = _as_global(mesh, right.batch.selection_mask())
 
         n = self.n_shards
-        # size buckets exactly (one cheap counting pass beats overflow
-        # retries — each retry re-traces the exchange program)
-        lbucket = bucket_capacity(X.needed_bucket(mesh, larrs[-1], lsel), minimum=8)
-        rbucket = bucket_capacity(X.needed_bucket(mesh, rarrs[-1], rsel), minimum=8)
-        lout, lsel2, lovf = X.hash_repartition(mesh, larrs, larrs[-1], lsel, lbucket)
-        rout, rsel2, rovf = X.hash_repartition(mesh, rarrs, rarrs[-1], rsel, rbucket)
-        assert not bool(np.asarray(lovf).max()) and not bool(np.asarray(rovf).max())
+        hybrid = None
+        if node.join_type in ("INNER", "LEFT") and bool(
+            self.session.get("skew_handling")
+        ):
+            hybrid = self._hybrid_repartition(mesh, larrs, lsel, rarrs, rsel)
+        if hybrid is not None:
+            lout, lsel2, rout, rsel2 = hybrid
+        else:
+            # size buckets exactly (one cheap counting pass beats overflow
+            # retries — each retry re-traces the exchange program)
+            lbucket = bucket_capacity(X.needed_bucket(mesh, larrs[-1], lsel), minimum=8)
+            rbucket = bucket_capacity(X.needed_bucket(mesh, rarrs[-1], rsel), minimum=8)
+            lout, lsel2, lovf = X.hash_repartition(mesh, larrs, larrs[-1], lsel, lbucket)
+            rout, rsel2, rovf = X.hash_repartition(mesh, rarrs, rarrs[-1], rsel, rbucket)
+            assert not bool(np.asarray(lovf).max()) and not bool(np.asarray(rovf).max())
+            st = self.exchange_stats
+            st["exchanges"] += 2
+            st["padded_shuffle_rows"] += n * n * (lbucket + rbucket)
+            st["shuffle_rows"] += int(
+                np.asarray(lsel).sum() + np.asarray(rsel).sum()
+            )
 
         # build shard-local Results and delegate to the local join kernel via
         # shard_map: both sides now co-partitioned by key hash
@@ -614,6 +640,53 @@ class DistributedExecutor(LocalExecutor):
             mask = ExprCompiler(result.batch.columns).predicate_mask(expr)
             result = Result(Batch(result.batch.columns, total, mask & out_sel), layout)
         return result
+
+    def _hybrid_repartition(self, mesh, larrs, lsel, rarrs, rsel):
+        """Skew-aware hybrid exchange for a partitioned join (interpreter
+        path, eager): detect heavy hitters over the probe-side key hashes,
+        keep hot probe rows on their source shard, replicate just the hot
+        build slice, and repartition the cold remainder through exactly
+        sized two-tier buckets. Returns None when no key is hot (caller
+        falls back to the plain exact-bucket exchange)."""
+        from trino_tpu.ops import skew as SK
+
+        k = max(1, int(self.session.get("skew_hot_k")))
+        frac = float(self.session.get("skew_hot_threshold_frac"))
+        hh, hv, n_hot, _total = SK.hot_key_hashes(mesh, larrs[-1], lsel, k, frac)
+        if int(np.asarray(n_hot).max()) == 0:
+            return None
+        lcold, lhot = X.skew_split_counts(mesh, larrs[-1], lsel, hh, hv)
+        rcold, rhot = X.skew_split_counts(mesh, rarrs[-1], rsel, hh, hv)
+        lb = bucket_capacity(lcold, minimum=8)
+        rb = bucket_capacity(rcold, minimum=8)
+        lhot_cap = bucket_capacity(lhot, minimum=8)
+        rhot_cap = bucket_capacity(rhot, minimum=8)
+        # cold buckets are exact, so the spill tier is vestigial-minimal
+        lout, lsel2, lflags, lcnt, _ = X.skewed_repartition(
+            mesh, larrs, larrs[-1], lsel, lb, 8,
+            hot_mode="local", hot_cap=lhot_cap, hot_set=(hh, hv),
+        )
+        rout, rsel2, rflags, rcnt, _ = X.skewed_repartition(
+            mesh, rarrs, rarrs[-1], rsel, rb, 8,
+            hot_mode="replicate", hot_cap=rhot_cap, hot_set=(hh, hv),
+        )
+        assert not any(
+            bool(np.asarray(f).max()) for f in (*lflags, *rflags)
+        )
+        n = mesh.devices.size
+        st = self.exchange_stats
+        st["exchanges"] += 2
+        st["hot_keys"] += int(np.asarray(n_hot).max())
+        st["shuffle_rows"] += int(np.asarray(lcnt[0]).max()) + int(
+            np.asarray(rcnt[0]).max()
+        )
+        st["salted_rows"] += int(np.asarray(lcnt[1]).max()) + int(
+            np.asarray(rcnt[1]).max()
+        )
+        st["padded_shuffle_rows"] += n * (n * lb + 8) + n * (
+            n * rb + 8 + rhot_cap
+        )
+        return lout, lsel2, rout, rsel2
 
 
 def _is_sharded(batch: Batch) -> bool:

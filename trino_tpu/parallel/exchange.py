@@ -113,6 +113,220 @@ def hash_repartition(
     return list(out_cols), out_sel, overflow
 
 
+def skewed_repartition(
+    mesh: Mesh,
+    arrays: Sequence[jax.Array],
+    key_hash: jax.Array,
+    sel: jax.Array,
+    bucket: int,
+    spill: int,
+    hot_mode: str | None = None,
+    hot_cap: int = 0,
+    hot_set=None,
+    detect=None,
+):
+    """Two-tier (+ optionally salted) repartition.
+
+    Replaces ``hash_repartition``'s single worst-case ``B`` with a small
+    per-(src,dst) cold ``bucket`` plus a shared ``spill`` tier: rows
+    overflowing their cold block pack into one per-source [spill] block
+    that is all_gathered with a destination lane — each receiver keeps the
+    spill rows addressed to it, so the layout stays destination-preserving
+    and the result is row-set-identical to ``hash_repartition``.
+
+    Skew handling adds a third *hot* region for keys in a heavy-hitter
+    set (``ops/skew.py``), which never touch cold or spill tiers:
+
+    - ``hot_mode="local"`` (probe side): hot rows stay on their source
+      shard — zero wire cost, the source shard is the salt.
+    - ``hot_mode="replicate"`` (build side): each source's hot rows are
+      all_gathered to every shard (partial broadcast of just the hot
+      slice), so every shard can join its local hot probe rows.
+
+    The hot set comes either from ``hot_set=(hot_hashes, hot_valid)``
+    (replicated tables from a prior sketch) or ``detect=(k, frac)`` which
+    runs ``hot_key_sketch`` in-program over this exchange's own hashes and
+    returns the tables for the peer exchange to reuse.
+
+    Returns ``(out_cols, out_sel, flags, counters, hotset)``:
+      flags: ``(spill_overflow, hot_overflow)`` int32, host-checkable;
+      counters: ``(sent_rows, hot_rows, hot_keys)`` int64 — live rows
+        entering the exchange, rows routed hot, hot keys detected;
+      hotset: ``(hot_hashes, hot_valid, n_hot)`` in detect mode, else ().
+    Per-shard output length is ``n*bucket + n*spill + H`` where H is 0
+    (no hot region), ``hot_cap`` (local) or ``n*hot_cap`` (replicate).
+    """
+    from trino_tpu.ops import skew as SK
+
+    n = mesh.devices.size
+    assert hot_mode in (None, "local", "replicate")
+    assert (hot_mode is None) == (hot_set is None and detect is None)
+    hot_extra = 2 if hot_set is not None else 0
+    in_specs = (PS(AXIS),) * (len(arrays) + 2) + (PS(),) * hot_extra
+    hotset_specs = (PS(), PS(), PS()) if detect is not None else ()
+    out_specs = (
+        tuple(PS(AXIS) for _ in arrays),
+        PS(AXIS),
+        (PS(), PS()),
+        (PS(), PS(), PS()),
+        hotset_specs,
+    )
+
+    @partial(smap, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    def go(*ops):
+        if hot_set is not None:
+            *cols, khash, s, hh, hv = ops
+            n_hot = jnp.sum(hv.astype(jnp.int64))
+            hotset_out = ()
+        else:
+            *cols, khash, s = ops
+            if detect is not None:
+                k, frac = detect
+                hh, hv, n_hot, _total = SK.hot_key_sketch(khash, s, k, frac)
+                hotset_out = (hh, hv, n_hot)
+            else:
+                hh = hv = None
+                n_hot = jnp.zeros((), dtype=jnp.int64)
+                hotset_out = ()
+        local_n = khash.shape[0]
+        dest = (khash % n).astype(jnp.int32)
+        if hh is not None:
+            dest = jnp.where(SK.is_hot(hh, hv, khash) & s, n, dest)
+        dest = jnp.where(s, dest, n + 1)  # dead rows -> dropped id
+        # same packed-lane deterministic sort as hash_repartition, with
+        # two extra ids: n = hot, n+1 = dead
+        idx_bits = max(1, (local_n - 1).bit_length())
+        wide = idx_bits + (n + 1).bit_length() > 31
+        lt = jnp.int64 if wide else jnp.int32
+        lane = (dest.astype(lt) << idx_bits) | jnp.arange(local_n, dtype=lt)
+        s_lane = jax.lax.sort((lane,), num_keys=1, is_stable=False)[0]
+        order = (s_lane & ((1 << idx_bits) - 1)).astype(jnp.int32)
+        d_sorted = (s_lane >> idx_bits).astype(jnp.int32)
+        counts = jnp.bincount(d_sorted, length=n + 2)
+        starts = jnp.cumsum(counts) - counts
+        within = (jnp.arange(local_n) - starts[d_sorted]).astype(jnp.int32)
+        cold = d_sorted < n
+        in_cold = cold & (within < bucket)
+        sp = (cold & (within >= bucket)).astype(jnp.int32)
+        spill_pos = (jnp.cumsum(sp) - sp).astype(jnp.int32)
+        n_spilled = jnp.sum(sp)
+        hot_region = hot_cap if hot_mode is not None else 0
+        base_spill = n * bucket
+        base_hot = base_spill + spill
+        total_slots = base_hot + hot_region
+        slot = jnp.where(in_cold, d_sorted * bucket + within, total_slots)
+        slot = jnp.where(
+            (sp > 0) & (spill_pos < spill), base_spill + spill_pos, slot
+        )
+        if hot_mode is not None:
+            slot = jnp.where(
+                (d_sorted == n) & (within < hot_cap), base_hot + within, slot
+            )
+        landed = slot < total_slots
+        valid_buf = (
+            jnp.zeros((total_slots,), dtype=jnp.bool_)
+            .at[slot]
+            .set(landed, mode="drop")
+        )
+        dest_buf = (
+            jnp.full((total_slots,), n, dtype=jnp.int32)
+            .at[slot]
+            .set(d_sorted, mode="drop")
+        )
+        me = jax.lax.axis_index(AXIS)
+
+        def ship(buf):
+            cold_b = buf[:base_spill].reshape((n, bucket) + buf.shape[1:])
+            cold_out = jax.lax.all_to_all(
+                cold_b, AXIS, split_axis=0, concat_axis=0
+            ).reshape((base_spill,) + buf.shape[1:])
+            spill_out = jax.lax.all_gather(
+                buf[base_spill:base_hot], AXIS, axis=0, tiled=True
+            )
+            parts = [cold_out, spill_out]
+            if hot_mode == "replicate":
+                parts.append(
+                    jax.lax.all_gather(buf[base_hot:], AXIS, axis=0, tiled=True)
+                )
+            elif hot_mode == "local":
+                parts.append(buf[base_hot:])
+            return jnp.concatenate(parts) if len(parts) > 1 else cold_out
+
+        out_cols = tuple(
+            ship(
+                jnp.zeros((total_slots,) + c.shape[1:], dtype=c.dtype)
+                .at[slot]
+                .set(c[order], mode="drop")
+            )
+            for c in cols
+        )
+        out_valid = ship(valid_buf)
+        # spill rows were gathered everywhere; keep only those addressed here
+        gdest = jax.lax.all_gather(
+            dest_buf[base_spill:base_hot], AXIS, axis=0, tiled=True
+        )
+        spill_keep = jnp.concatenate(
+            [
+                jnp.ones((base_spill,), dtype=jnp.bool_),
+                gdest == me,
+                jnp.ones((out_valid.shape[0] - base_spill - n * spill,), dtype=jnp.bool_),
+            ]
+        )
+        out_valid = out_valid & spill_keep
+        flags = (
+            jax.lax.pmax((n_spilled > spill).astype(jnp.int32), AXIS),
+            jax.lax.pmax((counts[n] > hot_cap).astype(jnp.int32), AXIS)
+            if hot_mode is not None
+            else jnp.zeros((), dtype=jnp.int32),
+        )
+        # sent counts LIVE rows entering the exchange (the padding-ratio
+        # denominator) — not wire slots; hot-local rows still count, so
+        # skew-on and skew-off runs share a comparable baseline
+        counters = (
+            jax.lax.psum(jnp.sum(s.astype(jnp.int64)), AXIS),
+            jax.lax.psum(counts[n].astype(jnp.int64), AXIS),
+            n_hot,
+        )
+        return out_cols, out_valid, flags, counters, hotset_out
+
+    args = list(arrays) + [key_hash, sel]
+    if hot_set is not None:
+        args += [hot_set[0], hot_set[1]]
+    out_cols, out_sel, flags, counters, hotset = go(*args)
+    return list(out_cols), out_sel, flags, counters, hotset
+
+
+def skew_split_counts(
+    mesh: Mesh, key_hash: jax.Array, sel: jax.Array, hot_hashes, hot_valid
+):
+    """Exact sizing for a hybrid exchange (interpreter path): the max
+    per-(src,dst) count over *cold* rows and the max per-source count of
+    *hot* rows. One cheap pass, like ``needed_bucket``."""
+    from trino_tpu.ops import skew as SK
+
+    n = mesh.devices.size
+
+    @partial(
+        smap,
+        mesh=mesh,
+        in_specs=(PS(AXIS), PS(AXIS), PS(), PS()),
+        out_specs=(PS(), PS()),
+    )
+    def go(khash, s, hh, hv):
+        dest = jnp.where(s, (khash % n).astype(jnp.int32), n + 1)
+        dest = jnp.where(SK.is_hot(hh, hv, khash) & s, n, dest)
+        counts = jnp.bincount(dest, length=n + 2)
+        return (
+            jax.lax.pmax(jnp.max(counts[:n]), AXIS),
+            jax.lax.pmax(counts[n], AXIS),
+        )
+
+    cold_max, hot_max = go(key_hash, sel, hot_hashes, hot_valid)
+    return max(8, int(np.asarray(cold_max).max())), max(
+        8, int(np.asarray(hot_max).max())
+    )
+
+
 def needed_bucket(mesh: Mesh, key_hash: jax.Array, sel: jax.Array) -> int:
     """Exact per-(src,dst) bucket size for hash_repartition: the max count
     of rows any one source sends to any one destination. One cheap pass —

@@ -306,3 +306,44 @@ def _domain_selectivity(dom: Domain, ss: Optional[SymbolStats]) -> float:
 
 def _is_num(v: Any) -> bool:
     return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+class FragmentStatsCalculator(StatsCalculator):
+    """Stats over one plan *fragment*: ``RemoteSource`` leaves resolve to
+    the producer fragment's root estimate instead of the unknown default,
+    so exchange/join/agg capacity seeding (``exec/fragments.py``) sees
+    realistic cardinalities on the consumer side of every cut."""
+
+    def __init__(self, catalogs, remote_stats: dict):
+        super().__init__(catalogs)
+        self._remote = remote_stats
+
+    def _stats_remotesource(self, node) -> PlanStats:
+        src = self._remote.get(node.fragment_id)
+        if src is None or src.row_count is None:
+            return PlanStats()
+        # the cut preserves symbol names across the exchange, so per-symbol
+        # stats (join-key NDVs) survive by name; unmatched names just drop
+        syms = {
+            s.name: src.symbols[s.name]
+            for s in node.symbols
+            if s.name in src.symbols
+        }
+        return PlanStats(src.row_count, syms)
+
+
+def fragment_output_stats(sub, catalogs) -> dict:
+    """Root-row estimates per fragment id for a fragmented plan, computed
+    bottom-up over the fragment tree (children first, so every
+    ``RemoteSource`` resolves against its producer's estimate)."""
+    out: dict = {}
+
+    def walk(sp) -> None:
+        for child in sp.children:
+            walk(child)
+        out[sp.fragment.id] = FragmentStatsCalculator(catalogs, out).stats(
+            sp.fragment.root
+        )
+
+    walk(sub)
+    return out

@@ -197,6 +197,9 @@ class ManagedQuery:
             "queryAttempts": self.query_attempts,
             "taskRetries": cluster_stats.get("task_retries", 0),
             "taskAttempts": cluster_stats.get("task_attempts", {}),
+            # skew-aware exchange counters (shuffle rows/bytes, padding
+            # ratio, overflow retries, hot/salted keys, capacity provenance)
+            "exchangeStats": self.result.exchange_stats if self.result else None,
             "error": self.error.to_json() if self.error else None,
         }
 
