@@ -81,6 +81,15 @@ def main() -> None:
         ("select count(*), sum(v) from memory.default.warm_facts", {}),
         # scan -> group-by (hash exchange + final agg)
         ("select k, sum(v) from memory.default.warm_facts group by k", {}),
+        # filtered group-by + two literal variants: constant hoisting
+        # canonicalizes all three to ONE fingerprint, so the corpus below
+        # dedupes them to a single compile (the printed table proves it)
+        ("select k, sum(v) from memory.default.warm_facts "
+         "where v < 100 group by k", {}),
+        ("select k, sum(v) from memory.default.warm_facts "
+         "where v < 500 group by k", {}),
+        ("select k, sum(v) from memory.default.warm_facts "
+         "where v < 900 group by k", {}),
         # partitioned join, skew path on (detect + salt programs)
         ("select sum(f.v * d.name) from memory.default.warm_facts f "
          "join memory.default.warm_dims d on f.k = d.k",
@@ -93,14 +102,36 @@ def main() -> None:
         ("select l_returnflag, sum(l_quantity) from tpch.tiny.lineitem "
          "group by l_returnflag", {}),
     ]
+    # one representative per canonical plan shape: literal variants share
+    # a fingerprint, so executing the first warms the program cache (and
+    # the persistent XLA cache) for every other member of the family
+    seen_fps: dict[str, str] = {}
     for sql, props in shapes:
         for mode in ("local", "distributed"):
             s = Session(properties={"execution_mode": mode, **props})
+            label = sql.split(chr(10))[0][:60]
             try:
+                fp = None
+                if mode == "distributed":
+                    fp, _params = runner.engine.fingerprint(sql, s)
+                    if fp is not None and fp in seen_fps:
+                        print(f"dedup  [{mode}] {label} "
+                              f"(= {fp[:12]} already warmed)")
+                        continue
                 runner.engine.execute_statement(sql, s)
-                print(f"warmed [{mode}] {sql.split(chr(10))[0][:60]}")
+                if fp is not None:
+                    seen_fps[fp] = label
+                print(f"warmed [{mode}] {label}")
             except Exception as e:  # noqa: BLE001 — warm what we can
                 print(f"skip   [{mode}] {type(e).__name__}: {e}")
+    # fingerprint -> compiled-program table (engine program cache)
+    cache = getattr(runner.engine, "_query_cache", {})
+    if cache:
+        print("\nfingerprint   programs  query")
+        for key, entry in cache.items():
+            fp = key[0] if isinstance(key, tuple) else str(key)
+            print(f"{fp[:12]}  {len(entry.get('programs', {})):>8}  "
+                  f"{seen_fps.get(fp, '?')}")
     n_entries = (
         len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
     )

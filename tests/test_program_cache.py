@@ -1,0 +1,191 @@
+"""Cross-query compiled-program cache (planner/canonicalize.py + engine).
+
+Covers the cache-key contract end to end: tokenized cacheability, canonical
+fingerprint stability across the plan serde, literal-variation program
+reuse (zero retraces, bit-identical to the baked path), invalidation on
+catalog data-version and access-control generation bumps, and the LRU
+bound on the engine's entry map.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.config import Session
+from trino_tpu.connectors.api import ColumnSchema, TableSchema
+from trino_tpu.testing import DistributedQueryRunner
+
+
+def _add_table(runner, name: str, rows: int = 1024, seed: int = 3) -> None:
+    mem = runner.catalogs.get("memory")
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 32, rows).astype(np.int64)
+    v = rng.integers(0, 1000, rows).astype(np.int64)
+    mem.create_table(
+        "default", name,
+        TableSchema(name, (ColumnSchema("k", T.BIGINT),
+                           ColumnSchema("v", T.BIGINT))),
+    )
+    mem.insert("default", name,
+               Batch([Column(T.BIGINT, k), Column(T.BIGINT, v)], rows))
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = DistributedQueryRunner(
+        Session(user="t", catalog="memory", schema="default")
+    )
+    _add_table(r, "pc_facts")
+    return r
+
+
+def _baked_session(runner) -> Session:
+    s = Session(user="t", catalog="memory", schema="default")
+    for k, v in runner.session.properties.items():
+        s.properties[k] = v
+    s.properties["program_cache"] = False
+    return s
+
+
+# --- cacheability: whole-token match, not substring -------------------------
+
+
+def test_sql_cacheable_tokenizes(runner):
+    eng = runner.engine
+    # substring false-positives of the old blacklist must stay cacheable
+    assert eng._sql_cacheable("select brand(x) from t")
+    assert eng._sql_cacheable("select randomness from t")
+    assert eng._sql_cacheable("select known from t")  # 'now' inside 'known'
+    # genuine volatile identifiers are not
+    assert not eng._sql_cacheable("select random() from t")
+    assert not eng._sql_cacheable("select rand() from t")
+    assert not eng._sql_cacheable("select current_timestamp")
+    assert not eng._sql_cacheable("select uuid()")
+    # unlexable text: uncached, parser reports the real error
+    assert not eng._sql_cacheable("select 'unterminated")
+
+
+# --- fingerprint stability --------------------------------------------------
+
+
+def test_fingerprint_stable_across_serde_roundtrip(runner):
+    from trino_tpu.planner.canonicalize import canonicalize_plan, plan_fingerprint
+    from trino_tpu.planner.serde import node_from_json, node_to_json
+    from trino_tpu.sql.parser import parse_statement
+
+    n = int(runner.engine.mesh.devices.size)
+    sql = "select k, sum(v) from memory.default.pc_facts where v < 100 group by k"
+    plan = runner.engine.plan(parse_statement(sql), runner.session)
+    root, params, fp = canonicalize_plan(plan, runner.session, n)
+    assert fp is not None and len(params) == 1
+    # a wire round-trip of the canonical plan must fingerprint identically
+    rt = node_from_json(node_to_json(root))
+    assert plan_fingerprint(rt, runner.session, n, nparams=len(params)) == fp
+
+
+def test_fingerprint_ignores_literals_and_symbol_counters(runner):
+    eng = runner.engine
+    fp1, p1 = eng.fingerprint(
+        "select k, sum(v) from memory.default.pc_facts where v < 100 group by k",
+        runner.session,
+    )
+    fp2, p2 = eng.fingerprint(
+        "select k, sum(v) from memory.default.pc_facts where v < 900 group by k",
+        runner.session,
+    )
+    assert fp1 is not None
+    # planner symbol counters advanced between the two plans; the literal
+    # differs: neither may leak into the fingerprint
+    assert fp1 == fp2
+    assert [v for v, _ in p1] == [100] and [v for v, _ in p2] == [900]
+    # a structural change (different aggregate) must NOT collide
+    fp3, _ = eng.fingerprint(
+        "select k, count(*) from memory.default.pc_facts where v < 100 group by k",
+        runner.session,
+    )
+    assert fp3 != fp1
+
+
+# --- literal-variation program reuse ----------------------------------------
+
+
+def test_literal_variation_hits_cache(runner):
+    eng = runner.engine
+    q = "select k, sum(v) from memory.default.pc_facts where v < {} group by k"
+    cold = eng.execute_statement(q.format(100), runner.session)
+    assert cold.trace_count >= 1 and cold.program_cache_misses >= 1
+    warm = eng.execute_statement(q.format(250), runner.session)
+    # different comparison literal, same canonical plan: every fragment
+    # program comes from the cache, nothing retraces
+    assert warm.program_cache_hits >= 1
+    assert warm.trace_count == 0
+    assert warm.program_cache_misses == 0
+    # hoisted execution must be bit-identical to the baked path
+    baked = eng.execute_statement(q.format(250), _baked_session(runner))
+    assert warm.rows == baked.rows
+
+
+def test_repeat_execution_zero_retrace(runner):
+    eng = runner.engine
+    sql = "select count(*), min(v), max(v) from memory.default.pc_facts"
+    first = eng.execute_statement(sql, runner.session)
+    second = eng.execute_statement(sql, runner.session)
+    assert first.rows == second.rows
+    assert second.trace_count == 0
+    assert second.program_cache_hits >= 1
+    assert second.compile_ms == 0.0
+
+
+# --- invalidation -----------------------------------------------------------
+
+
+def test_invalidation_on_catalog_version_bump(runner):
+    eng = runner.engine
+    sql = "select k, max(v) from memory.default.pc_facts group by k"
+    eng.execute_statement(sql, runner.session)
+    warm = eng.execute_statement(sql, runner.session)
+    assert warm.program_cache_hits >= 1
+    # any memory-catalog mutation bumps the connector's _version; string
+    # dictionaries are trace-time constants, so cached programs must drop
+    _add_table(runner, "pc_bump", rows=8, seed=9)
+    cold = eng.execute_statement(sql, runner.session)
+    assert cold.program_cache_hits == 0
+    assert cold.trace_count >= 1
+
+
+def test_invalidation_on_access_control_generation(runner):
+    eng = runner.engine
+    sql = "select k, min(v) from memory.default.pc_facts group by k"
+    eng.execute_statement(sql, runner.session)
+    warm = eng.execute_statement(sql, runner.session)
+    assert warm.program_cache_hits >= 1
+    eng.access_control.generation += 1  # policy change
+    cold = eng.execute_statement(sql, runner.session)
+    assert cold.program_cache_hits == 0
+    assert cold.trace_count >= 1
+
+
+# --- LRU bound --------------------------------------------------------------
+
+
+def test_lru_eviction_bound():
+    r = DistributedQueryRunner(
+        Session(user="t", catalog="memory", schema="default")
+    )
+    _add_table(r, "pc_lru", rows=256, seed=5)
+    eng = r.engine
+    eng._QUERY_CACHE_MAX = 3  # instance override of the class bound
+    shapes = [
+        "select count(*) from memory.default.pc_lru",
+        "select sum(v) from memory.default.pc_lru",
+        "select k, count(*) from memory.default.pc_lru group by k",
+        "select k, sum(v) from memory.default.pc_lru group by k",
+        "select k, min(v) from memory.default.pc_lru group by k",
+    ]
+    for sql in shapes:
+        eng.execute_statement(sql, r.session)
+    assert len(eng._query_cache) <= 3
+    # the most recent shape survived and still serves hits
+    again = eng.execute_statement(shapes[-1], r.session)
+    assert again.trace_count == 0 and again.program_cache_hits >= 1

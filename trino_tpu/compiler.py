@@ -26,7 +26,14 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.columnar import Column, Dictionary
-from trino_tpu.ir import Call, Constant, InputRef, RowExpr, SpecialForm
+from trino_tpu.ir import (
+    Call,
+    Constant,
+    HoistedConstant,
+    InputRef,
+    RowExpr,
+    SpecialForm,
+)
 
 Pair = tuple[jnp.ndarray, jnp.ndarray]  # (data, valid)
 
@@ -201,7 +208,10 @@ class ExprCompiler:
     """
 
     def __init__(
-        self, columns: Sequence[Column], string_dictionary: Dictionary | None = None
+        self,
+        columns: Sequence[Column],
+        string_dictionary: Dictionary | None = None,
+        params: Sequence | None = None,
     ):
         self.columns = list(columns)
         self.n = self.columns[0].capacity if self.columns else 1
@@ -209,6 +219,10 @@ class ExprCompiler:
         # against it (the executor remaps referenced string columns into it
         # first — see exec.local._unify_strings)
         self.string_dictionary = string_dictionary
+        # ordered parameter vector of a canonicalized plan (device scalars
+        # under tracing, host scalars eagerly); HoistedConstants read it
+        # so literal variants share one traced program
+        self.params = params
 
     # -- entry points -----------------------------------------------------
     def evaluate(self, expr: RowExpr) -> Pair:
@@ -224,6 +238,21 @@ class ExprCompiler:
         if isinstance(expr, InputRef):
             c = self.columns[expr.channel]
             return c.data, c.valid_mask()
+        if isinstance(expr, HoistedConstant):
+            if self.params is not None:
+                p = self.params[expr.index]
+                data = jnp.broadcast_to(
+                    jnp.asarray(p).astype(expr.type.storage_dtype), (self.n,)
+                )
+                return data, jnp.ones(self.n, dtype=jnp.bool_)
+            if expr.value is None:
+                # only reachable by executing a serde round-tripped
+                # canonical plan without its parameter vector
+                raise ValueError(
+                    f"hoisted constant param[{expr.index}] evaluated "
+                    "without a parameter vector"
+                )
+            # no params supplied: fall through and bake the kept value
         if isinstance(expr, Constant):
             if T.is_string(expr.type) and expr.value is not None:
                 if self.string_dictionary is None:

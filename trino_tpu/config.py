@@ -126,6 +126,15 @@ class Session:
         ("skew_hot_k", 16),  # top-k candidates per shard in the sketch
         # hot iff global count > frac * (total_rows / n_shards)
         ("skew_hot_threshold_frac", 0.5),
+        # --- cross-query program cache (planner/canonicalize.py) ----------
+        # share compiled fragment programs across statements under a
+        # canonical-plan fingerprint (ExpressionCompiler CacheKey analog);
+        # off -> every statement plans and traces from scratch
+        ("program_cache", True),
+        # hoist non-structural literals out of the plan into the jit
+        # parameter vector so `x < 24` and `x < 25` share one traced
+        # program; off -> literals bake into the trace (old behavior)
+        ("constant_hoisting", True),
     )
 
     def get(self, name: str) -> Any:

@@ -46,6 +46,12 @@ class StatementResult:
     # skew-aware exchange counters (shuffle rows/bytes, padding ratio,
     # overflow retries, hot/salted keys) — surfaced in /v1/query
     exchange_stats: Optional[dict[str, Any]] = None
+    # compile-time telemetry (cross-query program cache; Trino's
+    # CacheStatsMBean analog) — surfaced in /v1/query
+    compile_ms: float = 0.0  # trace+lower+compile wall paid by this query
+    trace_count: int = 0  # programs traced (0 on a fully warm run)
+    program_cache_hits: int = 0
+    program_cache_misses: int = 0
 
 
 class Engine:
@@ -115,38 +121,48 @@ class Engine:
         self._query_cache_lock = threading.Lock()
 
     _QUERY_CACHE_MAX = 64
-    # statements whose results depend on evaluation time/randomness (or
-    # session state) must not reuse a cached plan
-    _UNCACHEABLE_SQL = (
-        "random", "rand(", "now(", "current_time", "current_date",
-        "current_timestamp", "localtime", "uuid",
-    )
+    # statements whose results depend on evaluation time/randomness must
+    # not reuse a cached plan; matched against whole lexer identifiers —
+    # NOT substrings — so a function `brand()` or a column `randomness`
+    # doesn't silently disable caching (`current_timestamp` and friends
+    # lex as single IDENT tokens, underscores included)
+    _UNCACHEABLE_IDENTS = frozenset({
+        "random", "rand", "now", "uuid", "current_time", "current_date",
+        "current_timestamp", "localtime", "localtimestamp",
+    })
 
-    def _query_cache_entry(self, sql: str, session: Session) -> Optional[dict]:
-        """Cache slot for this (sql, session, data-version) or None when
-        the statement is uncacheable."""
+    def _sql_cacheable(self, sql: str) -> bool:
+        from trino_tpu.sql.lexer import SqlSyntaxError, tokenize
+
+        try:
+            tokens = tokenize(sql)
+        except SqlSyntaxError:
+            return False  # let the parser produce the real error, uncached
+        return not any(
+            tok.kind in ("IDENT", "KW")
+            and tok.text.lower() in self._UNCACHEABLE_IDENTS
+            for tok in tokens
+        )
+
+    def _query_cache_entry(self, fingerprint: str) -> dict:
+        """Cache slot for this (plan fingerprint, data-version) pair.
+
+        The fingerprint already folds in plan shape, dtypes, mesh, and the
+        codegen-relevant session properties (planner/canonicalize.py), so
+        the key only adds what the fingerprint cannot see: catalog data
+        versions (string dictionaries are trace-time constants, so new
+        data must retrace) and the access-control generation (rule changes
+        must drop entries immediately). The user is deliberately absent —
+        per-user literals ride the parameter vector, and plans that differ
+        structurally per user fingerprint differently on their own.
+        """
         import threading
 
-        if session.get("execution_mode") != "distributed" or not session.get(
-            "fragment_execution"
-        ):
-            return None
-        low = sql.lower()
-        if any(tok in low for tok in self._UNCACHEABLE_SQL):
-            return None
         versions = tuple(
             (name, getattr(self.catalogs.get(name), "_version", 0))
             for name in sorted(self.catalogs.names())
         )
-        key = (
-            sql,
-            session.user,
-            session.catalog,
-            session.schema,
-            tuple(sorted((k, repr(v)) for k, v in session.properties.items())),
-            versions,
-            self.access_control.generation,
-        )
+        key = (fingerprint, versions, self.access_control.generation)
         with self._query_cache_lock:
             entry = self._query_cache.get(key)
             if entry is None:
@@ -273,38 +289,72 @@ class Engine:
         if handler is not None:
             return handler(stmt, session)
         if isinstance(stmt, t.Query):
-            entry = (
-                self._query_cache_entry(sql_text, session) if sql_text else None
-            )
+            # always (re-)plan: planning is cheap host work, and the
+            # canonical fingerprint of the optimized plan — not the SQL
+            # text — keys the program cache, so `x < 24` and `x < 25`
+            # land on the same entry with different parameter vectors
+            plan = self.plan(stmt, session)
+            exec_plan, params, entry = plan, [], None
+            if (
+                sql_text is not None
+                and session.get("execution_mode") == "distributed"
+                and session.get("fragment_execution")
+                and bool(session.get("program_cache"))
+                and self._sql_cacheable(sql_text)
+            ):
+                from trino_tpu.planner.canonicalize import canonicalize_plan
+
+                mesh_n = (
+                    int(self.mesh.devices.size) if self.mesh is not None else 1
+                )
+                canonical, params, fp = canonicalize_plan(
+                    plan, session, mesh_n
+                )
+                if fp is not None:
+                    exec_plan = canonical
+                    entry = self._query_cache_entry(fp)
+                else:
+                    params = []  # unserializable shape: run baked, uncached
             # shared program stores and capacity objects are not safe for
             # concurrent executors: a second in-flight run of the same
-            # cached query executes uncached instead of waiting
+            # fingerprint executes uncached instead of waiting
             if entry is not None and not entry["lock"].acquire(blocking=False):
                 entry = None
             try:
-                if entry is not None and entry["plan"] is not None:
-                    return self._execute_query_plan(
-                        entry["plan"], session, query_id=query_id,
-                        programs=entry["programs"],
-                    )
-                plan = self.plan(stmt, session)
                 programs = None
                 if entry is not None:
-                    # joins carry data-dependent dynamic-filter rewrites
-                    # whose node identities change per query; cache
-                    # join-free plans
-                    if not any(
-                        isinstance(n, P.Join) for n in P.walk_plan(plan)
-                    ):
-                        entry["plan"] = plan
-                        programs = entry["programs"]
+                    if entry["plan"] is None:
+                        entry["plan"] = exec_plan
+                    # same fingerprint == same shape: execute the FIRST
+                    # cached plan object so fragment node identities (and
+                    # with them program keys and caps sites) stay stable
+                    # across queries; this query's literals ride in via
+                    # the parameter vector
+                    exec_plan = entry["plan"]
+                    programs = entry["programs"]
                 return self._execute_query_plan(
-                    plan, session, query_id=query_id, programs=programs
+                    exec_plan, session, query_id=query_id,
+                    programs=programs, params=params,
                 )
             finally:
                 if entry is not None:
                     entry["lock"].release()
         raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
+
+    def fingerprint(
+        self, sql: str, session: Session
+    ) -> tuple[Optional[str], list]:
+        """Canonical-plan fingerprint + hoisted params for a SELECT —
+        None for uncacheable statements (prewarm/test helper)."""
+        from trino_tpu.planner.canonicalize import canonicalize_plan
+
+        stmt = parse_statement(sql)
+        if not isinstance(stmt, t.Query) or not self._sql_cacheable(sql):
+            return None, []
+        plan = self.plan(stmt, session)
+        mesh_n = int(self.mesh.devices.size) if self.mesh is not None else 1
+        _, params, fp = canonicalize_plan(plan, session, mesh_n)
+        return fp, params
 
     def plan(self, stmt: t.Node, session: Session) -> P.PlanNode:
         from trino_tpu.planner.optimizer import optimize
@@ -322,6 +372,7 @@ class Engine:
         collector=None,
         query_id: Optional[str] = None,
         programs: Optional[dict] = None,
+        params: Optional[list] = None,
     ) -> StatementResult:
         from trino_tpu.memory import QueryMemoryContext
 
@@ -356,7 +407,9 @@ class Engine:
             max_bytes=int(session.get("query_max_memory_bytes")),
         )
         try:
-            executor = self._executor(session, ctx, programs=programs)
+            executor = self._executor(
+                session, ctx, programs=programs, params=params
+            )
             executor.stats_collector = collector
             batch, names = executor.execute(plan)
             snap = getattr(executor, "exchange_stats_snapshot", None)
@@ -365,6 +418,7 @@ class Engine:
                 if getattr(executor, "exchange_stats", None)
                 else None
             )
+            cs = getattr(executor, "compile_stats", None) or {}
             return StatementResult(
                 batch.to_pylist(),
                 names,
@@ -372,11 +426,21 @@ class Engine:
                 peak_memory_bytes=ctx.peak_bytes,
                 dynamic_filters=len(executor.dynamic_filters),
                 exchange_stats=exchange_stats,
+                compile_ms=round(float(cs.get("compile_ms", 0.0)), 3),
+                trace_count=int(cs.get("trace_count", 0)),
+                program_cache_hits=int(cs.get("program_cache_hits", 0)),
+                program_cache_misses=int(cs.get("program_cache_misses", 0)),
             )
         finally:
             ctx.close()
 
-    def _executor(self, session: Session, ctx, programs: Optional[dict] = None) -> LocalExecutor:
+    def _executor(
+        self,
+        session: Session,
+        ctx,
+        programs: Optional[dict] = None,
+        params: Optional[list] = None,
+    ) -> LocalExecutor:
         mode = session.get("execution_mode")
         if mode == "distributed":
             if session.get("fragment_execution"):
@@ -384,7 +448,7 @@ class Engine:
 
                 return FragmentedExecutor(
                     self.catalogs, session, self.mesh, memory_ctx=ctx,
-                    programs=programs,
+                    programs=programs, params=params,
                 )
             from trino_tpu.parallel.distributed import DistributedExecutor
 

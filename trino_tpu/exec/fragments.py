@@ -21,9 +21,15 @@ Execution model:
   semantics reference.
 - Capacities (group budgets, join output sizes, exchange buckets) are
   static per compile; kernels report overflow flags and the host retries
-  with doubled capacities. Programs are re-TRACED per query (the reference
-  likewise re-plans per query); identical programs skip XLA compilation
-  via the persistent on-disk compile cache enabled in trino_tpu.__init__.
+  with capacities regrown to the next power-of-two bucket. Compiled
+  programs live in an engine-owned store keyed by canonical-plan
+  fingerprint (planner/canonicalize.py) and, per program, by the
+  capacity signature it was traced at — repeated or literal-variant
+  queries skip Python retracing entirely (hoisted literals ride in as
+  the ``__params__`` jit input), and the overflow ladder re-hits any
+  signature it has seen before. Identical programs additionally skip
+  XLA compilation via the persistent on-disk compile cache enabled in
+  trino_tpu.__init__.
 """
 
 from __future__ import annotations
@@ -248,10 +254,19 @@ class _Caps:
             self.provenance[name] = "seeded"
 
     def grow(self, name: str, factor: int = 2) -> None:
-        self.vals[name] = self.vals[name] * factor
+        # quantize growth to power-of-two buckets: stats-seeded odd-sized
+        # caps would otherwise walk a per-query ladder of unique shapes,
+        # and every distinct capacity signature is a separate traced
+        # program in the cross-query store
+        self.vals[name] = bucket_capacity(self.vals[name] * factor, minimum=1)
         prev = self.provenance.get(name, "default")
         if not prev.endswith("+grown"):
             self.provenance[name] = prev + "+grown"
+
+    def signature(self) -> tuple:
+        """Hashable view of the current capacity values — the part of a
+        traced program's shape that the plan fingerprint cannot see."""
+        return tuple(sorted(self.vals.items()))
 
 
 @dataclasses.dataclass
@@ -301,9 +316,31 @@ class FragmentedExecutor(DistributedExecutor):
     # exchange counters queued alongside: (names, stacked int64, static)
     deferred_counters: Optional[list] = None
 
-    def __init__(self, *args, programs: Optional[dict] = None, **kwargs):
+    def __init__(
+        self,
+        *args,
+        programs: Optional[dict] = None,
+        params: Optional[Sequence] = None,
+        **kwargs,
+    ):
         super().__init__(*args, **kwargs)
         self.programs: dict = {} if programs is None else programs
+        # ordered (value, type) literals hoisted out of a canonicalized
+        # plan (planner/canonicalize.py): interpreter paths read the host
+        # values via self._params; traced programs receive device scalars
+        # through the __params__ jit input
+        self._param_list = list(params) if params else []
+        self._params = (
+            tuple(v for v, _ in self._param_list) or None
+        )
+        # per-query compile-time telemetry (CacheStatsMBean analog);
+        # engine copies this onto StatementResult after execution
+        self.compile_stats: dict = {
+            "trace_count": 0,
+            "compile_ms": 0.0,
+            "program_cache_hits": 0,
+            "program_cache_misses": 0,
+        }
         # per-query: replicated hot-key tables exported by probe-side
         # exchanges, keyed by producer fragment id (device arrays)
         self._hot_sets: dict[int, tuple] = {}
@@ -331,6 +368,48 @@ class FragmentedExecutor(DistributedExecutor):
             # an operator needed host values mid-trace (e.g. datetime
             # formatting over unique values) — interpret instead
             return super().execute(node)
+
+    def _param_arrays(self) -> Optional[tuple]:
+        """Hoisted literals as typed device scalars — the ``__params__``
+        jit input. Dtypes come from the hoisted Constant's SQL type so a
+        parameter-vector value is bit-identical to what ``jnp.full`` would
+        have baked."""
+        if not self._param_list:
+            return None
+        return tuple(
+            jnp.asarray(v, dtype=t.storage_dtype) for v, t in self._param_list
+        )
+
+    def _store_program(self, program_key, sig, jf, meta) -> None:
+        """Insert a traced program under (program_key, capacity signature).
+
+        ``("frag", id, apply_exchange, id(root))`` keys embed the root
+        node's identity because dynamic filtering rebuilds probe roots per
+        execution; on a shared cross-query store those per-run keys would
+        accumulate (each cached closure pins its root alive, keeping ids
+        unique), so storing a new root's program evicts every entry for
+        the same fragment traced against a different — now unreachable —
+        root.
+        """
+        if (
+            isinstance(program_key, tuple)
+            and len(program_key) == 4
+            and program_key[0] == "frag"
+        ):
+            prefix, rid = program_key[:3], program_key[3]
+            stale = [
+                k
+                for k in self.programs
+                if isinstance(k, tuple)
+                and len(k) == 2
+                and isinstance(k[0], tuple)
+                and len(k[0]) == 4
+                and k[0][:3] == prefix
+                and k[0][3] != rid
+            ]
+            for k in stale:
+                self.programs.pop(k, None)
+        self.programs[(program_key, sig)] = (jf, meta)
 
     def _all_capacities(self) -> dict:
         """Flattened view of every grown capacity in the program store,
@@ -572,8 +651,10 @@ class FragmentedExecutor(DistributedExecutor):
                     if fl:
                         overflowed = True
                         grow_or_raise(nm, caps)
-                if seg.any() and key is not None:
-                    self.programs.pop(key, None)
+                # the overflowed program stays in the store: its key
+                # carries the capacity signature it was traced at, so the
+                # grown rerun traces fresh while a later same-sized query
+                # (or regrow ladder revisit) still reuses it
             if not overflowed:
                 for names, stacked, static in dcounters:
                     vals = (
@@ -817,8 +898,12 @@ class FragmentedExecutor(DistributedExecutor):
         to jit; it must call ``meta.capture`` and return ``meta.outputs``.
 
         ``program_key`` (optional) reuses the jitted program + meta from
-        ``self.programs`` across queries on the same cached plan; an
-        overflow rebuilds and replaces the entry.
+        ``self.programs`` across queries on the same cached plan. Entries
+        are stored under ``(program_key, caps.signature())`` — the
+        capacity signature the program was traced at — so the overflow
+        ladder never serves a stale-capacity program AND any signature
+        seen before (by this query's regrow ladder or an earlier query on
+        the shared store) is reused instead of retraced.
 
         With ``defer=True`` (fragments inside ``_execute_fragments``) the
         overflow flags are NOT pulled here: they are queued as device
@@ -827,9 +912,6 @@ class FragmentedExecutor(DistributedExecutor):
         """
         import time as _time
 
-        cached = (
-            self.programs.get(program_key) if program_key is not None else None
-        )
         self._last_aux = ()
         attempts = 0
         while True:
@@ -847,14 +929,30 @@ class FragmentedExecutor(DistributedExecutor):
                     capacities=caps.vals,
                     attempts=attempts - 1,
                 )
+            cached = (
+                self.programs.get((program_key, caps.signature()))
+                if program_key is not None
+                else None
+            )
+            traced_now = cached is None
             if cached is not None:
                 jf, meta = cached
-                cached = None  # one shot: an overflow rebuilds below
+                self.compile_stats["program_cache_hits"] += 1
             else:
                 meta = _Meta()
                 jf = jax.jit(build_fn(meta))
+                if program_key is not None:
+                    self.compile_stats["program_cache_misses"] += 1
             t0 = _time.perf_counter()
             data, sel, flags, counters, aux = jf(*args)
+            if traced_now:
+                # trace + lower + (XLA or disk-cache) compile happen
+                # synchronously inside the first call; execution itself
+                # dispatches async, so this wall time ≈ compile cost
+                self.compile_stats["trace_count"] += 1
+                self.compile_stats["compile_ms"] += (
+                    _time.perf_counter() - t0
+                ) * 1000.0
             self._last_aux = aux
             if defer and getattr(self, "deferred_flags", None) is not None:
                 if flags:
@@ -877,8 +975,10 @@ class FragmentedExecutor(DistributedExecutor):
                             dict(meta.exchange_static),
                         )
                     )
-                if program_key is not None:
-                    self.programs[program_key] = (jf, meta)
+                if program_key is not None and traced_now:
+                    # keyed by the POST-trace signature: tracing filled in
+                    # any capacities this program consults via caps.get
+                    self._store_program(program_key, caps.signature(), jf, meta)
                 if stats_sink is not None:
                     stats_sink.setdefault("attempts", 0)
                     stats_sink["attempts"] += 1
@@ -899,8 +999,8 @@ class FragmentedExecutor(DistributedExecutor):
                 stats_sink["last_wall_s"] = _time.perf_counter() - t0
                 stats_sink["input_rows"] = input_rows
             if not any(flags_np):
-                if program_key is not None:
-                    self.programs[program_key] = (jf, meta)
+                if program_key is not None and traced_now:
+                    self._store_program(program_key, caps.signature(), jf, meta)
                 if counters or meta.exchange_static:
                     vals = (
                         np.atleast_1d(
@@ -954,6 +1054,12 @@ class FragmentedExecutor(DistributedExecutor):
         """
         caps = self.programs.setdefault(("caps", frag.id), _Caps())
         self._seed_caps(frag, caps)
+        pvec = self._param_arrays()
+        if pvec is not None:
+            # hoisted literals ride as device-scalar jit inputs: literal
+            # variants of the same canonical plan reuse the traced program
+            inputs = dict(inputs)
+            inputs["__params__"] = pvec
 
         def build(meta: _Meta):
             def fn(inp: dict[str, Batch]):
@@ -1082,6 +1188,11 @@ class _FragmentTracer(DistributedExecutor):
         super().__init__(base.catalogs, base.session, base.mesh, memory_ctx=None)
         self._inputs = inputs
         self._input_layouts = input_layouts
+        # traced parameter vector (hoisted plan literals); the inherited
+        # ExprCompiler call sites read it via getattr(self, "_params")
+        self._params = (
+            inputs.get("__params__") if isinstance(inputs, dict) else None
+        )
         self.caps = caps
         self.skew = skew or {}
         self.overflows: list[tuple[str, jax.Array]] = []
@@ -1771,7 +1882,9 @@ class _FragmentTracer(DistributedExecutor):
             expr = self._bind(node.filter, result.layout)
             work = list(result.batch.columns)
             expr = lower_string_calls(expr, work)
-            mask = ExprCompiler(work).predicate_mask(expr)
+            mask = ExprCompiler(
+                work, params=getattr(self, "_params", None)
+            ).predicate_mask(expr)
             result = Result(Batch(result.batch.columns, total, mask & out_sel), layout)
         return result
 

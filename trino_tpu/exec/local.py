@@ -218,7 +218,9 @@ class LocalExecutor:
 
         expr = lower_datetime_format_calls(expr, cols)
         expr = lower_string_calls(expr, cols)
-        mask = ExprCompiler(cols).predicate_mask(expr)
+        mask = ExprCompiler(
+            cols, params=getattr(self, "_params", None)
+        ).predicate_mask(expr)
         sel = mask if res.batch.sel is None else (mask & res.batch.sel)
         return Result(
             Batch(res.batch.columns, res.batch.num_rows, sel), res.layout
@@ -236,7 +238,7 @@ class LocalExecutor:
             bound = self._bind(expr, res.layout)
             bound = lower_datetime_format_calls(bound, work_cols)
             bound = lower_string_calls(bound, work_cols)
-            ec = ExprCompiler(work_cols)
+            ec = ExprCompiler(work_cols, params=getattr(self, "_params", None))
             if isinstance(bound, InputRef):
                 cols.append(work_cols[bound.channel])
                 continue
@@ -291,7 +293,11 @@ class LocalExecutor:
                 # unify all referenced dictionaries + literals, evaluate
                 # as codes in the unified dictionary
                 new_cols, union = _unify_strings(bound, work_cols)
-                ec2 = ExprCompiler(new_cols, string_dictionary=union)
+                ec2 = ExprCompiler(
+                    new_cols,
+                    string_dictionary=union,
+                    params=getattr(self, "_params", None),
+                )
                 data, valid = ec2.evaluate(bound)
                 cols.append(
                     Column(sym.type, data.astype(np.int32), valid, union)
@@ -1470,7 +1476,9 @@ class LocalExecutor:
             expr = self._bind(node.filter, out.layout)
             fcols = list(out.batch.columns)
             expr = lower_string_calls(expr, fcols)
-            mask = ExprCompiler(fcols).predicate_mask(expr)
+            mask = ExprCompiler(
+                fcols, params=getattr(self, "_params", None)
+            ).predicate_mask(expr)
             mask_np = np.asarray(mask)
             if node.join_type == "LEFT":
                 # ON-clause filter applies to MATCHES, not probe rows: a
@@ -1646,7 +1654,9 @@ class LocalExecutor:
 
             fexpr = self._bind(node.filter, flayout)
             fexpr = lower_string_calls(fexpr, fcols)
-            fmask = ExprCompiler(fcols).predicate_mask(fexpr)
+            fmask = ExprCompiler(
+                fcols, params=getattr(self, "_params", None)
+            ).predicate_mask(fexpr)
             osel = osel & fmask
         matched = (
             jnp.zeros(left.batch.capacity, dtype=jnp.bool_)

@@ -48,6 +48,27 @@ class Constant(RowExpr):
 
 
 @dataclasses.dataclass(frozen=True)
+class HoistedConstant(Constant):
+    """A Constant lifted out of a cached plan into the query's ordered
+    parameter vector (:mod:`trino_tpu.planner.canonicalize`). Mirrors how
+    the reference binds constants as fields of generated classes so one
+    compiled expression serves every literal (``sql/gen/
+    ExpressionCompiler.java:94`` CacheKey over canonical RowExpressions).
+
+    ``value`` keeps the planning-time literal so eager/interpreter paths
+    (which bake constants) still work; a compiler given a parameter
+    vector reads ``params[index]`` instead, letting literal variants of
+    the same plan shape share one traced program. Serde intentionally
+    drops ``value`` so variants serialize — and fingerprint — identically.
+    """
+
+    index: int = 0
+
+    def __repr__(self):
+        return f"param[{self.index}]({self.value}:{self.type})"
+
+
+@dataclasses.dataclass(frozen=True)
 class Variable(RowExpr):
     """Named symbol reference (resolved to a channel by the physical
     planner). Mirrors ``VariableReferenceExpression.java:22``."""

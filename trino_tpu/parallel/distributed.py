@@ -534,7 +534,9 @@ class DistributedExecutor(LocalExecutor):
             from trino_tpu.compiler import ExprCompiler
 
             expr = self._bind(node.filter, result.layout)
-            mask = ExprCompiler(result.batch.columns).predicate_mask(expr)
+            mask = ExprCompiler(
+                result.batch.columns, params=getattr(self, "_params", None)
+            ).predicate_mask(expr)
             result = Result(
                 Batch(result.batch.columns, total, mask & out_sel), layout
             )
@@ -637,7 +639,9 @@ class DistributedExecutor(LocalExecutor):
             from trino_tpu.compiler import ExprCompiler
 
             expr = self._bind(node.filter, result.layout)
-            mask = ExprCompiler(result.batch.columns).predicate_mask(expr)
+            mask = ExprCompiler(
+                result.batch.columns, params=getattr(self, "_params", None)
+            ).predicate_mask(expr)
             result = Result(Batch(result.batch.columns, total, mask & out_sel), layout)
         return result
 

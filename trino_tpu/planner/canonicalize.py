@@ -1,0 +1,280 @@
+"""Plan canonicalization + fingerprinting for the cross-query program cache.
+
+Reference: Trino keys its generated-code caches on *canonicalized*
+``RowExpression``s with constants bound as fields of the generated class
+(``sql/gen/ExpressionCompiler.java:56,94`` — a Guava cache over the
+expression shape), so ``x < 24`` and ``x < 25`` share one compiled class.
+The TPU-native analog: non-structural ``Constant``s in the optimized plan
+are hoisted into an ordered parameter vector (each becomes a
+:class:`~trino_tpu.ir.HoistedConstant` carrying its position), and the
+fingerprint is a sha256 over the canonical plan serde plus everything
+else that shapes the traced program — mesh size, codegen-relevant session
+properties, parameter count. Two SQL texts whose optimized plans differ
+only in hoisted literals fingerprint identically and share compiled
+fragment programs; the literals ride along as device-scalar jit
+arguments (``exec/fragments.py`` feeds them through ``__params__``).
+
+What stays baked (structural — changing it changes the traced program):
+
+- LIMIT / TopN counts, partition counts, decimal scales (shape/dtype)
+- string literals: they become dictionary truth tables at trace time
+- wide DECIMAL literals (|v| >= 2**63): they add hi/lo lanes (rank change)
+- arguments that must be concrete at trace time (LIKE patterns,
+  ``round`` digits, ``date_trunc`` units, IN-list strings …) — excluded
+  automatically because only the whitelisted arithmetic/comparison
+  positions below ever hoist
+- ``Values`` rows, aggregate arguments, window frame defaults
+
+Runtime *capacities* are deliberately NOT part of the fingerprint: they
+live in the per-entry ``_Caps`` signature that keys each traced program
+under the fingerprint entry (bucketed via ``bucket_capacity`` on growth
+so the overflow ladder lands on few distinct shapes — see
+``exec/fragments.py::_retry_traced``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Any, Optional
+
+from trino_tpu import types as T
+from trino_tpu.config import Session
+from trino_tpu.ir import Call, Constant, HoistedConstant, RowExpr, SpecialForm
+from trino_tpu.planner import plan as P
+
+# positions where a numeric literal compiles to a plain broadcast lane:
+# direct args of these calls (and the desugared members of IN/BETWEEN).
+# Everything else — function args the kernels need concrete, string
+# comparisons routed through dictionary truth tables — stays baked.
+_HOIST_CALLS = frozenset(
+    {"eq", "ne", "lt", "le", "gt", "ge",
+     "add", "subtract", "multiply", "divide", "modulus"}
+)
+_HOIST_FORMS = frozenset({"in", "between"})
+
+# session properties that change what a fragment traces into (capacity
+# defaults, execution strategy, lowering decisions). Anything NOT listed
+# here must not affect codegen, or same-fingerprint queries would want
+# different programs.
+_CODEGEN_PROPS = (
+    "batch_capacity",
+    "broadcast_join_threshold_rows",
+    "dynamic_filtering_max_build_rows",
+    "enable_dynamic_filtering",
+    "execution_mode",
+    "fragment_execution",
+    "join_distribution_type",
+    "join_reordering_strategy",
+    "skew_handling",
+    "skew_hot_k",
+    "skew_hot_threshold_frac",
+    "spill_enabled",
+    "spill_partitions",
+    "spill_threshold_rows",
+    "stats_capacity_seeding",
+    "stream_chunk_rows",
+    "stream_device_cache_bytes",
+    "stream_device_chunk_rows",
+    "stream_group_budget",
+    "stream_scan_threshold_rows",
+    "task_concurrency",
+    "tpu_enabled",
+    "worker_execution",
+)
+
+
+def _eligible(c: RowExpr) -> bool:
+    """Can this literal move to the parameter vector without changing the
+    traced program's shape or concreteness requirements?"""
+    if type(c) is not Constant:  # exact: never re-hoist a HoistedConstant
+        return False
+    if c.value is None:  # NULL handling branches on concreteness
+        return False
+    if T.is_string(c.type):  # becomes a dictionary truth table
+        return False
+    if not isinstance(c.value, (int, float)):
+        return False
+    if isinstance(c.value, int) and abs(c.value) >= 1 << 63:
+        return False  # wide decimal: extra hi/lo lanes (rank change)
+    return True
+
+
+def _hoist_expr(e: RowExpr, params: list, hoistable: bool) -> RowExpr:
+    """Depth-first rewrite; ``hoistable`` marks positions whose literals
+    the compiler lowers to plain broadcast lanes. Parameter order is the
+    visit order, which is a pure function of the plan shape — two plans
+    with equal shape assign equal indices."""
+    if isinstance(e, Call):
+        ok = e.name in _HOIST_CALLS and not any(
+            T.is_string(a.type) for a in e.args
+        )
+        args = tuple(_hoist_expr(a, params, ok) for a in e.args)
+        return e if args == e.args else Call(type=e.type, name=e.name, args=args)
+    if isinstance(e, SpecialForm):
+        ok = e.form in _HOIST_FORMS and not any(
+            T.is_string(a.type) for a in e.args
+        )
+        # args[0] is the tested value; members/bounds desugar to eq/ge/le
+        args = tuple(
+            _hoist_expr(a, params, ok and i > 0) for i, a in enumerate(e.args)
+        )
+        return (
+            e if args == e.args
+            else SpecialForm(type=e.type, form=e.form, args=args)
+        )
+    if hoistable and _eligible(e):
+        idx = len(params)
+        params.append((e.value, e.type))
+        return HoistedConstant(type=e.type, value=e.value, index=idx)
+    return e
+
+
+def _rewrite_node(node: P.PlanNode, params: list) -> P.PlanNode:
+    """Top-down: hoist this node's expressions, then recurse into sources.
+    Only Filter predicates, Project assignments and Join filters hoist —
+    every other expression position needs concrete values (Values rows,
+    aggregate masks, window defaults, scan pushdowns)."""
+    changes: dict[str, Any] = {}
+    if isinstance(node, P.Filter):
+        p2 = _hoist_expr(node.predicate, params, False)
+        if p2 is not node.predicate:
+            changes["predicate"] = p2
+    elif isinstance(node, P.Project):
+        new = [(s, _hoist_expr(e, params, False)) for s, e in node.assignments]
+        if any(e2 is not e for (_, e2), (_, e) in zip(new, node.assignments)):
+            changes["assignments"] = new
+    elif isinstance(node, P.Join) and node.filter is not None:
+        f2 = _hoist_expr(node.filter, params, False)
+        if f2 is not node.filter:
+            changes["filter"] = f2
+
+    if isinstance(node, P.Join):
+        left = _rewrite_node(node.left, params)
+        right = _rewrite_node(node.right, params)
+        if left is not node.left:
+            changes["left"] = left
+        if right is not node.right:
+            changes["right"] = right
+    elif isinstance(node, P.SetOp):
+        new_inputs = [_rewrite_node(s, params) for s in node.inputs]
+        if any(a is not b for a, b in zip(new_inputs, node.inputs)):
+            changes["inputs"] = new_inputs
+    elif getattr(node, "source", None) is not None:
+        src = _rewrite_node(node.source, params)
+        if src is not node.source:
+            changes["source"] = src
+    return dataclasses.replace(node, **changes) if changes else node
+
+
+def _strip_scan_constraints(node: P.PlanNode) -> P.PlanNode:
+    """Drop advisory scan pushdowns from a parameterized plan.
+
+    ``push_into_scans`` baked this query's literals into
+    ``TableScan.constraint`` (split pruning) and ``pushed_predicate``;
+    replaying them for a different literal could wrongly prune splits.
+    Both are advisory — the enclosing Filter still applies the full
+    (now parameterized) predicate — so correctness survives, only the
+    pruning shortcut is lost. ``limit``/``topn`` hints are structural
+    (never hoisted) and stay.
+    """
+    if isinstance(node, P.TableScan):
+        if node.constraint is not None or node.pushed_predicate is not None:
+            return dataclasses.replace(
+                node, constraint=None, pushed_predicate=None
+            )
+        return node
+    if isinstance(node, P.Join):
+        return dataclasses.replace(
+            node,
+            left=_strip_scan_constraints(node.left),
+            right=_strip_scan_constraints(node.right),
+        )
+    if isinstance(node, P.SetOp):
+        return dataclasses.replace(
+            node, inputs=[_strip_scan_constraints(s) for s in node.inputs]
+        )
+    if getattr(node, "source", None) is not None:
+        return dataclasses.replace(
+            node, source=_strip_scan_constraints(node.source)
+        )
+    return node
+
+
+def _alpha_rename(obj: Any, names: dict) -> Any:
+    """Positionally rename symbols in the serialized plan (``count_16`` →
+    ``s3``). The planner allocates symbol names off a process-global
+    counter, so two structurally identical plans planned at different
+    times carry different names; first-visit order is a pure function of
+    the plan shape, so equal shapes map to equal canonical names. Only
+    ``"n"`` values (symbol serde) and ``"name"`` values of ``var`` exprs
+    rename — ``call`` names are function names and stay."""
+    if isinstance(obj, list):
+        return [_alpha_rename(x, names) for x in obj]
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if k == "n" or (k == "name" and obj.get("k") == "var"):
+                if v not in names:
+                    names[v] = f"s{len(names)}"
+                out[k] = names[v]
+            else:
+                out[k] = _alpha_rename(v, names)
+        return out
+    return obj
+
+
+def plan_fingerprint(
+    root: P.PlanNode, session: Session, mesh_devices: int = 1, nparams: int = 0
+) -> Optional[str]:
+    """Stable sha256 over the canonical plan serde + codegen context.
+
+    Returns None when the plan contains nodes the canonical serde cannot
+    express (e.g. Unnest) — those statements simply run uncached.
+    """
+    from trino_tpu.planner.serde import node_to_json
+
+    try:
+        doc = _alpha_rename(node_to_json(root), {})
+        props = {}
+        for name in _CODEGEN_PROPS:
+            try:
+                props[name] = repr(session.get(name))
+            except KeyError:
+                continue
+        payload = json.dumps(
+            {
+                "plan": doc,
+                "mesh": int(mesh_devices),
+                "props": props,
+                "nparams": int(nparams),
+            },
+            sort_keys=True,
+            separators=(",", ":"),
+            default=str,
+        )
+    except Exception:  # noqa: BLE001 — unserializable plan: run uncached
+        return None
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def canonicalize_plan(
+    plan: P.PlanNode, session: Session, mesh_devices: int = 1
+) -> tuple[P.PlanNode, list, Optional[str]]:
+    """Hoist non-structural literals and fingerprint the optimized plan.
+
+    Returns ``(canonical_plan, params, fingerprint)`` where ``params`` is
+    the ordered list of ``(value, type)`` hoisted literals and
+    ``fingerprint`` is None for uncacheable shapes. With
+    ``constant_hoisting`` off the plan is returned untouched (every
+    literal variation then fingerprints — and compiles — separately).
+    """
+    params: list = []
+    root = plan
+    if bool(session.get("constant_hoisting")):
+        root = _rewrite_node(plan, params)
+        if params:
+            root = _strip_scan_constraints(root)
+    fp = plan_fingerprint(root, session, mesh_devices, nparams=len(params))
+    return root, params, fp

@@ -19,7 +19,15 @@ from decimal import Decimal
 from typing import Any
 
 from trino_tpu import types as T
-from trino_tpu.ir import Call, Constant, InputRef, RowExpr, SpecialForm, Variable
+from trino_tpu.ir import (
+    Call,
+    Constant,
+    HoistedConstant,
+    InputRef,
+    RowExpr,
+    SpecialForm,
+    Variable,
+)
 from trino_tpu.ops.sort import SortKey
 from trino_tpu.planner import plan as P
 from trino_tpu.planner.fragmenter import Partitioning, PlanFragment
@@ -34,6 +42,11 @@ def expr_to_json(e: RowExpr | None) -> Any:
     t = str(e.type)
     if isinstance(e, InputRef):
         return {"k": "input", "t": t, "channel": e.channel}
+    if isinstance(e, HoistedConstant):
+        # canonical by construction: the literal lives in the query's
+        # parameter vector, not the plan, so literal variants serialize —
+        # and fingerprint — identically (planner/canonicalize.py)
+        return {"k": "hoisted", "t": t, "index": e.index}
     if isinstance(e, Constant):
         v = e.value
         if isinstance(v, Decimal):
@@ -65,6 +78,10 @@ def expr_from_json(d: Any) -> RowExpr | None:
     k = d["k"]
     if k == "input":
         return InputRef(type=t, channel=d["channel"])
+    if k == "hoisted":
+        # the value is deliberately absent; execution must supply a
+        # parameter vector (interpreter paths re-bake from it too)
+        return HoistedConstant(type=t, value=None, index=d["index"])
     if k == "const":
         v = d["value"]
         if isinstance(v, dict) and "$decimal" in v:

@@ -200,6 +200,16 @@ class ManagedQuery:
             # skew-aware exchange counters (shuffle rows/bytes, padding
             # ratio, overflow retries, hot/salted keys, capacity provenance)
             "exchangeStats": self.result.exchange_stats if self.result else None,
+            # compile-time telemetry (cross-query program cache): a warm
+            # run shows traceCount == 0 and programCacheHits > 0
+            "compileMs": self.result.compile_ms if self.result else 0.0,
+            "traceCount": self.result.trace_count if self.result else 0,
+            "programCacheHits": (
+                self.result.program_cache_hits if self.result else 0
+            ),
+            "programCacheMisses": (
+                self.result.program_cache_misses if self.result else 0
+            ),
             "error": self.error.to_json() if self.error else None,
         }
 

@@ -37,6 +37,52 @@ from trino_tpu.serde import deserialize_batch, serialize_batch
 
 PAGE_ROWS = 1 << 16
 
+# Worker-side fragment/program memo (cross-attempt compile reuse): TASK
+# retry re-sends the same fragment payload to a worker; deserializing it
+# afresh gives the plan nodes new object identities, which makes every
+# program-store key miss and forces a full retrace per attempt. Keyed by
+# (query_id, fragment_id, payload digest), each entry pins ONE deserialized
+# PlanFragment (stable node ids) plus the program dict compiled against it,
+# so attempt N+1 re-executes attempt N's compiled programs. Entries hold
+# compiled executables — keep the bound small.
+_WORKER_FRAGMENT_CACHE_MAX = 8
+
+
+def _shared_fragment_entry(engine, query_id, payload_fragment, validate):
+    """Return a locked {fragment, programs, lock} entry for this payload, or
+    None when another live task of the same fragment holds it (concurrent
+    partitions must not share a FragmentedExecutor's mutable state)."""
+    import hashlib
+
+    from trino_tpu.planner.serde import fragment_from_json
+
+    cache = getattr(engine, "_task_fragment_cache", None)
+    if cache is None:
+        from collections import OrderedDict
+
+        cache = engine._task_fragment_cache = OrderedDict()
+        engine._task_fragment_cache_lock = threading.Lock()
+    digest = hashlib.sha256(
+        json.dumps(payload_fragment, sort_keys=True, default=str).encode()
+    ).hexdigest()
+    key = (query_id, payload_fragment.get("id"), digest)
+    with engine._task_fragment_cache_lock:
+        entry = cache.get(key)
+        if entry is None:
+            entry = {
+                "fragment": fragment_from_json(payload_fragment, validate=validate),
+                "programs": {},
+                "lock": threading.Lock(),
+            }
+            cache[key] = entry
+            while len(cache) > _WORKER_FRAGMENT_CACHE_MAX:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+    if not entry["lock"].acquire(blocking=False):
+        return None
+    return entry
+
 
 class OutputBuffer:
     """Per-partition page deques with token-acked consumption, bounded
@@ -357,7 +403,13 @@ class FusedWorkerRunner:
     - everything else splits contiguously.
     """
 
-    def __init__(self, engine, session: Session, fragment: PlanFragment):
+    def __init__(
+        self,
+        engine,
+        session: Session,
+        fragment: PlanFragment,
+        programs: Optional[dict] = None,
+    ):
         from trino_tpu.exec.fragments import FragmentedExecutor
         from trino_tpu.parallel.mesh import make_local_mesh
 
@@ -369,7 +421,9 @@ class FusedWorkerRunner:
         for k, v in session.properties.items():
             if k != "execution_mode":
                 local.properties[k] = v
-        self.executor = FragmentedExecutor(engine.catalogs, local, mesh)
+        self.executor = FragmentedExecutor(
+            engine.catalogs, local, mesh, programs=programs
+        )
         self.fragment = fragment
         self.mesh = mesh
 
@@ -617,9 +671,20 @@ class SqlTask:
         from trino_tpu.planner.sanity import validation_enabled
         from trino_tpu.planner.serde import fragment_from_json
 
-        self.fragment: PlanFragment = fragment_from_json(
-            payload["fragment"], validate=validation_enabled(self.session)
+        # TASK retry: reuse the attempt-1 fragment object (stable plan-node
+        # identities) and its compiled programs; lock released in _run()
+        self._frag_entry = _shared_fragment_entry(
+            engine,
+            task_id.rsplit(".", 2)[0],
+            payload["fragment"],
+            validation_enabled(self.session),
         )
+        if self._frag_entry is not None:
+            self.fragment: PlanFragment = self._frag_entry["fragment"]
+        else:
+            self.fragment = fragment_from_json(
+                payload["fragment"], validate=validation_enabled(self.session)
+            )
         self.splits: dict[str, list[dict]] = payload.get("splits", {})
         self.sources: dict[int, dict] = {
             int(k): v for k, v in payload.get("sources", {}).items()
@@ -742,6 +807,12 @@ class SqlTask:
             self.buffer.set_complete()
             if self._reserved:
                 self.engine.memory_pool.free(self.query_id, self._reserved)
+            # one-shot handoff (atomic pop): tests drive _run() directly on
+            # top of the constructor-started thread, and the entry lock
+            # must release exactly once no matter how many times _run ends
+            entry = self.__dict__.pop("_frag_entry", None)
+            if entry is not None:
+                entry["lock"].release()
 
     def _try_fused(self, prefetched, strict: bool = False) -> Optional[Result]:
         """Fragment as one compiled program on worker-local devices; None
@@ -762,7 +833,15 @@ class SqlTask:
                 )
             return None
         try:
-            runner = FusedWorkerRunner(self.engine, self.session, self.fragment)
+            # a concurrent _run() completion may have popped the entry;
+            # the fragment object itself stays valid either way
+            entry = getattr(self, "_frag_entry", None)
+            runner = FusedWorkerRunner(
+                self.engine,
+                self.session,
+                self.fragment,
+                programs=entry["programs"] if entry else None,
+            )
             source_meta = {
                 fid: {"keys": src.get("keys"), "symbols": src.get("symbols")}
                 for fid, src in self.sources.items()
@@ -774,6 +853,7 @@ class SqlTask:
             self.stats["dynamic_filters"] = len(
                 runner.executor.dynamic_filters
             )
+            self.stats["compile"] = dict(runner.executor.compile_stats)
             return result
         except (FusedUnsupported, jax.errors.TracerArrayConversionError) as e:
             if strict:
