@@ -52,6 +52,15 @@ def _emit(partial: bool = False) -> None:
     _EMITTED = True
     if partial:
         _RESULT["partial"] = True
+    # final metrics snapshot (query/compile/exchange histograms) rides the
+    # same single line, so a deadline partial still carries whatever the
+    # registry accumulated before the alarm fired
+    try:
+        from trino_tpu.obs.metrics import get_registry
+
+        _RESULT["metrics"] = get_registry().snapshot()
+    except Exception:  # noqa: BLE001 — the headline must print
+        pass
     print(json.dumps(_RESULT), flush=True)
 
 

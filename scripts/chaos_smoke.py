@@ -45,32 +45,53 @@ def main() -> int:
         "retry_max_delay_ms": 200,
     }
     skew_props = {"join_distribution_type": "PARTITIONED"}
-    with MultiProcessQueryRunner(n_workers=2) as runner:
-        clean, _ = runner.execute(Q1)
-        chaotic, _ = runner.execute(Q1, session_properties=chaos)
-        skew_clean, _ = runner.execute(Q_SKEW, session_properties=skew_props)
-        skew_chaotic, _ = runner.execute(
-            Q_SKEW, session_properties={**chaos, **skew_props}
-        )
-        from trino_tpu.server import auth
+    # the summary dict is built incrementally and emitted in a finally, so
+    # a crash mid-scenario still prints one machine-readable JSON line with
+    # whatever was gathered (partial: true)
+    summary: dict = {"seed": seed, "partial": True}
+    try:
+        with MultiProcessQueryRunner(n_workers=2) as runner:
+            clean, _ = runner.execute(Q1)
+            chaotic, _ = runner.execute(Q1, session_properties=chaos)
+            skew_clean, _ = runner.execute(
+                Q_SKEW, session_properties=skew_props
+            )
+            skew_chaotic, _ = runner.execute(
+                Q_SKEW, session_properties={**chaos, **skew_props}
+            )
+            from trino_tpu.server import auth
 
-        req = urllib.request.Request(
-            f"{runner.coordinator_uri}/v1/query", headers=auth.headers()
+            req = urllib.request.Request(
+                f"{runner.coordinator_uri}/v1/query", headers=auth.headers()
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                queries = json.loads(r.read().decode())
+            # coordinator metrics snapshot (task retries/attempt histograms)
+            # must be scraped before the cluster shuts down
+            with urllib.request.urlopen(
+                f"{runner.coordinator_uri}/v1/metrics?format=json", timeout=10
+            ) as r:
+                summary["metrics"] = json.loads(r.read().decode())
+        retries = max(q.get("taskRetries", 0) for q in queries)
+        summary.update(
+            seed=seed, rows=len(chaotic), task_retries=retries, partial=False
         )
-        with urllib.request.urlopen(req, timeout=10) as r:
-            queries = json.loads(r.read().decode())
-    retries = max(q.get("taskRetries", 0) for q in queries)
-    print(f"seed={seed} rows={len(chaotic)} task_retries={retries}")
-    if chaotic != clean:
-        print("FAIL: chaotic result differs from fault-free result")
-        return 1
-    if skew_chaotic != skew_clean:
-        print("FAIL: skewed-join chaotic result differs from fault-free")
-        return 1
-    if retries == 0:
-        print("WARN: no retries at this seed — injection never fired")
-    print("OK: bit-identical under 30% task-crash injection (incl. skewed join)")
-    return 0
+        print(f"seed={seed} rows={len(chaotic)} task_retries={retries}")
+        if chaotic != clean:
+            print("FAIL: chaotic result differs from fault-free result")
+            summary["ok"] = False
+            return 1
+        if skew_chaotic != skew_clean:
+            print("FAIL: skewed-join chaotic result differs from fault-free")
+            summary["ok"] = False
+            return 1
+        if retries == 0:
+            print("WARN: no retries at this seed — injection never fired")
+        print("OK: bit-identical under 30% task-crash injection (incl. skewed join)")
+        summary["ok"] = True
+        return 0
+    finally:
+        print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

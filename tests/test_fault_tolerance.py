@@ -555,12 +555,17 @@ class TestTaskRetryChaos:
                 )
             errors.append(ei.value.error)
         assert all(e.get("retryable") is True for e in errors)
-        # the query COUNTER differs per run by design; the injected fault
-        # (site, draw, failing fragment.partition) must replay exactly
+        # the query ID differs per run by design (task ids embed it:
+        # {yyyyMMdd_HHmmss_index_coord}.{stage}.{task}, or cq{n} for
+        # direct scheduler calls); the injected fault (site, draw,
+        # failing fragment.partition) must replay exactly
         import re
 
         normalized = [
-            re.sub(r"cq\d+", "cq#", e["message"]) for e in errors
+            re.sub(
+                r"\d{8}_\d{6}_\d{5}_\w+|cq\d+", "qid#", e["message"]
+            )
+            for e in errors
         ]
         assert normalized[0] == normalized[1], (
             "same seed must reproduce the same failure"

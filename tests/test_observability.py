@@ -1,13 +1,20 @@
-"""Observability: EXPLAIN ANALYZE stats, event listeners, system tables.
+"""Observability: spans, metrics, EXPLAIN ANALYZE, events, system tables.
 
 Mirrors reference tests ``execution/TestEventListenerBasic.java``,
-PlanPrinter stats rendering, and system connector tests.
+PlanPrinter stats rendering, and system connector tests; the tracing
+tests mirror the OpenTelemetry span assertions in
+``testing/trino-testing/.../TestingTelemetry`` usage (span parentage
+across coordinator → worker HTTP dispatch).
 """
+
+import json
+import urllib.error
+import urllib.request
 
 import pytest
 
 from trino_tpu.events import EventListener
-from trino_tpu.testing import LocalQueryRunner
+from trino_tpu.testing import LocalQueryRunner, MultiProcessQueryRunner
 
 
 @pytest.fixture(scope="module")
@@ -114,6 +121,366 @@ class TestSystemTables:
             assert rows[0][0].startswith("http://")
         finally:
             s.stop()
+
+
+class TestTracer:
+    """Unit coverage for trino_tpu.obs.trace (no server)."""
+
+    def test_noop_when_no_sink(self):
+        from trino_tpu.obs.trace import NOOP_SPAN, Tracer
+
+        t = Tracer()
+        s = t.start_span("query")
+        assert s is NOOP_SPAN  # shared singleton: zero alloc when dark
+        s.set("k", "v")
+        s.finish(status="ERROR")
+        assert s.context() is None
+        with t.span("child"):
+            assert t.current() is None
+
+    def test_nesting_and_sink(self):
+        from trino_tpu.obs.trace import InMemorySpanSink, Tracer
+
+        t = Tracer()
+        sink = InMemorySpanSink()
+        t.add_sink(sink)
+        with t.span("query", trace_id="q1") as root:
+            with t.span("plan"):
+                pass
+            t.record("compile", 12.5, attrs={"key": "k"})
+        spans = {s["name"]: s for s in sink.spans_for("q1")}
+        assert set(spans) == {"query", "plan", "compile"}
+        assert spans["plan"]["parentId"] == root.span_id
+        assert spans["compile"]["parentId"] == root.span_id
+        assert spans["compile"]["durationMs"] == 12.5
+        assert spans["query"]["parentId"] is None
+        assert all(s["traceId"] == "q1" for s in spans.values())
+
+    def test_error_status_on_exception(self):
+        from trino_tpu.obs.trace import InMemorySpanSink, Tracer
+
+        t = Tracer()
+        sink = InMemorySpanSink()
+        t.add_sink(sink)
+        with pytest.raises(ValueError):
+            with t.span("query", trace_id="q2"):
+                raise ValueError("boom")
+        (s,) = sink.spans_for("q2")
+        assert s["status"] == "ERROR"
+        assert "boom" in s["attrs"].get("error", "")
+
+    def test_header_roundtrip(self):
+        from trino_tpu.obs.trace import format_trace_header, parse_trace_header
+
+        assert format_trace_header(None) is None
+        assert parse_trace_header(None) is None
+        assert parse_trace_header("garbage") is None
+        hdr = format_trace_header(("q7", "s42"))
+        assert hdr == "q7;s42"
+        assert parse_trace_header(hdr) == ("q7", "s42")
+
+    def test_explicit_parent_crosses_threads(self):
+        import threading
+
+        from trino_tpu.obs.trace import InMemorySpanSink, Tracer
+
+        t = Tracer()
+        sink = InMemorySpanSink()
+        t.add_sink(sink)
+        root = t.start_span("query", trace_id="q3")
+        ctx = root.context()
+
+        def worker():
+            # fresh thread: no ambient context, explicit handoff required
+            assert t.current() is None
+            t.start_span(
+                "task_execute", trace_id=ctx[0], parent_id=ctx[1]
+            ).finish()
+
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+        root.finish()
+        spans = {s["name"]: s for s in sink.spans_for("q3")}
+        assert spans["task_execute"]["parentId"] == root.span_id
+
+
+class TestMetricsRegistry:
+    """Unit coverage for trino_tpu.obs.metrics (no server)."""
+
+    def test_counter_gauge_histogram(self):
+        from trino_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("q_total", state="FINISHED").inc()
+        reg.counter("q_total", state="FINISHED").inc(2)
+        reg.counter("q_total", state="FAILED").inc()
+        reg.gauge("running").set(3)
+        h = reg.histogram("lat_ms", buckets=(10, 100, 1000))
+        for v in (5, 50, 50, 500):
+            h.observe(v)
+        assert reg.counter("q_total", state="FINISHED").value == 3
+        assert reg.gauge("running").value == 3
+        assert h.count == 4 and h.sum == 605
+
+    def test_type_mismatch_rejected(self):
+        from trino_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("x_total").inc()
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+
+    def test_prometheus_render(self):
+        from trino_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("q_total", state="FINISHED").inc()
+        reg.histogram("lat_ms", buckets=(10, 100)).observe(42)
+        text = reg.render_prometheus()
+        assert "# TYPE q_total counter" in text
+        assert 'q_total{state="FINISHED"} 1' in text
+        assert "# TYPE lat_ms histogram" in text
+        # cumulative buckets end with +Inf; _sum/_count ride along
+        assert 'lat_ms_bucket{le="10"} 0' in text
+        assert 'lat_ms_bucket{le="100"} 1' in text
+        assert 'lat_ms_bucket{le="+Inf"} 1' in text
+        assert "lat_ms_sum 42" in text
+        assert "lat_ms_count 1" in text
+
+    def test_percentile_exact(self):
+        from trino_tpu.obs.metrics import percentile
+
+        assert percentile([], 50) is None
+        assert percentile([7.0], 99) == 7.0
+        vals = [10.0, 20.0, 30.0, 40.0]
+        assert percentile(vals, 50) == 25.0
+        assert percentile(vals, 0) == 10.0
+        assert percentile(vals, 100) == 40.0
+        assert percentile(vals, 50) <= percentile(vals, 99)
+
+    def test_snapshot_shape(self):
+        from trino_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(5)
+        reg.histogram("h_ms").observe(10)
+        snap = reg.snapshot()
+        assert snap["counters"]["c_total"] == 5
+        h = next(iter(snap["histograms"].values()))
+        assert h["count"] == 1 and h["sum"] == 10
+
+
+class TestTracingIsInert:
+    def test_rows_identical_with_tracer_on(self, runner):
+        """Acceptance: tracer-enabled and disabled runs are bit-identical
+        — all instrumentation is host-side, outside compiled programs."""
+        from trino_tpu.obs.trace import InMemorySpanSink, get_tracer
+
+        sql = (
+            "select l_returnflag, sum(l_extendedprice * (1 - l_discount)) "
+            "from tpch.tiny.lineitem group by l_returnflag "
+            "order by l_returnflag"
+        )
+        dark, _ = runner.execute(sql)
+        sink = InMemorySpanSink()
+        get_tracer().add_sink(sink)
+        try:
+            lit, _ = runner.execute(sql)
+        finally:
+            get_tracer().remove_sink(sink)
+        assert lit == dark
+        assert sink.trace_ids()  # and it actually traced something
+
+
+# --- distributed span/metrics tests (one shared 2-node cluster) ----------
+
+
+def _get_json(uri: str, path: str):
+    from trino_tpu.server import auth
+
+    req = urllib.request.Request(f"{uri}{path}", headers=auth.headers())
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read().decode())
+
+
+def _get_text(uri: str, path: str) -> str:
+    from trino_tpu.server import auth
+
+    req = urllib.request.Request(f"{uri}{path}", headers=auth.headers())
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.read().decode()
+
+
+def _query_id_for(coordinator_uri: str, sql_fragment: str) -> str:
+    qs = [
+        q
+        for q in _get_json(coordinator_uri, "/v1/query")
+        if sql_fragment in q["query"]
+    ]
+    assert qs, f"no query matching {sql_fragment!r} on the coordinator"
+    return qs[-1]["queryId"]
+
+
+def _cluster_timeline(cluster, qid: str) -> list:
+    """Union of the coordinator's and every worker's span dump for one
+    trace — the cross-process view a real backend would assemble."""
+    spans = list(_get_json(
+        cluster.coordinator_uri, f"/v1/query/{qid}/timeline"
+    )["spans"])
+    for uri in cluster.worker_uris:
+        try:
+            spans.extend(_get_json(uri, f"/v1/query/{qid}/timeline")["spans"])
+        except urllib.error.HTTPError:
+            pass  # worker saw no tasks for this query
+    return spans
+
+
+@pytest.fixture(scope="module")
+def obs_cluster():
+    with MultiProcessQueryRunner(n_workers=2) as runner:
+        yield runner
+
+
+Q5_MARKER = "revenue"
+
+
+class TestDistributedSpans:
+    def test_q5_span_tree_connected(self, obs_cluster):
+        """TPC-H Q5 on a 2-node cluster yields one connected span tree:
+        worker task_execute spans parent (via X-Trino-Trace) to the
+        coordinator's task_attempt spans, which parent to stage spans,
+        which reach the query root."""
+        from trino_tpu.benchmarks.tpch import queries
+
+        rows, _ = obs_cluster.execute(queries("tpch.tiny")[5])
+        assert rows
+        qid = _query_id_for(obs_cluster.coordinator_uri, Q5_MARKER)
+        spans = _cluster_timeline(obs_cluster, qid)
+        assert all(s["traceId"] == qid for s in spans)
+        by_id = {s["spanId"]: s for s in spans}
+        roots = [s for s in spans if s["parentId"] is None]
+        assert len(roots) == 1 and roots[0]["name"] == "query"
+
+        def depth(s, seen=50):
+            while s["parentId"] is not None and seen:
+                s = by_id[s["parentId"]]  # KeyError == disconnected tree
+                seen -= 1
+            return s
+
+        # every span chains up to the single root — no orphans anywhere
+        for s in spans:
+            assert depth(s)["spanId"] == roots[0]["spanId"]
+
+        names = {s["name"] for s in spans}
+        assert {"query", "execute", "plan", "optimize", "fragment",
+                "stage", "task_attempt"} <= names
+        # worker-side spans joined the same tree across the HTTP gap
+        execs = [s for s in spans if s["name"] == "task_execute"]
+        assert execs
+        attempt_ids = {
+            s["spanId"] for s in spans if s["name"] == "task_attempt"
+        }
+        assert all(s["parentId"] in attempt_ids for s in execs)
+        # multi-stage query: a join tree fans out over both workers
+        stages = [s for s in spans if s["name"] == "stage"]
+        assert len(stages) >= 2
+        workers = {
+            s["attrs"].get("worker")
+            for s in spans
+            if s["name"] == "task_attempt"
+        }
+        assert len(workers) == 2
+
+    def test_metrics_scrape_format(self, obs_cluster):
+        text = _get_text(obs_cluster.coordinator_uri, "/v1/metrics")
+        assert "# TYPE trino_tpu_queries_total counter" in text
+        assert "# TYPE trino_tpu_query_elapsed_ms histogram" in text
+        assert 'trino_tpu_queries_total{state="FINISHED"}' in text
+        # per-stage elapsed histograms from the coordinator rollup
+        assert "# TYPE trino_tpu_stage_elapsed_ms histogram" in text
+        assert 'trino_tpu_stage_elapsed_ms_bucket{' in text
+        assert 'le="+Inf"' in text
+        assert "trino_tpu_task_elapsed_ms_count" in text
+
+    def test_task_histogram_counts_consistent(self, obs_cluster):
+        """Every FINISHED attempt is observed exactly once: the per-stage
+        task-elapsed histogram total equals the FINISHED task counter."""
+        snap = _get_json(
+            obs_cluster.coordinator_uri, "/v1/metrics?format=json"
+        )
+        finished = sum(
+            v
+            for k, v in snap["counters"].items()
+            if k.startswith("trino_tpu_tasks_total")
+            and 'state="FINISHED"' in k
+        )
+        observed = sum(
+            h["count"]
+            for k, h in snap["histograms"].items()
+            if k.startswith("trino_tpu_task_elapsed_ms")
+        )
+        assert finished > 0
+        assert observed == finished
+
+    def test_query_stats_stage_percentiles(self, obs_cluster):
+        qid = _query_id_for(obs_cluster.coordinator_uri, Q5_MARKER)
+        info = _get_json(obs_cluster.coordinator_uri, f"/v1/query/{qid}")
+        stats = info["queryStats"]
+        assert stats["elapsedMs"] >= 0 and stats["queuedMs"] >= 0
+        stages = stats["stages"]
+        assert stages
+        multi = [s for s in stages if s.get("tasks", 0) >= 2]
+        assert multi, "expected a fan-out stage on a 2-worker cluster"
+        for s in multi:
+            te = s["taskElapsedMs"]
+            assert te["count"] == s["tasks"]
+            assert 0 <= te["p50"] <= te["p99"] <= te["max"]
+
+    def test_timeline_404_for_unknown_query(self, obs_cluster):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(
+                obs_cluster.coordinator_uri, "/v1/query/nope_xyz/timeline"
+            )
+        assert ei.value.code == 404
+
+    @pytest.mark.faults
+    def test_retry_spans_under_task_policy(self, obs_cluster):
+        """Chaos: with 30% task-crash injection the timeline shows the
+        retried dispatch attempts (attempt >= 2, retry flag) and the
+        retries counter moves."""
+        before = _get_json(
+            obs_cluster.coordinator_uri, "/v1/metrics?format=json"
+        )["counters"].get("trino_tpu_task_retries_total", 0)
+        rows, _ = obs_cluster.execute(
+            "select count(*) as chaos_probe from lineitem",
+            session_properties={
+                "retry_policy": "TASK",
+                "task_retry_attempts": 8,
+                "fault_injection_seed": 3,
+                "fault_task_crash_p": 0.3,
+                "retry_initial_delay_ms": 20,
+                "retry_max_delay_ms": 200,
+            },
+        )
+        assert rows
+        qid = _query_id_for(obs_cluster.coordinator_uri, "chaos_probe")
+        spans = _cluster_timeline(obs_cluster, qid)
+        retries = [
+            s
+            for s in spans
+            if s["name"] == "task_attempt"
+            and s["attrs"].get("attempt", 1) >= 2
+        ]
+        assert retries, "seed 3 must produce at least one retried attempt"
+        assert all(s["attrs"].get("retry") for s in retries)
+        # first attempts closed as failed, retried attempts as OK
+        info = _get_json(obs_cluster.coordinator_uri, f"/v1/query/{qid}")
+        assert info["taskRetries"] >= 1
+        after = _get_json(
+            obs_cluster.coordinator_uri, "/v1/metrics?format=json"
+        )["counters"].get("trino_tpu_task_retries_total", 0)
+        assert after - before >= 1
 
 
 class TestFusedExplainAnalyze:

@@ -184,7 +184,7 @@ class Engine:
             rec = dict(rec)
             if rec["state"] == "RUNNING":  # live elapsed for in-flight queries
                 rec["elapsedTimeMillis"] = int(
-                    (_time.time() - rec["_start"]) * 1000
+                    (_time.monotonic() - rec["_start"]) * 1000
                 )
             rec.pop("_start", None)
             out.append(rec)
@@ -202,23 +202,50 @@ class Engine:
 
     # === entry ============================================================
 
-    def execute_statement(self, sql: str, session: Session) -> StatementResult:
+    def execute_statement(
+        self,
+        sql: str,
+        session: Session,
+        query_id: Optional[str] = None,
+        fire_events: bool = True,
+    ) -> StatementResult:
+        """Run one statement.
+
+        ``query_id`` lets a caller that already owns the query lifecycle
+        (ManagedQuery on the server) pin its id so traces/events/system
+        tables all agree; ``fire_events=False`` hands event ownership to
+        that caller too, so server terminal paths (kill/cancel/reject)
+        can fire exactly one completed event themselves.
+        """
         import time as _time
 
         from trino_tpu.events import QueryCompletedEvent, QueryCreatedEvent
+        from trino_tpu.obs.metrics import get_registry
+        from trino_tpu.obs.trace import get_tracer
 
-        qid = self._next_query_id()
-        t0 = _time.time()
-        self.event_listeners.fire_created(
-            QueryCreatedEvent(qid, sql, session.user, t0)
+        qid = query_id or self._next_query_id()
+        t0 = _time.time()  # epoch: event create_time / display only
+        t0m = _time.monotonic()  # interval math
+        if fire_events:
+            self.event_listeners.fire_created(
+                QueryCreatedEvent(qid, sql, session.user, t0)
+            )
+        tracer = get_tracer()
+        # root span when standalone; child "execute" span when a server
+        # ManagedQuery already opened the query root on this thread
+        span = tracer.start_span(
+            "query" if tracer.current() is None else "execute",
+            trace_id=qid if tracer.current() is None else None,
+            attrs={"queryId": qid, "user": session.user},
         )
         record = {
             "queryId": qid, "state": "RUNNING", "user": session.user,
             "source": session.source, "query": sql, "elapsedTimeMillis": 0,
-            "peakMemoryBytes": 0, "outputRows": 0, "_start": t0,
+            "peakMemoryBytes": 0, "outputRows": 0, "_start": t0m,
         }
         self._recent_queries.append(record)
         error: Optional[str] = None
+        exc: Optional[BaseException] = None
         res: Optional[StatementResult] = None
         # Validate + pin the session's explicit transaction for the duration
         # of this statement: a stale/expired __txn must error (reference
@@ -234,31 +261,68 @@ class Engine:
                 except Exception:
                     session.properties.pop("__txn", None)
                     raise
-            res = self._execute_statement_inner(sql, session, qid)
+            with tracer.activate(span):
+                res = self._execute_statement_inner(sql, session, qid)
             return res
         except Exception as e:  # noqa: BLE001
             error = str(e)
+            exc = e
             raise
         finally:
             if txn_info is not None:
                 txn_info.busy -= 1
                 txn_info.last_access = _time.time()
             end = _time.time()
+            wall = _time.monotonic() - t0m
             record["state"] = "FINISHED" if error is None else "FAILED"
-            record["elapsedTimeMillis"] = int((end - t0) * 1000)
+            record["elapsedTimeMillis"] = int(wall * 1000)
             if res is not None:
                 record["peakMemoryBytes"] = res.peak_memory_bytes
                 record["outputRows"] = len(res.rows)
-            self.event_listeners.fire_completed(
-                QueryCompletedEvent(
-                    qid, sql, session.user, t0, end,
-                    record["state"],
-                    output_rows=record["outputRows"],
-                    peak_memory_bytes=record["peakMemoryBytes"],
-                    error_message=error,
-                    wall_seconds=end - t0,
-                )
+            span.finish(
+                status="OK" if error is None else "ERROR",
+                state=record["state"],
+                rows=record["outputRows"],
             )
+            self._record_query_metrics(get_registry(), record, res, wall)
+            if fire_events:
+                err_code = err_type = None
+                if exc is not None:
+                    from trino_tpu.errors import classify_error
+
+                    err_code, _, err_type = classify_error(exc)
+                self.event_listeners.fire_completed(
+                    QueryCompletedEvent(
+                        qid, sql, session.user, t0, end,
+                        record["state"],
+                        output_rows=record["outputRows"],
+                        peak_memory_bytes=record["peakMemoryBytes"],
+                        error_message=error,
+                        wall_seconds=wall,
+                        error_code=err_code,
+                        error_type=err_type,
+                    )
+                )
+
+    @staticmethod
+    def _record_query_metrics(reg, record: dict, res, wall_s: float) -> None:
+        """Fold one statement's counters into the process registry."""
+        reg.counter("trino_tpu_queries_total", state=record["state"]).inc()
+        reg.histogram("trino_tpu_query_elapsed_ms").observe(wall_s * 1000.0)
+        reg.counter("trino_tpu_output_rows_total").inc(record["outputRows"])
+        if res is None:
+            return
+        reg.counter("trino_tpu_compile_ms_total").inc(res.compile_ms)
+        reg.counter("trino_tpu_trace_count_total").inc(res.trace_count)
+        reg.counter("trino_tpu_program_cache_hits_total").inc(
+            res.program_cache_hits
+        )
+        reg.counter("trino_tpu_program_cache_misses_total").inc(
+            res.program_cache_misses
+        )
+        for key, val in (res.exchange_stats or {}).items():
+            if isinstance(val, (int, float)) and not isinstance(val, bool):
+                reg.counter(f"trino_tpu_exchange_{key}_total").inc(val)
 
     def _execute_statement_inner(
         self, sql: str, session: Session, query_id: Optional[str] = None
@@ -357,11 +421,15 @@ class Engine:
         return fp, params
 
     def plan(self, stmt: t.Node, session: Session) -> P.PlanNode:
+        from trino_tpu.obs.trace import get_tracer
         from trino_tpu.planner.optimizer import optimize
 
+        tracer = get_tracer()
         analyzer = Analyzer(self.catalogs, session, self.access_control)
-        plan = analyzer.plan_statement(stmt)
-        return optimize(plan, session, self.catalogs)
+        with tracer.span("plan"):
+            plan = analyzer.plan_statement(stmt)
+        with tracer.span("optimize"):
+            return optimize(plan, session, self.catalogs)
 
     # === DQL ==============================================================
 
@@ -392,7 +460,8 @@ class Engine:
             cluster_stats: dict[str, Any] = {}
             if batch is None and self.cluster_scheduler is not None:
                 batch, names = self.cluster_scheduler.execute(
-                    plan, session, stats_sink=cluster_stats
+                    plan, session, stats_sink=cluster_stats,
+                    query_id=query_id,
                 )
             if batch is not None:
                 return StatementResult(

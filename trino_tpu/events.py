@@ -4,13 +4,17 @@ Reference: ``event/QueryMonitor.java:92,134,210`` builds
 created/completed events → ``eventlistener/EventListenerManager.java`` →
 pluggable ``EventListener``s (``spi/eventlistener/``,
 ``Plugin.getEventListenerFactories`` at ``spi/Plugin.java:80``).
+
+Stage/task completion events (``SplitCompletedEvent`` territory in the
+reference) are fired by the cluster scheduler once per stage / per task
+attempt, carrying the elapsed + retry accounting the observability
+registry aggregates.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import time
-from typing import Any, Optional
+from typing import Optional
 
 
 @dataclasses.dataclass
@@ -28,11 +32,40 @@ class QueryCompletedEvent:
     user: str
     create_time: float
     end_time: float
-    state: str  # FINISHED | FAILED
+    state: str  # FINISHED | FAILED | CANCELED
     output_rows: int = 0
     peak_memory_bytes: int = 0
     error_message: Optional[str] = None
     wall_seconds: float = 0.0
+    # classification matching the /v1/query error block (trino_tpu.errors)
+    error_code: Optional[int] = None
+    error_type: Optional[str] = None
+
+
+@dataclasses.dataclass
+class StageCompletedEvent:
+    query_id: str
+    stage_id: int
+    state: str  # FINISHED | FAILED
+    tasks: int = 0
+    attempts: int = 0
+    elapsed_ms: float = 0.0
+    # sibling task elapsed distribution (straggler/speculation signal)
+    task_elapsed_p50_ms: Optional[float] = None
+    task_elapsed_p99_ms: Optional[float] = None
+
+
+@dataclasses.dataclass
+class TaskCompletedEvent:
+    query_id: str
+    stage_id: int
+    task_id: str
+    worker: str
+    state: str  # FINISHED | FAILED | CANCELED | ...
+    attempt: int = 1
+    elapsed_ms: float = 0.0
+    rows: int = 0
+    error_message: Optional[str] = None
 
 
 class EventListener:
@@ -42,6 +75,12 @@ class EventListener:
         pass
 
     def query_completed(self, event: QueryCompletedEvent) -> None:  # noqa: B027
+        pass
+
+    def stage_completed(self, event: StageCompletedEvent) -> None:  # noqa: B027
+        pass
+
+    def task_completed(self, event: TaskCompletedEvent) -> None:  # noqa: B027
         pass
 
 
@@ -63,5 +102,19 @@ class EventListenerManager:
         for l in self._listeners:
             try:
                 l.query_completed(event)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def fire_stage_completed(self, event: StageCompletedEvent) -> None:
+        for l in self._listeners:
+            try:
+                l.stage_completed(event)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def fire_task_completed(self, event: TaskCompletedEvent) -> None:
+        for l in self._listeners:
+            try:
+                l.task_completed(event)
             except Exception:  # noqa: BLE001
                 pass

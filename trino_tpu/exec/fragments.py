@@ -45,6 +45,8 @@ from jax.sharding import PartitionSpec as PS
 from trino_tpu import types as T
 from trino_tpu.columnar import Batch, Column, Dictionary, bucket_capacity
 from trino_tpu.exec.local import ExecutionError, Result, rank_codes, sum_spec_for
+from trino_tpu.obs.metrics import get_registry
+from trino_tpu.obs.trace import get_tracer
 from trino_tpu.ops import join as J
 from trino_tpu.ops.aggregation import AggSpec, global_aggregate, group_aggregate
 from trino_tpu.ops.sort import sort_indices
@@ -356,7 +358,8 @@ class FragmentedExecutor(DistributedExecutor):
         # identities, so the fragmentation must be stable too
         sub = self.programs.get("__subplan__")
         if sub is None:
-            sub = fragment_plan(node)
+            with get_tracer().span("fragment"):
+                sub = fragment_plan(node)
             self.programs["__subplan__"] = sub
         if not query_fusable(sub):
             return super().execute(node)
@@ -581,6 +584,8 @@ class FragmentedExecutor(DistributedExecutor):
     # === fragment scheduling ============================================
 
     def _execute_fragments(self, sub: SubPlan) -> tuple[Batch, list[str]]:
+        import time as _time
+
         results: dict[int, Result] = {}
         names_holder: dict[int, list[str]] = {}
 
@@ -641,7 +646,16 @@ class FragmentedExecutor(DistributedExecutor):
             extras = [
                 jnp.ravel(f.astype(jnp.int32)) for _, _, f, _ in deferred
             ] + [jnp.ravel(c) for _, c, _ in dcounters if c is not None]
+            t_pull = _time.perf_counter()
             host_root, extra_vals = root.batch.to_host(extras=extras)
+            pull_ms = (_time.perf_counter() - t_pull) * 1000.0
+            get_tracer().record(
+                "device_pull", pull_ms,
+                attrs={"extras": len(extras), "attempt": attempts},
+            )
+            get_registry().histogram("trino_tpu_device_pull_ms").observe(
+                pull_ms
+            )
             flag_vals = extra_vals[: len(deferred)]
             counter_vals = list(extra_vals[len(deferred):])
             overflowed = False
@@ -678,11 +692,29 @@ class FragmentedExecutor(DistributedExecutor):
         results: dict[int, Result],
         names_holder: dict[int, list[str]],
     ) -> Result:
+        # span per fragment execution; program_compile / exchange spans
+        # emitted inside parent to it via the ambient stack
+        span = get_tracer().start_span(
+            "fragment_execute", attrs={"stage": frag.id}
+        )
+        with span:
+            return self._run_fragment_spanned(
+                frag, results, names_holder, span
+            )
+
+    def _run_fragment_spanned(
+        self,
+        frag: PlanFragment,
+        results: dict[int, Result],
+        names_holder: dict[int, list[str]],
+        span,
+    ) -> Result:
         import time as _time
 
         t0 = _time.perf_counter()
         streamed = self._try_streaming(frag, names_holder, results)
         if streamed is not None:
+            span.set("mode", "streamed")
             if self.stats_collector is not None:
                 self.stats_collector.record_fragment(
                     frag.id,
@@ -772,6 +804,9 @@ class FragmentedExecutor(DistributedExecutor):
         aux = getattr(self, "_last_aux", ())
         if aux:
             self._hot_sets[frag.id] = aux
+        span.set("mode", "fused")
+        if sink:
+            span.set("attempts", sink.get("attempts", 1))
         if self.stats_collector is not None:
             self.stats_collector.record_fragment(
                 frag.id,
@@ -949,10 +984,19 @@ class FragmentedExecutor(DistributedExecutor):
                 # trace + lower + (XLA or disk-cache) compile happen
                 # synchronously inside the first call; execution itself
                 # dispatches async, so this wall time ≈ compile cost
+                compile_ms = (_time.perf_counter() - t0) * 1000.0
                 self.compile_stats["trace_count"] += 1
-                self.compile_stats["compile_ms"] += (
-                    _time.perf_counter() - t0
-                ) * 1000.0
+                self.compile_stats["compile_ms"] += compile_ms
+                get_tracer().record(
+                    "program_compile", compile_ms,
+                    attrs={
+                        "key": repr(program_key) if program_key else None,
+                        "attempt": attempts,
+                    },
+                )
+                get_registry().histogram(
+                    "trino_tpu_program_compile_ms"
+                ).observe(compile_ms)
             self._last_aux = aux
             if defer and getattr(self, "deferred_flags", None) is not None:
                 if flags:

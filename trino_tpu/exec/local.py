@@ -103,13 +103,18 @@ class LocalExecutor:
 
     # === entry ==========================================================
     def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
-        if isinstance(node, P.Output):
-            res = self._exec(node.source)
-            cols = [res.column(s) for s in node.symbols]
-            out = Batch(cols, res.batch.num_rows, res.batch.sel).compact()
-            return out, node.column_names
-        res = self._exec(node)
-        return res.batch.compact(), [s.name for s in node.output_symbols]
+        from trino_tpu.obs.trace import get_tracer
+
+        with get_tracer().span(
+            "execute_plan", attrs={"executor": type(self).__name__}
+        ):
+            if isinstance(node, P.Output):
+                res = self._exec(node.source)
+                cols = [res.column(s) for s in node.symbols]
+                out = Batch(cols, res.batch.num_rows, res.batch.sel).compact()
+                return out, node.column_names
+            res = self._exec(node)
+            return res.batch.compact(), [s.name for s in node.output_symbols]
 
     @staticmethod
     def _nonempty(res: Result) -> Result:

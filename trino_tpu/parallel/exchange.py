@@ -321,10 +321,24 @@ def skew_split_counts(
             jax.lax.pmax(counts[n], AXIS),
         )
 
+    import time as _time
+
+    from trino_tpu.obs.trace import get_tracer
+
+    t0 = _time.perf_counter()
     cold_max, hot_max = go(key_hash, sel, hot_hashes, hot_valid)
-    return max(8, int(np.asarray(cold_max).max())), max(
-        8, int(np.asarray(hot_max).max())
+    out = (
+        max(8, int(np.asarray(cold_max).max())),
+        max(8, int(np.asarray(hot_max).max())),
     )
+    # eager host-blocking sizing pass (the repartition kernels themselves
+    # are traced collectives — no host-side span possible there)
+    get_tracer().record(
+        "exchange_sizing",
+        (_time.perf_counter() - t0) * 1000.0,
+        attrs={"kind": "skew", "cold_max": out[0], "hot_max": out[1]},
+    )
+    return out
 
 
 def needed_bucket(mesh: Mesh, key_hash: jax.Array, sel: jax.Array) -> int:
@@ -345,7 +359,18 @@ def needed_bucket(mesh: Mesh, key_hash: jax.Array, sel: jax.Array) -> int:
         local_max = jnp.max(counts)
         return jax.lax.pmax(local_max, AXIS)
 
-    return max(8, int(np.asarray(go(key_hash, sel)).max()))
+    import time as _time
+
+    from trino_tpu.obs.trace import get_tracer
+
+    t0 = _time.perf_counter()
+    bucket = max(8, int(np.asarray(go(key_hash, sel)).max()))
+    get_tracer().record(
+        "exchange_sizing",
+        (_time.perf_counter() - t0) * 1000.0,
+        attrs={"kind": "bucket", "bucket": bucket},
+    )
+    return bucket
 
 
 def broadcast_all(mesh: Mesh, arrays: Sequence[jax.Array], sel: jax.Array):

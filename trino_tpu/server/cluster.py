@@ -27,7 +27,10 @@ import urllib.request
 from typing import Optional
 
 from trino_tpu.config import Session
+from trino_tpu.events import StageCompletedEvent, TaskCompletedEvent
 from trino_tpu.exec.local import ExecutionError, Result
+from trino_tpu.obs.metrics import get_registry, percentile
+from trino_tpu.obs.trace import TRACE_HEADER, format_trace_header, get_tracer
 from trino_tpu.planner import plan as P
 from trino_tpu.planner.fragmenter import (
     HASH,
@@ -238,6 +241,15 @@ class HttpRemoteTask:
         self.backoff = backoff or Backoff()
         # set instead of raising when a TASK-retry dispatch fails to start
         self.start_error: Optional[str] = None
+        # observability: the dispatch attempt's span + propagation context
+        # ((trace_id, span_id) rides X-Trino-Trace so the worker's
+        # task_execute span parents to this attempt), last observed status
+        # for the end-of-query finalize pass, and attempt ordinal
+        self.trace = None
+        self.span = None
+        self.attempt = 1
+        self.last_status: Optional[dict] = None
+        self._obs_done = False
 
     def _site_target(self) -> str:
         # "cq7.3.0r1" -> "3.0r1": stable across runs, fresh per attempt
@@ -269,6 +281,9 @@ class HttpRemoteTask:
                 )
                 if body is not None:
                     req.add_header("Content-Type", "application/json")
+                header = format_trace_header(self.trace)
+                if header is not None:
+                    req.add_header(TRACE_HEADER, header)
                 with urllib.request.urlopen(
                     req, timeout=timeout or self.timeout
                 ) as r:
@@ -287,9 +302,11 @@ class HttpRemoteTask:
 
     def status(self, max_wait: float = 0.0) -> dict:
         uri = self.uri + (f"?maxWait={max_wait}" if max_wait else "")
-        return self._request(
+        st = self._request(
             "status", "GET", uri, timeout=max(self.timeout, max_wait + 10)
         )
+        self.last_status = st
+        return st
 
     def cancel(self) -> None:
         try:
@@ -340,17 +357,26 @@ class ClusterScheduler:
             "backoff": Backoff.from_session(session),
         }
 
-    def execute(self, plan: P.PlanNode, session: Session, stats_sink=None):
+    def execute(
+        self,
+        plan: P.PlanNode,
+        session: Session,
+        stats_sink=None,
+        query_id: Optional[str] = None,
+    ):
         """Returns (Batch, column_names). ``stats_sink`` (dict) receives
-        retry/attempt counters for query stats and /v1/query."""
+        retry/attempt counters plus a per-stage ``stages`` rollup for
+        query stats and /v1/query."""
         from trino_tpu.ft.retry import RetryPolicy
 
-        sub = fragment_plan(plan)
+        tracer = get_tracer()
+        with tracer.span("fragment"):
+            sub = fragment_plan(plan)
         nodes = self.node_manager.active_nodes()
         if not nodes:
             raise ExecutionError("no active workers in the cluster")
         n = len(nodes)
-        query_id = f"cq{next(_task_counter)}"
+        query_id = query_id or f"cq{next(_task_counter)}"
         policy = RetryPolicy.from_session(session)
         stats = stats_sink if stats_sink is not None else {}
         stats.setdefault("retry_policy", policy)
@@ -394,10 +420,23 @@ class ClusterScheduler:
                 and k not in ("execution_mode",)
             },
         }
+        # per-execute observability state (the scheduler instance is shared
+        # across concurrent queries, so nothing goes on ``self``):
+        # stage spans stay open until the query finalizes, ``elapsed``
+        # collects FINISHED sibling-task wall times per stage for the
+        # p50/p99 rollup, ``stage_start`` is monotonic per stage
+        obs: dict = {"stage_spans": {}, "elapsed": {}, "stage_start": {}}
+        ok = False
         try:
             for frag in order:
                 if frag.id == sub.fragment.id:
                     continue
+                obs["stage_start"][frag.id] = time.monotonic()
+                stage_span = tracer.start_span(
+                    "stage",
+                    attrs={"stage": frag.id, "tasks": task_counts[frag.id]},
+                )
+                obs["stage_spans"][frag.id] = stage_span
                 remote_tasks[frag.id] = self._schedule_fragment(
                     query_id,
                     frag,
@@ -409,6 +448,7 @@ class ClusterScheduler:
                     fragments,
                     policy=policy,
                     http=http,
+                    stage_span=stage_span,
                 )
                 if policy == RetryPolicy.TASK:
                     # stage barrier: producers must FINISH (with retained
@@ -418,10 +458,23 @@ class ClusterScheduler:
                     self._await_fragment(
                         query_id, frag, remote_tasks[frag.id],
                         session, stats, http,
+                        stage_span=stage_span, obs=obs,
                     )
-            result = self._execute_root(
-                sub.fragment, session, remote_tasks, task_counts, policy
+            obs["stage_start"][sub.fragment.id] = time.monotonic()
+            root_span = tracer.start_span(
+                "stage",
+                attrs={
+                    "stage": sub.fragment.id,
+                    "tasks": 0,
+                    "coordinator": True,
+                },
             )
+            obs["stage_spans"][sub.fragment.id] = root_span
+            with tracer.activate(root_span):
+                result = self._execute_root(
+                    sub.fragment, session, remote_tasks, task_counts, policy
+                )
+            ok = True
             if policy == RetryPolicy.TASK:
                 # retained buffers never free on ack; release them now
                 for tasks in remote_tasks.values():
@@ -434,6 +487,12 @@ class ClusterScheduler:
                     t.cancel()
             raise
         finally:
+            # close attempt/stage spans, fire stage/task events, and build
+            # stats["stages"] BEFORE releasing nodes — the caller reads
+            # ``stats`` right after execute() returns
+            self._finalize_query(
+                query_id, stats, remote_tasks, task_counts, obs, ok
+            )
             for tasks in remote_tasks.values():
                 for t in tasks:
                     self.node_scheduler.release(t.node)
@@ -488,6 +547,7 @@ class ClusterScheduler:
         fragments: dict[int, PlanFragment],
         policy: str = "NONE",
         http: Optional[dict] = None,
+        stage_span=None,
     ) -> list[HttpRemoteTask]:
         from trino_tpu.ft.retry import RetryPolicy, is_retryable
         from trino_tpu.planner.serde import fragment_to_json
@@ -546,6 +606,21 @@ class ClusterScheduler:
                 task = HttpRemoteTask(
                     placements[p], f"{query_id}.{frag.id}.{p}", payload, **http
                 )
+                att = get_tracer().start_span(
+                    "task_attempt",
+                    trace_id=getattr(stage_span, "trace_id", None),
+                    parent_id=getattr(stage_span, "span_id", None),
+                    attrs={
+                        "taskId": task.task_id,
+                        "stage": frag.id,
+                        "worker": placements[p].node_id,
+                        "attempt": 1,
+                    },
+                )
+                task.span = att
+                # rides X-Trino-Trace so the worker's task_execute span
+                # parents to this dispatch attempt
+                task.trace = att.context()
                 if policy == RetryPolicy.TASK:
                     # a dispatch failure is just attempt 1 failing: defer
                     # to the stage barrier, which retries it elsewhere
@@ -597,6 +672,8 @@ class ClusterScheduler:
         session: Session,
         stats: dict,
         http: dict,
+        stage_span=None,
+        obs: Optional[dict] = None,
     ) -> None:
         """Block until every task of ``frag`` is FINISHED, re-dispatching
         failed attempts (``{qid}.{frag}.{p}`` -> ``...{p}r{k}``) to other
@@ -625,17 +702,20 @@ class ClusterScheduler:
         attempts = [1] * len(tasks)
         # per-attempt deadline: a hung-but-responsive worker must not
         # stall the stage barrier forever — overrun counts as a
-        # retryable attempt failure
-        deadlines = [time.time() + stage_budget] * len(tasks)
+        # retryable attempt failure (monotonic: wall-clock jumps must not
+        # spuriously expire the budget)
+        deadlines = [time.monotonic() + stage_budget] * len(tasks)
         pending = set(range(len(tasks)))
         while pending:
             for i in sorted(pending):
                 t = tasks[i]
                 if t.start_error is not None:
                     failure, retryable = t.start_error, True
-                elif time.time() > deadlines[i]:
+                    fail_st = {"state": "FAILED", "error": failure}
+                elif time.monotonic() > deadlines[i]:
                     failure = f"task attempt exceeded {stage_budget}s stage budget"
                     retryable = True
+                    fail_st = {"state": "FAILED", "error": failure}
                 else:
                     try:
                         st = t.status(max_wait=1.0)
@@ -645,9 +725,11 @@ class ClusterScheduler:
                         # worker unreachable through all HTTP retries:
                         # treat the attempt as lost
                         failure, retryable = f"unreachable: {e}", True
+                        fail_st = {"state": "FAILED", "error": failure}
                     else:
                         state = st.get("state")
                         if state == "FINISHED":
+                            self._finish_attempt(query_id, frag.id, t, st, obs)
                             pending.discard(i)
                             continue
                         if state != "FAILED":
@@ -655,6 +737,8 @@ class ClusterScheduler:
                         failure = st.get("error")
                         r = st.get("retryable")
                         retryable = True if r is None else bool(r)
+                        fail_st = st
+                self._finish_attempt(query_id, frag.id, t, fail_st, obs)
                 if not retryable:
                     raise TaskFailure(
                         t.task_id, t.node.node_id, failure, retryable=False
@@ -673,17 +757,170 @@ class ClusterScheduler:
                 new_id = f"{base}r{attempts[i] - 1}"
                 stats["task_retries"] = stats.get("task_retries", 0) + 1
                 stats.setdefault("task_attempts", {})[base] = attempts[i]
+                get_registry().counter("trino_tpu_task_retries_total").inc()
                 retry = HttpRemoteTask(node, new_id, t.payload, **http)
+                retry.attempt = attempts[i]
+                att = get_tracer().start_span(
+                    "task_attempt",
+                    trace_id=getattr(stage_span, "trace_id", None),
+                    parent_id=getattr(stage_span, "span_id", None),
+                    attrs={
+                        "taskId": new_id,
+                        "stage": frag.id,
+                        "worker": node.node_id,
+                        "attempt": attempts[i],
+                        "retry": True,
+                    },
+                )
+                retry.span = att
+                retry.trace = att.context()
                 # swap in before start(): the query-level cleanup releases
                 # whatever sits in ``tasks``, and the old node is released
                 tasks[i] = retry
-                deadlines[i] = time.time() + stage_budget
+                deadlines[i] = time.monotonic() + stage_budget
                 try:
                     retry.start()
                 except Exception as e:  # noqa: BLE001
                     if not is_retryable(e):
                         raise
                     retry.start_error = str(e)
+
+    # --- per-attempt / per-query observability rollup ---------------------
+
+    def _finish_attempt(
+        self,
+        query_id: str,
+        frag_id: int,
+        t: HttpRemoteTask,
+        st: Optional[dict],
+        obs: Optional[dict],
+    ) -> None:
+        """Close one dispatch attempt: span, counters, sibling-elapsed
+        sample, TaskCompletedEvent. Idempotent per attempt — the stage
+        barrier, _first_failed_status, and the end-of-query finalize can
+        each observe the same task."""
+        if t._obs_done:
+            return
+        t._obs_done = True
+        st = st or {}
+        state = st.get("state") or "UNKNOWN"
+        elapsed_ms = float(st.get("elapsed") or 0.0) * 1000.0
+        reg = get_registry()
+        reg.counter("trino_tpu_tasks_total", state=state).inc()
+        if state == "FINISHED":
+            # sibling elapsed within a stage feeds the p50/p99 rollup the
+            # speculative-execution roadmap item needs
+            if obs is not None:
+                obs["elapsed"].setdefault(frag_id, []).append(elapsed_ms)
+            reg.histogram(
+                "trino_tpu_task_elapsed_ms", stage=str(frag_id)
+            ).observe(elapsed_ms)
+        if t.span is not None:
+            attrs = {"state": state, "elapsedMs": elapsed_ms}
+            if st.get("error"):
+                attrs["error"] = st.get("error")
+            t.span.finish(
+                status="OK" if state == "FINISHED" else "ERROR", **attrs
+            )
+        listeners = getattr(self.engine, "event_listeners", None)
+        if listeners is not None:
+            listeners.fire_task_completed(
+                TaskCompletedEvent(
+                    query_id=query_id,
+                    stage_id=frag_id,
+                    task_id=t.task_id,
+                    worker=t.node.node_id,
+                    state=state,
+                    attempt=t.attempt,
+                    elapsed_ms=elapsed_ms,
+                    error_message=st.get("error"),
+                )
+            )
+
+    def _finalize_query(
+        self,
+        query_id: str,
+        stats: dict,
+        remote_tasks: dict[int, list[HttpRemoteTask]],
+        task_counts: dict[int, int],
+        obs: dict,
+        ok: bool,
+    ) -> None:
+        """End-of-query rollup (runs on success AND failure, tracer on or
+        off): close remaining attempt spans, close stage spans, observe
+        stage metrics, fire stage events, and build ``stats['stages']``
+        (elapsedMs + sibling task p50/p99) for queryStats."""
+        for fid, tasks in remote_tasks.items():
+            for t in tasks:
+                if t._obs_done:
+                    continue
+                st = t.last_status
+                terminal = st is not None and st.get("state") in (
+                    "FINISHED", "FAILED", "CANCELED",
+                )
+                if ok and not terminal:
+                    # one best-effort poll only on the success path — a
+                    # failed query may have unreachable workers
+                    try:
+                        st = t.status()
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._finish_attempt(query_id, fid, t, st, obs)
+        reg = get_registry()
+        listeners = getattr(self.engine, "event_listeners", None)
+        task_attempts = stats.get("task_attempts", {})
+        now = time.monotonic()
+        stages = []
+        for fid in sorted(obs["stage_spans"]):
+            start = obs["stage_start"].get(fid)
+            elapsed_ms = (now - start) * 1000.0 if start is not None else 0.0
+            n_tasks = task_counts.get(fid, 0)
+            # retries recorded as {query_id}.{fid}.{i} -> total attempts
+            extra = 0
+            for base, a in task_attempts.items():
+                rest = base[len(query_id) + 1:] if base.startswith(
+                    query_id + "."
+                ) else ""
+                if rest.split(".", 1)[0] == str(fid):
+                    extra += a - 1
+            n_attempts = n_tasks + extra
+            entry = {
+                "stage": fid,
+                "tasks": n_tasks,
+                "attempts": n_attempts,
+                "elapsedMs": elapsed_ms,
+            }
+            vals = obs["elapsed"].get(fid, [])
+            if vals:
+                entry["taskElapsedMs"] = {
+                    "count": len(vals),
+                    "p50": percentile(vals, 50),
+                    "p99": percentile(vals, 99),
+                    "max": max(vals),
+                }
+            stages.append(entry)
+            reg.histogram(
+                "trino_tpu_stage_elapsed_ms", stage=str(fid)
+            ).observe(elapsed_ms)
+            obs["stage_spans"][fid].finish(
+                status="OK" if ok else "ERROR",
+                tasks=n_tasks,
+                attempts=n_attempts,
+            )
+            if listeners is not None:
+                listeners.fire_stage_completed(
+                    StageCompletedEvent(
+                        query_id=query_id,
+                        stage_id=fid,
+                        state="FINISHED" if ok else "FAILED",
+                        tasks=n_tasks,
+                        attempts=n_attempts,
+                        elapsed_ms=elapsed_ms,
+                        task_elapsed_p50_ms=percentile(vals, 50),
+                        task_elapsed_p99_ms=percentile(vals, 99),
+                    )
+                )
+        stats["stages"] = stages
 
     # --- root fragment on the coordinator --------------------------------
 

@@ -59,10 +59,15 @@ class TrinoTpuServer:
         spmd: bool = False,
         cluster_memory_limit_bytes: Optional[int] = None,
     ):
+        from trino_tpu.obs.trace import InMemorySpanSink, get_tracer
         from trino_tpu.server.resourcegroups import ResourceGroupManager
         from trino_tpu.server.task import SqlTaskManager
 
         self.engine = engine or Engine()
+        # registering a sink is what turns tracing ON for this process;
+        # a bare Engine (no server) stays dark and pays nothing
+        self.span_sink = InMemorySpanSink()
+        get_tracer().add_sink(self.span_sink)
         self.role = role
         self.node_id = node_id or f"{role}-{port}"
         self.discovery_uri = discovery_uri
@@ -163,10 +168,13 @@ class TrinoTpuServer:
             time.sleep(2.0)
 
     def stop(self) -> None:
+        from trino_tpu.obs.trace import get_tracer
+
         self.state = "STOPPED"
         self.httpd.shutdown()
         self.httpd.server_close()
         self.query_manager.shutdown(wait=False)
+        get_tracer().remove_sink(self.span_sink)
 
     def graceful_shutdown(self) -> None:
         """Drain: refuse new queries, wait for active ones, then stop
@@ -363,9 +371,16 @@ def _make_handler(server: TrinoTpuServer):
             parts = [p for p in path.split("/") if p]
             if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                 # TaskResource.createOrUpdateTask (reference :127)
+                from trino_tpu.obs.trace import TRACE_HEADER, parse_trace_header
+
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length).decode())
-                task = server.task_manager.create_or_update(parts[2], payload)
+                # coordinator attempt span context: the worker's
+                # task_execute span parents to it across the process gap
+                trace = parse_trace_header(self.headers.get(TRACE_HEADER))
+                task = server.task_manager.create_or_update(
+                    parts[2], payload, trace=trace
+                )
                 return self._send_json(task.info())
             if path == "/v1/write":
                 # scaled-writer data plane: binary serialized batch in the
@@ -493,10 +508,39 @@ def _make_handler(server: TrinoTpuServer):
                         "failureInfo": server.node_manager.failure_detector.info(),
                     }
                 )
+            if path == "/v1/metrics":
+                # Prometheus text scrape (text format 0.0.4); ?format=json
+                # returns the structured snapshot for bench/chaos embeds
+                from trino_tpu.obs.metrics import get_registry
+
+                qs = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+                if qs.get("format", [""])[0] == "json":
+                    return self._send_json(get_registry().snapshot())
+                body = get_registry().render_prometheus().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
             if path == "/v1/query":
                 return self._send_json(
                     [q.info() for q in server.query_manager.queries()]
                 )
+            if (
+                len(parts) == 4
+                and parts[:2] == ["v1", "query"]
+                and parts[3] == "timeline"
+            ):
+                # span dump for one trace (= query id). Workers hold spans
+                # for queries they never registered, so 404 only when the
+                # id is unknown to BOTH the query manager and the sink.
+                spans = server.span_sink.spans_for(parts[2])
+                if not spans and server.query_manager.get(parts[2]) is None:
+                    return self._error(404, "query not found")
+                return self._send_json({"queryId": parts[2], "spans": spans})
             if len(parts) == 3 and parts[:2] == ["v1", "query"]:
                 q = server.query_manager.get(parts[2])
                 if q is None:

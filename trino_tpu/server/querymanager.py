@@ -4,11 +4,19 @@ Reference: ``dispatcher/DispatchManager.java:61,148`` (createQuery →
 queue → execute), ``execution/SqlQueryManager`` (registry/limits),
 ``execution/QueryStateMachine.java`` (lifecycle + stats), and
 ``server/protocol/Query.java:117`` (paged result serving).
+
+Observability: each ManagedQuery owns the query's root span (trace id =
+query id) and fires QueryCreated/QueryCompleted events exactly once per
+query across EVERY terminal path — normal completion, failure,
+client cancel, coordinator kill (CLUSTER_OUT_OF_MEMORY), and
+resource-group rejection. Interval math uses ``time.monotonic()``;
+epoch timestamps survive only in display fields (createTime/endTime).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import itertools
 import secrets
 import threading
@@ -19,6 +27,7 @@ from typing import Any, Optional
 from trino_tpu import types as T
 from trino_tpu.config import Session
 from trino_tpu.engine import Engine, StatementResult
+from trino_tpu.obs.trace import get_tracer
 from trino_tpu.server.statemachine import (
     QueryState,
     StateMachine,
@@ -62,7 +71,7 @@ class ErrorInfo:
 class ManagedQuery:
     """One query's full lifecycle + buffered results."""
 
-    def __init__(self, sql: str, session: Session):
+    def __init__(self, sql: str, session: Session, engine: Optional[Engine] = None):
         self.query_id = _new_query_id()
         self.slug = "x" + secrets.token_hex(8)
         self.sql = sql
@@ -70,15 +79,27 @@ class ManagedQuery:
         self.state = new_query_state_machine(self.query_id)
         self.result: Optional[StatementResult] = None
         self.error: Optional[ErrorInfo] = None
-        self.create_time = time.time()
+        self.create_time = time.time()  # epoch: createTime display only
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
-        self.last_access = time.time()  # protocol touch; guards history GC
+        self._create_mono = time.monotonic()
+        self._end_mono: Optional[float] = None
+        self.last_access = time.monotonic()  # protocol touch; guards history GC
         self._cancelled = threading.Event()
         self.query_attempts = 1  # >1 under retry_policy=QUERY
+        self._engine = engine
+        self._completed_fired = False
+        self._completed_lock = threading.Lock()
+        # root span for the whole query (covers queued time); the dispatch
+        # thread re-activates it so engine/scheduler spans nest under it
+        self.span = get_tracer().start_span(
+            "query",
+            trace_id=self.query_id,
+            attrs={"queryId": self.query_id, "user": session.user},
+        )
 
     def touch(self) -> None:
-        self.last_access = time.time()
+        self.last_access = time.monotonic()
 
     # --- lifecycle --------------------------------------------------------
 
@@ -102,49 +123,36 @@ class ManagedQuery:
         else:
             max_attempts = 1
         backoff = Backoff.from_session(self.session)
+        tracer = get_tracer()
         try:
             if self._cancelled.is_set():
                 return
             self.state.set(QueryState.RUNNING)
             attempt = 1
-            while True:
-                try:
-                    if attempt > 1:
-                        self.session.properties["fault_attempt_salt"] = attempt
-                    self.result = engine.execute_statement(self.sql, self.session)
-                    break
-                except Exception as e:  # noqa: BLE001
-                    if (
-                        attempt >= max_attempts
-                        or self._cancelled.is_set()
-                        or not is_retryable(e)
-                    ):
-                        raise
-                    time.sleep(backoff.delay(attempt))
-                    attempt += 1
-                    self.query_attempts = attempt
+            with tracer.activate(self.span):
+                while True:
+                    try:
+                        if attempt > 1:
+                            self.session.properties["fault_attempt_salt"] = attempt
+                        self.result = self._call_engine(engine)
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        if (
+                            attempt >= max_attempts
+                            or self._cancelled.is_set()
+                            or not is_retryable(e)
+                        ):
+                            raise
+                        time.sleep(backoff.delay(attempt))
+                        attempt += 1
+                        self.query_attempts = attempt
             self.state.set(QueryState.FINISHING)
             self.state.set(QueryState.FINISHED)
         except Exception as e:  # noqa: BLE001 — any failure fails the query
-            from trino_tpu.analyzer import SemanticError
-            from trino_tpu.memory import ExceededMemoryLimitError
-            from trino_tpu.planner.sanity import PlanValidationError
-            from trino_tpu.sql.lexer import SqlSyntaxError
+            from trino_tpu.errors import classify_error
+            from trino_tpu.ft.retry import is_retryable
 
-            if isinstance(e, SqlSyntaxError):
-                code, name, typ = 1, "SYNTAX_ERROR", "USER_ERROR"
-            elif isinstance(e, SemanticError):
-                code, name, typ = 2, "SEMANTIC_ERROR", "USER_ERROR"
-            elif isinstance(e, PlanValidationError):
-                # a sanity checker rejected the plan: an engine bug, not a
-                # user error — name the checker in the /v1/query error
-                code, name, typ = 65537, "PLAN_VALIDATION_ERROR", "INTERNAL_ERROR"
-            elif isinstance(e, ExceededMemoryLimitError):
-                code, name, typ = 131075, "EXCEEDED_MEMORY_LIMIT", "INSUFFICIENT_RESOURCES"
-            elif isinstance(e, KeyError):
-                code, name, typ = 2, "SEMANTIC_ERROR", "USER_ERROR"
-            else:
-                code, name, typ = 65536, "GENERIC_INTERNAL_ERROR", "INTERNAL_ERROR"
+            code, name, typ = classify_error(e)
             self.error = ErrorInfo(
                 str(e), code, name, typ, traceback.format_exc(),
                 retryable=is_retryable(e),
@@ -152,12 +160,66 @@ class ManagedQuery:
             self.state.set(QueryState.FAILED)
         finally:
             self.end_time = time.time()
+            self._end_mono = time.monotonic()
+            self._fire_completed(engine)
+
+    def _call_engine(self, engine: Engine) -> StatementResult:
+        """Invoke the engine, pinning this query's id and taking event
+        ownership when the engine supports it (test doubles may not)."""
+        try:
+            params = inspect.signature(engine.execute_statement).parameters
+            extended = "fire_events" in params
+        except (TypeError, ValueError):  # builtins / exotic callables
+            extended = False
+        if extended:
+            return engine.execute_statement(
+                self.sql, self.session,
+                query_id=self.query_id, fire_events=False,
+            )
+        return engine.execute_statement(self.sql, self.session)
+
+    def _fire_completed(self, engine: Optional[Engine] = None) -> None:
+        """Fire QueryCompletedEvent exactly once, on whichever terminal
+        path got here first, and close the root span."""
+        with self._completed_lock:
+            if self._completed_fired:
+                return
+            self._completed_fired = True
+        st = self.state.get()
+        end = self.end_time or time.time()
+        wall = (self._end_mono or time.monotonic()) - self._create_mono
+        self.span.finish(
+            status="OK" if st == QueryState.FINISHED else "ERROR",
+            state=st.value,
+        )
+        eng = engine or self._engine
+        listeners = getattr(eng, "event_listeners", None)
+        if listeners is None:
+            return
+        from trino_tpu.events import QueryCompletedEvent
+
+        listeners.fire_completed(
+            QueryCompletedEvent(
+                self.query_id, self.sql, self.session.user,
+                self.create_time, end, st.value,
+                output_rows=len(self.result.rows) if self.result else 0,
+                peak_memory_bytes=(
+                    self.result.peak_memory_bytes if self.result else 0
+                ),
+                error_message=self.error.message if self.error else None,
+                wall_seconds=wall,
+                error_code=self.error.error_code if self.error else None,
+                error_type=self.error.error_type if self.error else None,
+            )
+        )
 
     def cancel(self) -> None:
         self._cancelled.set()
         if self.state.set(QueryState.CANCELED):
             self.error = ErrorInfo("Query was canceled", 1, "USER_CANCELED", "USER_ERROR")
             self.end_time = time.time()
+            self._end_mono = time.monotonic()
+            self._fire_completed()
 
     def kill(self, message: str) -> bool:
         """Administrative kill (cluster memory manager): FAILED with
@@ -170,6 +232,8 @@ class ManagedQuery:
                 "INSUFFICIENT_RESOURCES",
             )
             self.end_time = time.time()
+            self._end_mono = time.monotonic()
+            self._fire_completed()
             return True
         return False
 
@@ -177,7 +241,7 @@ class ManagedQuery:
 
     def info(self) -> dict:
         st = self.state.get()
-        elapsed = (self.end_time or time.time()) - self.create_time
+        elapsed = (self._end_mono or time.monotonic()) - self._create_mono
         cluster_stats = self.result.cluster_stats if self.result else {}
         return {
             "queryId": self.query_id,
@@ -197,6 +261,9 @@ class ManagedQuery:
             "queryAttempts": self.query_attempts,
             "taskRetries": cluster_stats.get("task_retries", 0),
             "taskAttempts": cluster_stats.get("task_attempts", {}),
+            # per-stage rollup (obs): elapsed + sibling task elapsed
+            # p50/p99 — the speculative-execution straggler signal
+            "queryStats": self._query_stats(elapsed, cluster_stats),
             # skew-aware exchange counters (shuffle rows/bytes, padding
             # ratio, overflow retries, hot/salted keys, capacity provenance)
             "exchangeStats": self.result.exchange_stats if self.result else None,
@@ -212,6 +279,24 @@ class ManagedQuery:
             ),
             "error": self.error.to_json() if self.error else None,
         }
+
+    def _query_stats(self, elapsed_s: float, cluster_stats: dict) -> dict:
+        return {
+            "elapsedMs": int(elapsed_s * 1000),
+            "queuedMs": int(
+                ((self._start_mono() or time.monotonic()) - self._create_mono)
+                * 1000
+            ),
+            "stages": cluster_stats.get("stages", []),
+        }
+
+    def _start_mono(self) -> Optional[float]:
+        # start_time is epoch; approximate queued interval from epoch delta
+        # clamped non-negative (display-grade only — a wall-clock step
+        # during the queue wait can skew this, never the elapsed fields)
+        if self.start_time is None:
+            return None
+        return self._create_mono + max(0.0, self.start_time - self.create_time)
 
 
 class QueryManager:
@@ -242,12 +327,21 @@ class QueryManager:
         self._shutdown = False
 
     def create_query(self, sql: str, session: Session) -> ManagedQuery:
-        q = ManagedQuery(sql, session)
+        q = ManagedQuery(sql, session, engine=self.engine)
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("query manager is shut down")
             self._queries[q.query_id] = q
             self._gc_locked()
+        listeners = getattr(self.engine, "event_listeners", None)
+        if listeners is not None:
+            from trino_tpu.events import QueryCreatedEvent
+
+            listeners.fire_created(
+                QueryCreatedEvent(
+                    q.query_id, sql, session.user, q.create_time
+                )
+            )
         threading.Thread(target=self._dispatch, args=(q,), daemon=True).start()
         return q
 
@@ -264,6 +358,8 @@ class QueryManager:
             q.error = ErrorInfo(str(e), 3, "QUERY_REJECTED", "USER_ERROR")
             q.state.set(QueryState.FAILED)
             q.end_time = time.time()
+            q._end_mono = time.monotonic()
+            q._fire_completed(self.engine)
         finally:
             if admitted and self._complete is not None:
                 self._complete(q, token)
@@ -294,7 +390,7 @@ class QueryManager:
             return
         # evict least-recently-ACCESSED terminal queries only: a client may
         # still be paging a finished query's buffered results
-        now = time.time()
+        now = time.monotonic()
         done = [
             q
             for q in self._queries.values()

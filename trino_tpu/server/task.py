@@ -158,7 +158,7 @@ class OutputBuffer:
         """Pages from `token` on; blocks up to max_wait for more data.
         A request at token N acks (frees) pages below N. Returns
         (pages, next_token, complete)."""
-        deadline = time.time() + max_wait
+        deadline = time.monotonic() + max_wait
         with self._lock:
             # acknowledge everything below `token`
             base = self._base[partition]
@@ -174,7 +174,7 @@ class OutputBuffer:
                 pages = self._pages[partition][max(0, token - base):]
                 if pages or self._complete:
                     return pages, token + len(pages), self._complete
-                remaining = deadline - time.time()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return [], token, False
                 self._lock.wait(remaining)
@@ -203,6 +203,7 @@ class ExchangeClient:
         injector=None,
         http_retries: int = 3,
         backoff=None,
+        trace=None,
     ):
         from trino_tpu.ft.retry import Backoff
 
@@ -213,10 +214,15 @@ class ExchangeClient:
         self.injector = injector
         self.http_retries = max(1, int(http_retries))
         self.backoff = backoff or Backoff()
+        # (trace_id, parent_span_id) for the exchange_read span: pull
+        # threads start with a fresh context, so callers that spawn one
+        # thread per source must capture and pass the parent explicitly
+        self.trace = trace
 
     @classmethod
     def for_session(
-        cls, session, locations: list[str], partition: int, injector=None
+        cls, session, locations: list[str], partition: int, injector=None,
+        trace=None,
     ) -> "ExchangeClient":
         """Injector may be passed in to share one event log / counter set
         with the caller (the owning task); otherwise it is derived from
@@ -233,9 +239,10 @@ class ExchangeClient:
                 injector=injector or FaultInjector.from_session(session),
                 http_retries=int(session.get("http_retry_attempts")),
                 backoff=Backoff.from_session(session),
+                trace=trace,
             )
         except KeyError:  # sessions predating the ft properties
-            return cls(locations, partition, injector=injector)
+            return cls(locations, partition, injector=injector, trace=trace)
 
     def _get_json(self, loc: str, uri: str, token: int, deadline: float) -> dict:
         """One token read, retried through transient errors. The site key
@@ -246,7 +253,7 @@ class ExchangeClient:
         task_tail = loc.rsplit("/", 1)[-1].split(".", 1)[-1]
         last: Optional[Exception] = None
         for attempt in range(1, self.http_retries + 1):
-            if time.time() > deadline and last is not None:
+            if time.monotonic() > deadline and last is not None:
                 break
             from trino_tpu.server import auth
 
@@ -272,9 +279,16 @@ class ExchangeClient:
         raise last  # deadline exceeded mid-retry
 
     def read_all(self) -> list[Batch]:
+        from trino_tpu.obs.metrics import get_registry
+        from trino_tpu.obs.trace import get_tracer
+
+        tracer = get_tracer()
+        ctx = self.trace or tracer.context()
+        t0 = time.monotonic()
         batches: list[Batch] = []
         threads = []
         errors: list[Exception] = []
+        xfer = {"pages": 0, "bytes": 0}
         lock = threading.Lock()
 
         def pull(loc: str):
@@ -282,7 +296,7 @@ class ExchangeClient:
 
             try:
                 token = 0
-                deadline = time.time() + self.timeout
+                deadline = time.monotonic() + self.timeout
                 while True:
                     uri = (
                         f"{loc}/results/{self.partition}/{token}"
@@ -290,9 +304,12 @@ class ExchangeClient:
                     )
                     payload = self._get_json(loc, uri, token, deadline)
                     for b64 in payload["pages"]:
-                        batch = deserialize_batch(base64.b64decode(b64))
+                        raw = base64.b64decode(b64)
+                        batch = deserialize_batch(raw)
                         with lock:
                             batches.append(batch)
+                            xfer["pages"] += 1
+                            xfer["bytes"] += len(raw)
                     token = payload["token"]
                     if payload["complete"]:
                         # final ack frees the last unacked page window on
@@ -310,25 +327,44 @@ class ExchangeClient:
                         return
                     if payload.get("failed"):
                         raise RuntimeError(payload.get("error", "upstream task failed"))
-                    if time.time() > deadline:
+                    if time.monotonic() > deadline:
                         raise TimeoutError(f"exchange timed out reading {uri}")
             except Exception as e:  # noqa: BLE001
                 with lock:
                     errors.append(e)
 
-        for loc in self.locations:
-            t = threading.Thread(target=pull, args=(loc,), daemon=True)
-            t.start()
-            threads.append(t)
-        deadline = time.time() + self.timeout
-        for t in threads:
-            t.join(max(0.0, deadline - time.time()))
-        if errors:
-            raise errors[0]
-        if any(t.is_alive() for t in threads):
-            # a stalled puller must not yield silently-partial results
-            raise TimeoutError("exchange read timed out with pulls in flight")
-        return batches
+        try:
+            for loc in self.locations:
+                t = threading.Thread(target=pull, args=(loc,), daemon=True)
+                t.start()
+                threads.append(t)
+            deadline = time.monotonic() + self.timeout
+            for t in threads:
+                t.join(max(0.0, deadline - time.monotonic()))
+            if errors:
+                raise errors[0]
+            if any(t.is_alive() for t in threads):
+                # a stalled puller must not yield silently-partial results
+                raise TimeoutError("exchange read timed out with pulls in flight")
+            return batches
+        finally:
+            dur_ms = (time.monotonic() - t0) * 1000.0
+            tracer.record(
+                "exchange_read", dur_ms,
+                attrs={
+                    "locations": len(self.locations),
+                    "partition": self.partition,
+                    "pages": xfer["pages"],
+                    "bytes": xfer["bytes"],
+                },
+                trace_id=ctx[0] if ctx else None,
+                parent_id=ctx[1] if ctx else None,
+                status="OK" if not errors else "ERROR",
+            )
+            reg = get_registry()
+            reg.histogram("trino_tpu_exchange_read_ms").observe(dur_ms)
+            reg.counter("trino_tpu_exchange_read_bytes_total").inc(xfer["bytes"])
+            reg.counter("trino_tpu_exchange_read_pages_total").inc(xfer["pages"])
 
 
 class WorkerExecutor(LocalExecutor):
@@ -653,12 +689,17 @@ class SqlTask:
     Reference: ``execution/SqlTask.java`` + ``SqlTaskExecution.java``.
     """
 
-    def __init__(self, task_id: str, engine, payload: dict):
+    def __init__(self, task_id: str, engine, payload: dict, trace=None):
         self.task_id = task_id
         self.engine = engine
         self.state = "RUNNING"
         self.error: Optional[str] = None
-        self.created = time.time()
+        self.created = time.monotonic()  # interval math only (elapsed/reap)
+        self.finished: Optional[float] = None  # monotonic, set on _run exit
+        # (trace_id, parent_span_id) from the coordinator's X-Trino-Trace
+        # header: parents this worker's task_execute span to the
+        # dispatching attempt span across the process boundary
+        self.trace = trace
         self.fragment_id = payload["fragment"]["id"]
         s = payload.get("session", {})
         self.session = Session(
@@ -723,6 +764,11 @@ class SqlTask:
         threads = []
         errors: list[Exception] = []
 
+        from trino_tpu.obs.trace import get_tracer
+
+        # capture the task span context here: pull threads start fresh
+        ctx = get_tracer().context()
+
         def pull(fid: int, src: dict):
             try:
                 out[fid] = ExchangeClient.for_session(
@@ -730,6 +776,7 @@ class SqlTask:
                     src["locations"],
                     src["partition"],
                     injector=self.injector,
+                    trace=ctx,
                 ).read_all()
             except Exception as e:  # noqa: BLE001
                 errors.append(e)
@@ -766,34 +813,45 @@ class SqlTask:
         self._reserved += nbytes
 
     def _run(self) -> None:
+        from trino_tpu.obs.metrics import get_registry
+        from trino_tpu.obs.trace import get_tracer
+
+        tracer = get_tracer()
+        span = tracer.start_span(
+            "task_execute",
+            trace_id=self.trace[0] if self.trace else None,
+            parent_id=self.trace[1] if self.trace else None,
+            attrs={"taskId": self.task_id, "stage": self.fragment_id},
+        )
         self._reserved = 0
         try:
-            prefetched = self._prefetch_sources()
-            if self.injector is not None:
-                # crash AFTER the sources were pulled: a retried attempt
-                # must be able to re-pull them (retained buffers / unacked
-                # token windows make the replay idempotent)
-                from trino_tpu.ft.injection import task_site
+            with tracer.activate(span):
+                prefetched = self._prefetch_sources()
+                if self.injector is not None:
+                    # crash AFTER the sources were pulled: a retried attempt
+                    # must be able to re-pull them (retained buffers / unacked
+                    # token windows make the replay idempotent)
+                    from trino_tpu.ft.injection import task_site
 
-                self.injector.maybe_crash_task(task_site(self.task_id))
-            from trino_tpu.memory import batch_nbytes
+                    self.injector.maybe_crash_task(task_site(self.task_id))
+                from trino_tpu.memory import batch_nbytes
 
-            self._account(
-                sum(
-                    batch_nbytes(b)
-                    for batches in prefetched.values()
-                    for b in batches
+                self._account(
+                    sum(
+                        batch_nbytes(b)
+                        for batches in prefetched.values()
+                        for b in batches
+                    )
                 )
-            )
-            result = None
-            mode = self.session.get("worker_execution")
-            if mode in ("fused", "fused_strict"):
-                result = self._try_fused(prefetched, strict=mode == "fused_strict")
-            if result is None:
-                self.execution_path = "interpreter"
-                result = self._run_interpreted(prefetched)
-            self._account(batch_nbytes(result.batch) if result.batch is not None else 0)
-            self._emit(result)
+                result = None
+                mode = self.session.get("worker_execution")
+                if mode in ("fused", "fused_strict"):
+                    result = self._try_fused(prefetched, strict=mode == "fused_strict")
+                if result is None:
+                    self.execution_path = "interpreter"
+                    result = self._run_interpreted(prefetched)
+                self._account(batch_nbytes(result.batch) if result.batch is not None else 0)
+                self._emit(result)
             self.state = "FINISHED"
         except Exception as e:  # noqa: BLE001
             from trino_tpu.ft.retry import is_retryable
@@ -802,6 +860,19 @@ class SqlTask:
             self.retryable = is_retryable(e)
             self.state = "FAILED"
         finally:
+            self.finished = time.monotonic()
+            span.finish(
+                status="OK" if self.state == "FINISHED" else "ERROR",
+                state=self.state,
+                path=self.execution_path,
+            )
+            reg = get_registry()
+            reg.counter(
+                "trino_tpu_worker_tasks_total", state=self.state
+            ).inc()
+            reg.histogram(
+                "trino_tpu_task_execute_ms", stage=str(self.fragment_id)
+            ).observe((self.finished - self.created) * 1000.0)
             if self.injector is not None and self.injector.total_injected:
                 self.stats["faults_injected"] = self.injector.total_injected
             self.buffer.set_complete()
@@ -923,7 +994,9 @@ class SqlTask:
             # policy; None unless FAILED
             "retryable": self.retryable,
             "fragment": self.fragment_id,
-            "elapsed": time.time() - self.created,
+            # monotonic interval, frozen at completion (the coordinator's
+            # per-stage sibling elapsed distribution reads this)
+            "elapsed": (self.finished or time.monotonic()) - self.created,
             "executionPath": self.execution_path,
             "stats": self.stats,
         }
@@ -1024,7 +1097,7 @@ class SqlTaskManager:
         self._lock = threading.Lock()
 
     def _reap(self) -> None:
-        now = time.time()
+        now = time.monotonic()
         for tid in [
             tid
             for tid, t in self._tasks.items()
@@ -1034,12 +1107,14 @@ class SqlTaskManager:
             self._tasks[tid].buffer.abort()
             del self._tasks[tid]
 
-    def create_or_update(self, task_id: str, payload: dict) -> SqlTask:
+    def create_or_update(
+        self, task_id: str, payload: dict, trace=None
+    ) -> SqlTask:
         with self._lock:
             self._reap()
             task = self._tasks.get(task_id)
             if task is None:
-                task = SqlTask(task_id, self.engine, payload)
+                task = SqlTask(task_id, self.engine, payload, trace=trace)
                 self._tasks[task_id] = task
             return task
 

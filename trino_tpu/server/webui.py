@@ -3,7 +3,9 @@
 Reference: the coordinator web UI (``core/trino-main/src/main/resources/webapp/``
 React app + ``server/ui/ClusterStatsResource.java``). A single self-refreshing
 page served at ``/ui`` over the existing JSON endpoints — no build step,
-no external assets.
+no external assets. Clicking a query row expands a per-stage timeline
+rendered from ``/v1/query/{id}/timeline`` span data (stage + task_attempt
+bars, offset from the query root span).
 """
 
 PAGE = """<!doctype html>
@@ -27,6 +29,21 @@ PAGE = """<!doctype html>
   .RUNNING, .QUEUED, .PLANNING { color: #e0af68; }
   td.q { max-width: 40rem; overflow: hidden; text-overflow: ellipsis;
          white-space: nowrap; }
+  tr.qrow { cursor: pointer; }
+  tr.qrow:hover td { background: #1f1f2b; }
+  .tl { padding: .6rem; }
+  .tlrow { display: flex; align-items: center; gap: .6rem;
+           margin: .15rem 0; }
+  .tlname { width: 16rem; font-size: .72rem; color: #9aa0b0;
+            overflow: hidden; text-overflow: ellipsis; white-space: nowrap; }
+  .tltrack { flex: 1; position: relative; height: .9rem;
+             background: #1f1f2b; border-radius: 3px; }
+  .tlbar { position: absolute; height: 100%; border-radius: 3px;
+           background: #7aa2f7; min-width: 2px; }
+  .tlbar.stage { background: #bb9af7; }
+  .tlbar.err { background: #f7768e; }
+  .tlms { width: 6rem; font-size: .72rem; color: #9aa0b0;
+          text-align: right; }
 </style>
 </head>
 <body>
@@ -41,6 +58,49 @@ PAGE = """<!doctype html>
   <tr><th>query id</th><th>state</th><th>user</th><th>elapsed</th><th>query</th></tr>
 </table>
 <script>
+const open = new Set();  // query ids with an expanded timeline
+
+function bar(span, t0, total, cls) {
+  const left = total > 0 ? ((span.startMs - t0) / total) * 100 : 0;
+  const width = total > 0 ? ((span.durationMs || 0) / total) * 100 : 0;
+  const c = cls + (span.status === 'ERROR' ? ' err' : '');
+  return `<div class="tlbar ${c}" style="left:${Math.max(0, left).toFixed(2)}%;` +
+         `width:${Math.max(0.2, width).toFixed(2)}%"></div>`;
+}
+
+function renderTimeline(tl) {
+  const esc = s => String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;');
+  const spans = tl.spans || [];
+  if (!spans.length) return '<div class="tl">no spans recorded</div>';
+  const t0 = Math.min(...spans.map(s => s.startMs));
+  const total = Math.max(...spans.map(
+      s => (s.startMs - t0) + (s.durationMs || 0)));
+  const interesting = spans.filter(
+      s => ['query', 'stage', 'task_attempt', 'task_execute',
+            'plan', 'optimize', 'fragment'].includes(s.name))
+    .sort((a, b) => a.startMs - b.startMs);
+  const label = s => {
+    const a = s.attrs || {};
+    if (s.name === 'stage') return `stage ${a.stage}` +
+        (a.coordinator ? ' (coordinator)' : ` · ${a.tasks} tasks`);
+    if (s.name === 'task_attempt') return `  ${a.taskId}` +
+        (a.retry ? ' (retry)' : '');
+    if (s.name === 'task_execute') return `  exec ${a.taskId}`;
+    return s.name;
+  };
+  return '<div class="tl">' + interesting.map(s =>
+    `<div class="tlrow"><div class="tlname">${esc(label(s))}</div>` +
+    `<div class="tltrack">` +
+    bar(s, t0, total, s.name === 'stage' || s.name === 'query' ? 'stage' : '') +
+    `</div><div class="tlms">${(s.durationMs || 0).toFixed(1)} ms</div></div>`
+  ).join('') + '</div>';
+}
+
+async function toggleTimeline(qid) {
+  if (open.has(qid)) open.delete(qid); else open.add(qid);
+  refresh();
+}
+
 async function refresh() {
   const st = await (await fetch('/v1/status')).json();
   const qs = await (await fetch('/v1/query')).json();
@@ -54,12 +114,26 @@ async function refresh() {
       .replace(/>/g, '&gt;').replace(/"/g, '&quot;');
   const stateClass = s => ['FINISHED','FAILED','RUNNING','QUEUED','PLANNING']
       .includes(s) ? s : '';
-  const rows = qs.sort((a, b) => b.createTime - a.createTime).slice(0, 50).map(q =>
-    `<tr><td>${esc(q.queryId)}</td><td class="${stateClass(q.state)}">${esc(q.state)}</td>` +
-    `<td>${esc(q.user)}</td><td>${esc(q.elapsedTimeMillis)} ms</td>` +
-    `<td class="q">${esc(q.query)}</td></tr>`).join('');
+  const sorted = qs.sort((a, b) => b.createTime - a.createTime).slice(0, 50);
+  const rows = [];
+  for (const q of sorted) {
+    rows.push(
+      `<tr class="qrow" onclick="toggleTimeline('${esc(q.queryId)}')">` +
+      `<td>${esc(q.queryId)}</td><td class="${stateClass(q.state)}">${esc(q.state)}</td>` +
+      `<td>${esc(q.user)}</td><td>${esc(q.elapsedTimeMillis)} ms</td>` +
+      `<td class="q">${esc(q.query)}</td></tr>`);
+    if (open.has(q.queryId)) {
+      let tl = {spans: []};
+      try {
+        tl = await (await fetch(
+            '/v1/query/' + encodeURIComponent(q.queryId) + '/timeline')).json();
+      } catch (e) { /* timeline unavailable */ }
+      rows.push(`<tr><td colspan="5">${renderTimeline(tl)}</td></tr>`);
+    }
+  }
   document.getElementById('qtable').innerHTML =
-    '<tr><th>query id</th><th>state</th><th>user</th><th>elapsed</th><th>query</th></tr>' + rows;
+    '<tr><th>query id</th><th>state</th><th>user</th><th>elapsed</th><th>query</th></tr>' +
+    rows.join('');
 }
 refresh(); setInterval(refresh, 2000);
 </script>

@@ -12,7 +12,6 @@ plan node (the reference's per-operator granularity at our altitude).
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Optional
 
 from trino_tpu.planner import plan as P
