@@ -1,10 +1,20 @@
-"""Chaos smoke: one TPC-H query under 30% task-crash injection.
+"""Chaos smoke: TPC-H under task-crash injection plus a slow worker.
 
-Boots a 2-worker cluster, runs TPC-H Q1 twice — fault-free, then with
-``fault_task_crash_p=0.3`` + ``retry_policy=TASK`` — and checks the
-results are bit-identical and that at least one task retry happened.
+Boots a 2-worker cluster and runs three scenarios:
+
+1. TPC-H Q1 fault-free vs ``fault_task_crash_p=0.3`` +
+   ``retry_policy=TASK`` — results must be bit-identical and at least
+   one task retry should fire.
+2. A skewed partitioned join under the same crash injection.
+3. ``slow-worker``: worker-1 deterministically slowed 10× via
+   ``fault_slow_workers`` and ``fault_task_slow_factor`` with
+   ``speculation=true`` — the straggler detector must hedge at least
+   one attempt onto the healthy worker, results stay bit-identical,
+   and the speculative counters land in the summary line.
+
 Quick manual repro for the fault-tolerance stack (CI runs the same
-scenario as ``tests/test_fault_tolerance.py -m faults``).
+scenarios as ``tests/test_fault_tolerance.py -m faults`` /
+``tests/test_speculation.py``).
 
 Usage: JAX_PLATFORMS=cpu python scripts/chaos_smoke.py [seed]
 """
@@ -45,6 +55,19 @@ def main() -> int:
         "retry_max_delay_ms": 200,
     }
     skew_props = {"join_distribution_type": "PARTITIONED"}
+    # slow-worker scenario: worker-1 runs every task 10× slower (sleep
+    # after compute, before emit — so a speculative cancel can still
+    # abort delivery); speculation hedges onto the healthy worker-0
+    slow_props = {
+        "retry_policy": "TASK",
+        "fault_injection_seed": seed,
+        "fault_slow_workers": "worker-1",
+        "fault_task_slow_factor": 10.0,
+        "speculation": True,
+        "speculation_floor_ms": 100,
+        "speculation_multiplier": 2.0,
+        "speculation_max_fraction": 1.0,
+    }
     # the summary dict is built incrementally and emitted in a finally, so
     # a crash mid-scenario still prints one machine-readable JSON line with
     # whatever was gathered (partial: true)
@@ -59,6 +82,7 @@ def main() -> int:
             skew_chaotic, _ = runner.execute(
                 Q_SKEW, session_properties={**chaos, **skew_props}
             )
+            slow_spec, _ = runner.execute(Q1, session_properties=slow_props)
             from trino_tpu.server import auth
 
             req = urllib.request.Request(
@@ -73,10 +97,21 @@ def main() -> int:
             ) as r:
                 summary["metrics"] = json.loads(r.read().decode())
         retries = max(q.get("taskRetries", 0) for q in queries)
+        spec_attempts = max(q.get("speculativeAttempts", 0) for q in queries)
+        spec_wins = max(q.get("speculativeWins", 0) for q in queries)
         summary.update(
-            seed=seed, rows=len(chaotic), task_retries=retries, partial=False
+            seed=seed,
+            rows=len(chaotic),
+            task_retries=retries,
+            speculative_attempts=spec_attempts,
+            speculative_wins=spec_wins,
+            partial=False,
         )
-        print(f"seed={seed} rows={len(chaotic)} task_retries={retries}")
+        print(
+            f"seed={seed} rows={len(chaotic)} task_retries={retries}"
+            f" speculative_attempts={spec_attempts}"
+            f" speculative_wins={spec_wins}"
+        )
         if chaotic != clean:
             print("FAIL: chaotic result differs from fault-free result")
             summary["ok"] = False
@@ -85,9 +120,18 @@ def main() -> int:
             print("FAIL: skewed-join chaotic result differs from fault-free")
             summary["ok"] = False
             return 1
+        if slow_spec != clean:
+            print("FAIL: slow-worker speculative result differs from fault-free")
+            summary["ok"] = False
+            return 1
         if retries == 0:
             print("WARN: no retries at this seed — injection never fired")
-        print("OK: bit-identical under 30% task-crash injection (incl. skewed join)")
+        if spec_attempts == 0:
+            print("WARN: no speculative attempts — straggler never flagged")
+        print(
+            "OK: bit-identical under 30% task-crash injection"
+            " (incl. skewed join + 10x slow worker)"
+        )
         summary["ok"] = True
         return 0
     finally:

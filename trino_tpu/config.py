@@ -108,6 +108,25 @@ class Session:
         ("fault_task_crash_p", 0.0),
         ("fault_http_drop_p", 0.0),
         ("fault_http_delay_ms", 0),
+        # delay faults: deterministic per-node slowdowns at task-execute
+        # sites so chaos tests can manufacture stragglers. fault_slow_workers
+        # is a comma-separated node-id list ("" = every node once a delay
+        # fault is configured); stall is a fixed pre-execute sleep, factor
+        # scales the measured execution time (10.0 -> a 10x-slow worker)
+        ("fault_slow_workers", ""),
+        ("fault_task_stall_ms", 0),
+        ("fault_task_slow_factor", 1.0),
+        # --- speculative (hedged) task execution (server/cluster.py) ------
+        # under retry_policy=TASK: when a running attempt's elapsed exceeds
+        # max(floor, multiplier * p99 of completed siblings), dispatch one
+        # duplicate on a different healthy node; first finisher wins, the
+        # loser is cancelled (token-acked buffers dedupe delivery)
+        ("speculation", False),
+        ("speculation_floor_ms", 500),
+        ("speculation_multiplier", 2.0),
+        # cap on concurrent speculative attempts per query, as a fraction
+        # of the query's planned task count (min 1 when speculation is on)
+        ("speculation_max_fraction", 0.25),
         # --- internal HTTP tuning (chaos tests shrink these) --------------
         ("http_request_timeout_s", 30.0),  # task POST/GET/DELETE calls
         ("http_retry_attempts", 3),  # transient-error retries per request

@@ -23,6 +23,7 @@ def classify_error(e: BaseException) -> Tuple[int, str, str]:
     and the analyzer/planner modules this touches are heavyweight.
     """
     from trino_tpu.analyzer import SemanticError
+    from trino_tpu.ft.retry import TaskFailure
     from trino_tpu.memory import ExceededMemoryLimitError
     from trino_tpu.planner.sanity import PlanValidationError
     from trino_tpu.sql.lexer import SqlSyntaxError
@@ -31,6 +32,10 @@ def classify_error(e: BaseException) -> Tuple[int, str, str]:
         return (1, "SYNTAX_ERROR", "USER_ERROR")
     if isinstance(e, SemanticError):
         return (2, "SEMANTIC_ERROR", "USER_ERROR")
+    if isinstance(e, TaskFailure):
+        # a remote task attempt failed beyond what the retry policy could
+        # absorb (covers TaskRetriesExhausted too)
+        return (65540, "REMOTE_TASK_ERROR", "INTERNAL_ERROR")
     if isinstance(e, PlanValidationError):
         # a sanity checker rejected the plan: an engine bug, not a
         # user error — name the checker in the /v1/query error

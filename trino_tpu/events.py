@@ -61,11 +61,13 @@ class TaskCompletedEvent:
     stage_id: int
     task_id: str
     worker: str
-    state: str  # FINISHED | FAILED | CANCELED | ...
+    state: str  # FINISHED | FAILED | CANCELED | CANCELED_SPECULATIVE | ...
     attempt: int = 1
     elapsed_ms: float = 0.0
     rows: int = 0
     error_message: Optional[str] = None
+    # a hedged (duplicate) attempt of a detected straggler
+    speculative: bool = False
 
 
 class EventListener:

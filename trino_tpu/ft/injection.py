@@ -58,12 +58,22 @@ class FaultInjector:
         http_drop_p: float = 0.0,
         http_delay_ms: float = 0.0,
         salt: Any = 0,
+        slow_workers: str = "",
+        task_stall_ms: float = 0.0,
+        task_slow_factor: float = 1.0,
     ):
         self.seed = int(seed)
         self.salt = salt  # varies per query attempt under QUERY retry
         self.task_crash_p = float(task_crash_p)
         self.http_drop_p = float(http_drop_p)
         self.http_delay_ms = float(http_delay_ms)
+        # delay faults: which nodes run slow ("" = all), and how — a fixed
+        # pre-execute stall and/or a multiplicative execution slowdown
+        self.slow_workers = frozenset(
+            w.strip() for w in str(slow_workers or "").split(",") if w.strip()
+        )
+        self.task_stall_ms = float(task_stall_ms)
+        self.task_slow_factor = max(1.0, float(task_slow_factor))
         self.events: list[dict] = []
         self.dropped_events = 0
         self.counts: dict[str, int] = {}
@@ -79,7 +89,15 @@ class FaultInjector:
             crash_p = float(session.get("fault_task_crash_p"))
             drop_p = float(session.get("fault_http_drop_p"))
             delay_ms = float(session.get("fault_http_delay_ms"))
-            if crash_p <= 0 and drop_p <= 0 and delay_ms <= 0:
+            stall_ms = float(session.get("fault_task_stall_ms"))
+            slow_factor = float(session.get("fault_task_slow_factor"))
+            if (
+                crash_p <= 0
+                and drop_p <= 0
+                and delay_ms <= 0
+                and stall_ms <= 0
+                and slow_factor <= 1.0
+            ):
                 return None
             return cls(
                 seed=int(session.get("fault_injection_seed")),
@@ -87,6 +105,9 @@ class FaultInjector:
                 http_drop_p=drop_p,
                 http_delay_ms=delay_ms,
                 salt=session.properties.get("fault_attempt_salt", 0),
+                slow_workers=str(session.get("fault_slow_workers")),
+                task_stall_ms=stall_ms,
+                task_slow_factor=slow_factor,
             )
         except (KeyError, TypeError, ValueError):
             return None
@@ -144,6 +165,40 @@ class FaultInjector:
         self._record(site, "http-delay", self.http_delay_ms / 1000.0)
         time.sleep(self.http_delay_ms / 1000.0)
 
+    # --- delay faults (straggler manufacturing) ---------------------------
+
+    def is_slow_node(self, node_id: Optional[str]) -> bool:
+        """Does a delay fault target this node? An empty ``slow_workers``
+        list means every node is slow (single-node chaos convenience)."""
+        if self.task_stall_ms <= 0 and self.task_slow_factor <= 1.0:
+            return False
+        if not self.slow_workers:
+            return True
+        return node_id is not None and node_id in self.slow_workers
+
+    def stall_task(self, site: str, node_id: Optional[str]) -> None:
+        """Fixed pre-execute stall on targeted nodes. Recorded per site so
+        chaos runs replay the same wall-clock shape."""
+        if self.task_stall_ms <= 0 or not self.is_slow_node(node_id):
+            return
+        self._record(site, "task-stall", self.task_stall_ms / 1000.0)
+        time.sleep(self.task_stall_ms / 1000.0)
+
+    def slow_task(self, site: str, node_id: Optional[str],
+                  execute_s: float) -> None:
+        """Multiplicative slowdown: the worker measured ``execute_s`` of
+        real execution; sleep the remainder so the attempt takes
+        ``task_slow_factor`` times as long end to end. Applied *before*
+        the result is emitted, so a speculative cancel still aborts the
+        output buffer of a genuinely-10x-slow attempt."""
+        if self.task_slow_factor <= 1.0 or not self.is_slow_node(node_id):
+            return
+        extra_s = max(0.0, execute_s) * (self.task_slow_factor - 1.0)
+        if extra_s <= 0:
+            return
+        self._record(site, "task-slow", round(extra_s, 6))
+        time.sleep(extra_s)
+
     def http_site(self, op: str, target: str, attempt: int) -> str:
         """Canonical HTTP site string. ``target`` must already be free of
         per-run identifiers (ports, query counters)."""
@@ -155,6 +210,9 @@ def injection_properties(
     task_crash_p: float = 0.0,
     http_drop_p: float = 0.0,
     http_delay_ms: float = 0.0,
+    slow_workers: str = "",
+    task_stall_ms: float = 0.0,
+    task_slow_factor: float = 1.0,
 ) -> dict:
     """Session-property dict enabling injection (test/CLI convenience)."""
     return {
@@ -162,6 +220,9 @@ def injection_properties(
         "fault_task_crash_p": task_crash_p,
         "fault_http_drop_p": http_drop_p,
         "fault_http_delay_ms": http_delay_ms,
+        "fault_slow_workers": slow_workers,
+        "fault_task_stall_ms": task_stall_ms,
+        "fault_task_slow_factor": task_slow_factor,
     }
 
 

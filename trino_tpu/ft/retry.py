@@ -68,6 +68,61 @@ class TaskRetriesExhausted(TaskFailure):
         )
 
 
+class SpeculationConfig:
+    """Straggler-detection knobs for hedged task execution.
+
+    A running attempt is flagged once ``elapsed > max(floor_ms,
+    multiplier * p99(completed sibling elapsed))``, and only after
+    ``min_completed`` siblings of the same stage have finished (the
+    quorum keeps the very first finisher from branding everyone else a
+    straggler). Only meaningful under ``retry_policy=TASK`` — hedging
+    rides the same re-dispatch-over-retained-buffers machinery.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        floor_ms: float = 500.0,
+        multiplier: float = 2.0,
+        max_fraction: float = 0.25,
+        min_completed: int = 1,
+    ):
+        self.enabled = bool(enabled)
+        self.floor_ms = max(0.0, float(floor_ms))
+        self.multiplier = max(1.0, float(multiplier))
+        self.max_fraction = max(0.0, float(max_fraction))
+        self.min_completed = max(1, int(min_completed))
+
+    @classmethod
+    def from_session(cls, session) -> "SpeculationConfig":
+        try:
+            return cls(
+                enabled=bool(session.get("speculation")),
+                floor_ms=float(session.get("speculation_floor_ms")),
+                multiplier=float(session.get("speculation_multiplier")),
+                max_fraction=float(session.get("speculation_max_fraction")),
+            )
+        except (KeyError, TypeError, ValueError):
+            return cls()
+
+    def budget(self, total_tasks: int) -> int:
+        """Max concurrent speculative attempts for a query with
+        ``total_tasks`` planned tasks (at least 1 when enabled)."""
+        if not self.enabled:
+            return 0
+        return max(1, int(self.max_fraction * max(0, total_tasks)))
+
+    def threshold_ms(self, completed_elapsed_ms) -> Optional[float]:
+        """Straggler threshold given completed siblings' elapsed times,
+        or None while the quorum is unmet (never hedge blind)."""
+        if not self.enabled or len(completed_elapsed_ms) < self.min_completed:
+            return None
+        from trino_tpu.obs.metrics import percentile
+
+        p99 = percentile(completed_elapsed_ms, 99.0) or 0.0
+        return max(self.floor_ms, self.multiplier * p99)
+
+
 class Backoff:
     """Exponential backoff with bounded, deterministic jitter.
 

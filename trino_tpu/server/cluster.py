@@ -250,6 +250,10 @@ class HttpRemoteTask:
         self.attempt = 1
         self.last_status: Optional[dict] = None
         self._obs_done = False
+        # hedged execution: dispatch time feeds the straggler detector;
+        # speculative marks a duplicate (hedge) attempt of a straggler
+        self.started_mono: Optional[float] = None
+        self.speculative = False
 
     def _site_target(self) -> str:
         # "cq7.3.0r1" -> "3.0r1": stable across runs, fresh per attempt
@@ -296,9 +300,16 @@ class HttpRemoteTask:
         raise last  # pragma: no cover — loop always returns or raises
 
     def start(self) -> None:
+        self.started_mono = time.monotonic()
         self._request(
             "start", "POST", self.uri, body=json.dumps(self.payload).encode()
         )
+
+    def elapsed_ms(self) -> float:
+        """Wall time since dispatch (0 before start)."""
+        if self.started_mono is None:
+            return 0.0
+        return (time.monotonic() - self.started_mono) * 1000.0
 
     def status(self, max_wait: float = 0.0) -> dict:
         uri = self.uri + (f"?maxWait={max_wait}" if max_wait else "")
@@ -308,9 +319,10 @@ class HttpRemoteTask:
         self.last_status = st
         return st
 
-    def cancel(self) -> None:
+    def cancel(self, speculative: bool = False) -> None:
+        uri = self.uri + ("?speculative=true" if speculative else "")
         try:
-            self._request("cancel", "DELETE", self.uri, timeout=10, parse=False)
+            self._request("cancel", "DELETE", uri, timeout=10, parse=False)
         except Exception:  # noqa: BLE001 - best-effort
             pass
 
@@ -367,7 +379,7 @@ class ClusterScheduler:
         """Returns (Batch, column_names). ``stats_sink`` (dict) receives
         retry/attempt counters plus a per-stage ``stages`` rollup for
         query stats and /v1/query."""
-        from trino_tpu.ft.retry import RetryPolicy
+        from trino_tpu.ft.retry import RetryPolicy, SpeculationConfig
 
         tracer = get_tracer()
         with tracer.span("fragment"):
@@ -382,6 +394,8 @@ class ClusterScheduler:
         stats.setdefault("retry_policy", policy)
         stats.setdefault("task_retries", 0)
         stats.setdefault("task_attempts", {})
+        stats.setdefault("speculative_attempts", 0)
+        stats.setdefault("speculative_wins", 0)
         http = self._http_opts(session)
 
         fragments = {f.id: f for f in sub.all_fragments()}
@@ -424,8 +438,18 @@ class ClusterScheduler:
         # across concurrent queries, so nothing goes on ``self``):
         # stage spans stay open until the query finalizes, ``elapsed``
         # collects FINISHED sibling-task wall times per stage for the
-        # p50/p99 rollup, ``stage_start`` is monotonic per stage
-        obs: dict = {"stage_spans": {}, "elapsed": {}, "stage_start": {}}
+        # p50/p99 rollup, ``stage_start`` is monotonic per stage. The
+        # speculation budget (max concurrent hedges) is per QUERY, shared
+        # across this execute's stage barriers via spec_active.
+        spec = SpeculationConfig.from_session(session)
+        obs: dict = {
+            "stage_spans": {},
+            "elapsed": {},
+            "stage_start": {},
+            "spec": spec,
+            "spec_budget": spec.budget(sum(task_counts.values())),
+            "spec_active": 0,
+        }
         ok = False
         try:
             for frag in order:
@@ -664,6 +688,23 @@ class ClusterScheduler:
             raise ExecutionError("no active workers available for task retry")
         return self.node_scheduler.select(candidates, 1)[0]
 
+    def _speculation_node(self, exclude: str) -> Optional[WorkerNode]:
+        """Placement for a hedged attempt: a *different* healthy node, or
+        None (unlike retries, a hedge on the straggler's own node is
+        pointless — skip hedging instead). ``select()`` reserves the
+        slot; the caller must release on every hedge outcome."""
+        active = self.node_manager.active_nodes()
+        healthy = set(self.node_manager.failure_detector.active_nodes())
+        candidates = [
+            n for n in active
+            if n.node_id != exclude and (not healthy or n.node_id in healthy)
+        ]
+        if not candidates:
+            candidates = [n for n in active if n.node_id != exclude]
+        if not candidates:
+            return None
+        return self.node_scheduler.select(candidates, 1)[0]
+
     def _await_fragment(
         self,
         query_id: str,
@@ -678,6 +719,16 @@ class ClusterScheduler:
         """Block until every task of ``frag`` is FINISHED, re-dispatching
         failed attempts (``{qid}.{frag}.{p}`` -> ``...{p}r{k}``) to other
         workers with backoff, bounded by ``task_retry_attempts``.
+
+        Speculation (``speculation=true``): once enough siblings have
+        finished, a running attempt whose elapsed exceeds
+        ``max(floor, multiplier * p99_of_completed_siblings)`` gets ONE
+        duplicate (hedge) attempt (``...{p}s{k}``) on a different healthy
+        node. First finisher wins and is swapped into ``tasks`` — under
+        the stage barrier consumers only ever read the winner's URI, so
+        the loser (cancelled with ``?speculative=true``, which aborts its
+        output buffer) can never double-deliver pages. Concurrent hedges
+        are capped per query by ``speculation_max_fraction``.
 
         Mutates ``tasks`` in place so consumers scheduled afterwards see
         the surviving attempt's URIs. Raises :class:`TaskFailure` for a
@@ -699,6 +750,8 @@ class ClusterScheduler:
         except KeyError:
             stage_budget = 300.0
         backoff = http.get("backoff") or Backoff.from_session(session)
+        reg = get_registry()
+        spec = (obs or {}).get("spec")
         attempts = [1] * len(tasks)
         # per-attempt deadline: a hung-but-responsive worker must not
         # stall the stage barrier forever — overrun counts as a
@@ -706,84 +759,259 @@ class ClusterScheduler:
         # spuriously expire the budget)
         deadlines = [time.monotonic() + stage_budget] * len(tasks)
         pending = set(range(len(tasks)))
-        while pending:
-            for i in sorted(pending):
-                t = tasks[i]
-                if t.start_error is not None:
-                    failure, retryable = t.start_error, True
-                    fail_st = {"state": "FAILED", "error": failure}
-                elif time.monotonic() > deadlines[i]:
-                    failure = f"task attempt exceeded {stage_budget}s stage budget"
-                    retryable = True
-                    fail_st = {"state": "FAILED", "error": failure}
-                else:
+        hedges: dict[int, HttpRemoteTask] = {}
+
+        def _spec_counter(outcome: str) -> None:
+            reg.counter(
+                "trino_tpu_speculative_attempts_total", outcome=outcome
+            ).inc()
+
+        def _drop_hedge(i: int, h: HttpRemoteTask, st: dict,
+                        outcome: str) -> None:
+            """Resolve a hedge that did NOT win: cancel, release its node,
+            close its attempt span, free budget."""
+            hedges.pop(i, None)
+            h.cancel(speculative=True)
+            self.node_scheduler.release(h.node)
+            self._finish_attempt(query_id, frag.id, h, st, obs)
+            if obs is not None:
+                obs["spec_active"] = max(0, obs.get("spec_active", 1) - 1)
+            _spec_counter(outcome)
+
+        try:
+            while pending:
+                for i in sorted(pending):
+                    t = tasks[i]
+                    if t.start_error is not None:
+                        failure, retryable = t.start_error, True
+                        fail_st = {"state": "FAILED", "error": failure}
+                    elif time.monotonic() > deadlines[i]:
+                        failure = f"task attempt exceeded {stage_budget}s stage budget"
+                        retryable = True
+                        fail_st = {"state": "FAILED", "error": failure}
+                    else:
+                        try:
+                            # a hedged straggler gets a short poll: the
+                            # 1s long-poll would delay noticing the hedge
+                            # finishing first by a full status round
+                            st = t.status(
+                                max_wait=0.05 if i in hedges else 1.0
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            if not is_retryable(e):
+                                raise
+                            # worker unreachable through all HTTP retries:
+                            # treat the attempt as lost
+                            failure, retryable = f"unreachable: {e}", True
+                            fail_st = {"state": "FAILED", "error": failure}
+                        else:
+                            state = st.get("state")
+                            if state == "FINISHED":
+                                h = hedges.get(i)
+                                if h is not None:
+                                    # primary beat its hedge: the loser's
+                                    # buffer is aborted before consumers
+                                    # ever learn its URI
+                                    _drop_hedge(
+                                        i, h,
+                                        {
+                                            "state": "CANCELED_SPECULATIVE",
+                                            "elapsed": h.elapsed_ms() / 1000.0,
+                                        },
+                                        outcome="cancelled",
+                                    )
+                                self._finish_attempt(query_id, frag.id, t, st, obs)
+                                pending.discard(i)
+                                continue
+                            if state != "FAILED":
+                                continue  # still queued/running
+                            failure = st.get("error")
+                            r = st.get("retryable")
+                            retryable = True if r is None else bool(r)
+                            fail_st = st
+                    self._finish_attempt(query_id, frag.id, t, fail_st, obs)
+                    if not retryable:
+                        raise TaskFailure(
+                            t.task_id, t.node.node_id, failure, retryable=False
+                        )
+                    h = hedges.pop(i, None)
+                    if h is not None:
+                        # the primary died while its hedge is in flight:
+                        # promote the hedge instead of dispatching a fresh
+                        # retry (the duplicate work is already running)
+                        t.cancel()
+                        self.node_scheduler.release(t.node)
+                        attempts[i] += 1
+                        stats.setdefault("task_attempts", {})[
+                            f"{query_id}.{frag.id}.{i}"
+                        ] = attempts[i]
+                        if obs is not None:
+                            obs["spec_active"] = max(
+                                0, obs.get("spec_active", 1) - 1
+                            )
+                        tasks[i] = h
+                        deadlines[i] = time.monotonic() + stage_budget
+                        continue
+                    if attempts[i] >= max_attempts:
+                        raise TaskRetriesExhausted(
+                            t.task_id, t.node.node_id, failure, attempts[i]
+                        )
+                    # release the failed attempt, back off, re-dispatch
+                    t.cancel()
+                    self.node_scheduler.release(t.node)
+                    time.sleep(backoff.delay(attempts[i]))
+                    node = self._retry_node(exclude=t.node.node_id)
+                    attempts[i] += 1
+                    base = f"{query_id}.{frag.id}.{i}"
+                    new_id = f"{base}r{attempts[i] - 1}"
+                    stats["task_retries"] = stats.get("task_retries", 0) + 1
+                    stats.setdefault("task_attempts", {})[base] = attempts[i]
+                    reg.counter("trino_tpu_task_retries_total").inc()
+                    retry = HttpRemoteTask(node, new_id, t.payload, **http)
+                    retry.attempt = attempts[i]
+                    att = get_tracer().start_span(
+                        "task_attempt",
+                        trace_id=getattr(stage_span, "trace_id", None),
+                        parent_id=getattr(stage_span, "span_id", None),
+                        attrs={
+                            "taskId": new_id,
+                            "stage": frag.id,
+                            "worker": node.node_id,
+                            "attempt": attempts[i],
+                            "retry": True,
+                        },
+                    )
+                    retry.span = att
+                    retry.trace = att.context()
+                    # swap in before start(): the query-level cleanup releases
+                    # whatever sits in ``tasks``, and the old node is released
+                    tasks[i] = retry
+                    deadlines[i] = time.monotonic() + stage_budget
                     try:
-                        st = t.status(max_wait=1.0)
+                        retry.start()
                     except Exception as e:  # noqa: BLE001
                         if not is_retryable(e):
                             raise
-                        # worker unreachable through all HTTP retries:
-                        # treat the attempt as lost
-                        failure, retryable = f"unreachable: {e}", True
-                        fail_st = {"state": "FAILED", "error": failure}
+                        retry.start_error = str(e)
+
+                # --- hedge polling: first finisher wins -------------------
+                for i, h in list(hedges.items()):
+                    if i not in pending:
+                        continue
+                    if h.start_error is not None:
+                        hst = {"state": "FAILED", "error": h.start_error}
                     else:
-                        state = st.get("state")
-                        if state == "FINISHED":
-                            self._finish_attempt(query_id, frag.id, t, st, obs)
-                            pending.discard(i)
-                            continue
-                        if state != "FAILED":
-                            continue  # still queued/running
-                        failure = st.get("error")
-                        r = st.get("retryable")
-                        retryable = True if r is None else bool(r)
-                        fail_st = st
-                self._finish_attempt(query_id, frag.id, t, fail_st, obs)
-                if not retryable:
-                    raise TaskFailure(
-                        t.task_id, t.node.node_id, failure, retryable=False
+                        try:
+                            hst = h.status(max_wait=0.0)
+                        except Exception as e:  # noqa: BLE001
+                            if not is_retryable(e):
+                                raise
+                            hst = {"state": "FAILED", "error": f"unreachable: {e}"}
+                    state = hst.get("state")
+                    if state == "FINISHED":
+                        # hedge wins: swap it in as the surviving attempt and
+                        # speculatively cancel the straggling primary (its
+                        # buffer aborts, so it can never deliver a page)
+                        primary = tasks[i]
+                        hedges.pop(i)
+                        primary.cancel(speculative=True)
+                        self._finish_attempt(
+                            query_id, frag.id, primary,
+                            {
+                                "state": "CANCELED_SPECULATIVE",
+                                "elapsed": primary.elapsed_ms() / 1000.0,
+                            },
+                            obs,
+                        )
+                        self.node_scheduler.release(primary.node)
+                        tasks[i] = h
+                        if obs is not None:
+                            obs["spec_active"] = max(
+                                0, obs.get("spec_active", 1) - 1
+                            )
+                        stats["speculative_wins"] = (
+                            stats.get("speculative_wins", 0) + 1
+                        )
+                        _spec_counter("won")
+                        _spec_counter("cancelled")  # the loser's cancel
+                        self._finish_attempt(query_id, frag.id, h, hst, obs)
+                        pending.discard(i)
+                    elif state == "FAILED":
+                        # hedge died on its own; the primary keeps running
+                        # (no retry of a hedge — it was a bet, not a need)
+                        _drop_hedge(i, h, hst, outcome="lost")
+
+                # --- straggler detection -> hedge dispatch ----------------
+                if (
+                    spec is not None
+                    and spec.enabled
+                    and pending
+                    and obs is not None
+                    and obs.get("spec_active", 0) < obs.get("spec_budget", 0)
+                ):
+                    threshold = spec.threshold_ms(
+                        obs["elapsed"].get(frag.id, [])
                     )
-                if attempts[i] >= max_attempts:
-                    raise TaskRetriesExhausted(
-                        t.task_id, t.node.node_id, failure, attempts[i]
-                    )
-                # release the failed attempt, back off, re-dispatch
-                t.cancel()
-                self.node_scheduler.release(t.node)
-                time.sleep(backoff.delay(attempts[i]))
-                node = self._retry_node(exclude=t.node.node_id)
-                attempts[i] += 1
-                base = f"{query_id}.{frag.id}.{i}"
-                new_id = f"{base}r{attempts[i] - 1}"
-                stats["task_retries"] = stats.get("task_retries", 0) + 1
-                stats.setdefault("task_attempts", {})[base] = attempts[i]
-                get_registry().counter("trino_tpu_task_retries_total").inc()
-                retry = HttpRemoteTask(node, new_id, t.payload, **http)
-                retry.attempt = attempts[i]
-                att = get_tracer().start_span(
-                    "task_attempt",
-                    trace_id=getattr(stage_span, "trace_id", None),
-                    parent_id=getattr(stage_span, "span_id", None),
-                    attrs={
-                        "taskId": new_id,
-                        "stage": frag.id,
-                        "worker": node.node_id,
-                        "attempt": attempts[i],
-                        "retry": True,
-                    },
+                    if threshold is not None:
+                        for i in sorted(pending):
+                            if i in hedges:
+                                continue
+                            t = tasks[i]
+                            if (
+                                t.start_error is not None
+                                or t.elapsed_ms() <= threshold
+                            ):
+                                continue
+                            node = self._speculation_node(
+                                exclude=t.node.node_id
+                            )
+                            if node is None:
+                                continue  # no distinct healthy node
+                            hedge_id = (
+                                f"{query_id}.{frag.id}.{i}s{attempts[i]}"
+                            )
+                            hedge = HttpRemoteTask(
+                                node, hedge_id, t.payload, **http
+                            )
+                            hedge.attempt = attempts[i]
+                            hedge.speculative = True
+                            att = get_tracer().start_span(
+                                "task_attempt",
+                                trace_id=getattr(stage_span, "trace_id", None),
+                                parent_id=getattr(stage_span, "span_id", None),
+                                attrs={
+                                    "taskId": hedge_id,
+                                    "stage": frag.id,
+                                    "worker": node.node_id,
+                                    "attempt": attempts[i],
+                                    "speculative": True,
+                                    "hedgeOf": t.task_id,
+                                    "thresholdMs": round(threshold, 1),
+                                },
+                            )
+                            hedge.span = att
+                            hedge.trace = att.context()
+                            stats["speculative_attempts"] = (
+                                stats.get("speculative_attempts", 0) + 1
+                            )
+                            obs["spec_active"] = obs.get("spec_active", 0) + 1
+                            hedges[i] = hedge
+                            try:
+                                hedge.start()
+                            except Exception as e:  # noqa: BLE001
+                                if not is_retryable(e):
+                                    raise
+                                hedge.start_error = str(e)
+                            if obs["spec_active"] >= obs["spec_budget"]:
+                                break
+        finally:
+            # a raising exit (fatal failure, retries exhausted) leaves
+            # hedges in flight; they are not in ``tasks``, so the
+            # query-level cleanup would never cancel or release them
+            for i, h in list(hedges.items()):
+                _drop_hedge(
+                    i, h, {"state": "CANCELED_SPECULATIVE"}, outcome="cancelled"
                 )
-                retry.span = att
-                retry.trace = att.context()
-                # swap in before start(): the query-level cleanup releases
-                # whatever sits in ``tasks``, and the old node is released
-                tasks[i] = retry
-                deadlines[i] = time.monotonic() + stage_budget
-                try:
-                    retry.start()
-                except Exception as e:  # noqa: BLE001
-                    if not is_retryable(e):
-                        raise
-                    retry.start_error = str(e)
 
     # --- per-attempt / per-query observability rollup ---------------------
 
@@ -809,7 +1037,7 @@ class ClusterScheduler:
         reg.counter("trino_tpu_tasks_total", state=state).inc()
         if state == "FINISHED":
             # sibling elapsed within a stage feeds the p50/p99 rollup the
-            # speculative-execution roadmap item needs
+            # speculation detector thresholds on
             if obs is not None:
                 obs["elapsed"].setdefault(frag_id, []).append(elapsed_ms)
             reg.histogram(
@@ -817,11 +1045,19 @@ class ClusterScheduler:
             ).observe(elapsed_ms)
         if t.span is not None:
             attrs = {"state": state, "elapsedMs": elapsed_ms}
+            if t.speculative:
+                attrs["speculative"] = True
             if st.get("error"):
                 attrs["error"] = st.get("error")
-            t.span.finish(
-                status="OK" if state == "FINISHED" else "ERROR", **attrs
-            )
+            # a speculatively-cancelled loser is not an error: a sibling
+            # simply finished first (rendered distinctly in the waterfall)
+            if state == "FINISHED":
+                status = "OK"
+            elif state == "CANCELED_SPECULATIVE":
+                status = "CANCELED"
+            else:
+                status = "ERROR"
+            t.span.finish(status=status, **attrs)
         listeners = getattr(self.engine, "event_listeners", None)
         if listeners is not None:
             listeners.fire_task_completed(
@@ -834,6 +1070,7 @@ class ClusterScheduler:
                     attempt=t.attempt,
                     elapsed_ms=elapsed_ms,
                     error_message=st.get("error"),
+                    speculative=t.speculative,
                 )
             )
 
@@ -856,7 +1093,7 @@ class ClusterScheduler:
                     continue
                 st = t.last_status
                 terminal = st is not None and st.get("state") in (
-                    "FINISHED", "FAILED", "CANCELED",
+                    "FINISHED", "FAILED", "CANCELED", "CANCELED_SPECULATIVE",
                 )
                 if ok and not terminal:
                     # one best-effort poll only on the success path — a

@@ -70,6 +70,9 @@ class TrinoTpuServer:
         get_tracer().add_sink(self.span_sink)
         self.role = role
         self.node_id = node_id or f"{role}-{port}"
+        # tasks need the node identity for delay-fault targeting
+        # (ft/injection.py is_slow_node) and task-span attribution
+        self.engine.node_id = self.node_id
         self.discovery_uri = discovery_uri
         self.resource_groups = resource_groups or ResourceGroupManager()
         # every node can run tasks (reference: same binary, coordinator=true/false)
@@ -608,7 +611,11 @@ def _make_handler(server: TrinoTpuServer):
                     return self._send_no_content()
                 return self._error(404, "query not found")
             if len(parts) == 3 and parts[:2] == ["v1", "task"]:
-                if server.task_manager.cancel(parts[2]):
+                # ?speculative=true marks a hedged-attempt loser: the state
+                # machine records CANCELED_SPECULATIVE instead of CANCELED
+                qs = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+                speculative = qs.get("speculative", [""])[0] == "true"
+                if server.task_manager.cancel(parts[2], speculative=speculative):
                     return self._send_no_content()
                 return self._error(404, "task not found")
             return self._error(404, f"unknown path: {path}")

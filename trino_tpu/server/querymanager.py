@@ -261,6 +261,10 @@ class ManagedQuery:
             "queryAttempts": self.query_attempts,
             "taskRetries": cluster_stats.get("task_retries", 0),
             "taskAttempts": cluster_stats.get("task_attempts", {}),
+            # hedged execution: duplicates dispatched for detected
+            # stragglers, and how many of them finished first
+            "speculativeAttempts": cluster_stats.get("speculative_attempts", 0),
+            "speculativeWins": cluster_stats.get("speculative_wins", 0),
             # per-stage rollup (obs): elapsed + sibling task elapsed
             # p50/p99 — the speculative-execution straggler signal
             "queryStats": self._query_stats(elapsed, cluster_stats),
@@ -287,6 +291,8 @@ class ManagedQuery:
                 ((self._start_mono() or time.monotonic()) - self._create_mono)
                 * 1000
             ),
+            "speculativeAttempts": cluster_stats.get("speculative_attempts", 0),
+            "speculativeWins": cluster_stats.get("speculative_wins", 0),
             "stages": cluster_stats.get("stages", []),
         }
 

@@ -96,3 +96,28 @@ TERMINAL_QUERY_STATES = {QueryState.FINISHED, QueryState.FAILED, QueryState.CANC
 
 def new_query_state_machine(query_id: str) -> StateMachine[QueryState]:
     return StateMachine(query_id, QueryState.QUEUED, TERMINAL_QUERY_STATES)
+
+
+class TaskState:
+    """Worker task states (reference: ``execution/TaskState.java``).
+
+    Plain string constants — worker task state crosses the HTTP boundary
+    as JSON, so the wire form IS the state. ``CANCELED_SPECULATIVE``
+    marks the loser of a hedged (speculative) attempt pair: cancelled by
+    the scheduler because a sibling finished first, not because the
+    query failed — terminal and failed-for-consumers, but not an error.
+    """
+
+    RUNNING = "RUNNING"
+    FINISHED = "FINISHED"
+    FAILED = "FAILED"
+    CANCELED = "CANCELED"
+    CANCELED_SPECULATIVE = "CANCELED_SPECULATIVE"
+
+
+TERMINAL_TASK_STATES = {
+    TaskState.FINISHED,
+    TaskState.FAILED,
+    TaskState.CANCELED,
+    TaskState.CANCELED_SPECULATIVE,
+}

@@ -34,6 +34,7 @@ from trino_tpu.ops import join as J
 from trino_tpu.planner import plan as P
 from trino_tpu.planner.fragmenter import PlanFragment
 from trino_tpu.serde import deserialize_batch, serialize_batch
+from trino_tpu.server.statemachine import TaskState
 
 PAGE_ROWS = 1 << 16
 
@@ -692,7 +693,10 @@ class SqlTask:
     def __init__(self, task_id: str, engine, payload: dict, trace=None):
         self.task_id = task_id
         self.engine = engine
-        self.state = "RUNNING"
+        self.state = TaskState.RUNNING
+        # the node this task runs on (server/http.py sets engine.node_id);
+        # delay-fault injection targets nodes by this identity
+        self.node_id: Optional[str] = getattr(engine, "node_id", None)
         self.error: Optional[str] = None
         self.created = time.monotonic()  # interval math only (elapsed/reap)
         self.finished: Optional[float] = None  # monotonic, set on _run exit
@@ -833,7 +837,11 @@ class SqlTask:
                     # token windows make the replay idempotent)
                     from trino_tpu.ft.injection import task_site
 
-                    self.injector.maybe_crash_task(task_site(self.task_id))
+                    site = task_site(self.task_id)
+                    self.injector.maybe_crash_task(site)
+                    # straggler manufacturing: fixed stall before execution
+                    # on targeted slow nodes
+                    self.injector.stall_task(site, self.node_id)
                 from trino_tpu.memory import batch_nbytes
 
                 self._account(
@@ -844,25 +852,41 @@ class SqlTask:
                     )
                 )
                 result = None
+                exec_t0 = time.monotonic()
                 mode = self.session.get("worker_execution")
                 if mode in ("fused", "fused_strict"):
                     result = self._try_fused(prefetched, strict=mode == "fused_strict")
                 if result is None:
                     self.execution_path = "interpreter"
                     result = self._run_interpreted(prefetched)
+                if self.injector is not None:
+                    # multiplicative slowdown applied before the result is
+                    # emitted: a speculative cancel can still abort this
+                    # buffer while the "slow" attempt is mid-sleep
+                    self.injector.slow_task(
+                        site, self.node_id, time.monotonic() - exec_t0
+                    )
+                    if self.state != TaskState.RUNNING:
+                        # cancelled mid-stall (speculative loser): never
+                        # emit into the aborted buffer
+                        return
                 self._account(batch_nbytes(result.batch) if result.batch is not None else 0)
                 self._emit(result)
-            self.state = "FINISHED"
+            if self.state == TaskState.RUNNING:
+                self.state = TaskState.FINISHED
         except Exception as e:  # noqa: BLE001
             from trino_tpu.ft.retry import is_retryable
 
             self.error = f"{e}\n{traceback.format_exc()}"
             self.retryable = is_retryable(e)
-            self.state = "FAILED"
+            if self.state == TaskState.RUNNING:
+                # a cancelled task that then unwinds with an exception keeps
+                # its cancelled state (the cancel is the cause, not the error)
+                self.state = TaskState.FAILED
         finally:
             self.finished = time.monotonic()
             span.finish(
-                status="OK" if self.state == "FINISHED" else "ERROR",
+                status="OK" if self.state == TaskState.FINISHED else "ERROR",
                 state=self.state,
                 path=self.execution_path,
             )
@@ -1008,21 +1032,33 @@ class SqlTask:
         # FINISHED task whose buffer was aborted with undelivered pages
         # (cancel raced completion): report failed, not empty success.
         truncated = self.buffer.dropped_unacked
+        canceled = self.state in (
+            TaskState.CANCELED, TaskState.CANCELED_SPECULATIVE
+        )
         return {
             "taskId": self.task_id,
             "pages": [base64.b64encode(p).decode() for p in pages],
             "token": next_token,
-            "complete": complete and self.state == "FINISHED" and not truncated,
-            "failed": self.state in ("FAILED", "CANCELED") or truncated,
+            "complete": complete
+            and self.state == TaskState.FINISHED
+            and not truncated,
+            "failed": self.state == TaskState.FAILED or canceled or truncated,
             "error": self.error or (
-                "task canceled" if self.state == "CANCELED" else
+                "task canceled" if canceled else
                 ("task output aborted with undelivered pages" if truncated else None)
             ),
         }
 
-    def cancel(self) -> None:
-        if self.state == "RUNNING":
-            self.state = "CANCELED"
+    def cancel(self, speculative: bool = False) -> None:
+        """Terminate a running task. ``speculative=True`` marks the loser
+        of a hedged attempt pair: a sibling finished first, so this
+        attempt's output is unwanted — abort the buffer so it can never
+        double-deliver pages the winner already served."""
+        if self.state == TaskState.RUNNING:
+            self.state = (
+                TaskState.CANCELED_SPECULATIVE if speculative
+                else TaskState.CANCELED
+            )
         # always release buffered pages (a finished task's final unacked
         # window would otherwise live as long as the registry entry)
         self.buffer.abort()
@@ -1101,7 +1137,7 @@ class SqlTaskManager:
         for tid in [
             tid
             for tid, t in self._tasks.items()
-            if t.state != "RUNNING"
+            if t.state != TaskState.RUNNING
             and now - t.created > self.TERMINAL_RETENTION
         ]:
             self._tasks[tid].buffer.abort()
@@ -1122,11 +1158,11 @@ class SqlTaskManager:
         with self._lock:
             return self._tasks.get(task_id)
 
-    def cancel(self, task_id: str) -> bool:
+    def cancel(self, task_id: str, speculative: bool = False) -> bool:
         task = self.get(task_id)
         if task is None:
             return False
-        task.cancel()
+        task.cancel(speculative=speculative)
         return True
 
     def tasks(self) -> list[SqlTask]:

@@ -42,6 +42,8 @@ PAGE = """<!doctype html>
            background: #7aa2f7; min-width: 2px; }
   .tlbar.stage { background: #bb9af7; }
   .tlbar.err { background: #f7768e; }
+  .tlbar.spec { background: #e0af68; }
+  .tlbar.spec.cancelled { background: #565f89; }
   .tlms { width: 6rem; font-size: .72rem; color: #9aa0b0;
           text-align: right; }
 </style>
@@ -63,7 +65,13 @@ const open = new Set();  // query ids with an expanded timeline
 function bar(span, t0, total, cls) {
   const left = total > 0 ? ((span.startMs - t0) / total) * 100 : 0;
   const width = total > 0 ? ((span.durationMs || 0) / total) * 100 : 0;
-  const c = cls + (span.status === 'ERROR' ? ' err' : '');
+  const a = span.attrs || {};
+  let c = cls;
+  // speculative attempts render distinctly: amber for the hedge,
+  // muted for whichever attempt lost the race and was cancelled
+  if (a.speculative) c += ' spec';
+  if (a.state === 'CANCELED_SPECULATIVE') c += ' spec cancelled';
+  if (span.status === 'ERROR') c += ' err';
   return `<div class="tlbar ${c}" style="left:${Math.max(0, left).toFixed(2)}%;` +
          `width:${Math.max(0.2, width).toFixed(2)}%"></div>`;
 }
@@ -84,7 +92,9 @@ function renderTimeline(tl) {
     if (s.name === 'stage') return `stage ${a.stage}` +
         (a.coordinator ? ' (coordinator)' : ` · ${a.tasks} tasks`);
     if (s.name === 'task_attempt') return `  ${a.taskId}` +
-        (a.retry ? ' (retry)' : '');
+        (a.retry ? ' (retry)' : '') +
+        (a.speculative ? ' (speculative)' : '') +
+        (a.state === 'CANCELED_SPECULATIVE' ? ' (lost race)' : '');
     if (s.name === 'task_execute') return `  exec ${a.taskId}`;
     return s.name;
   };
