@@ -41,15 +41,29 @@ _RESULT: dict = {
     "unit": "rows/s",
     "compile_ms": 0.0,
     "cache_hits": 0,
+    # device-profiler rollup (obs/profiler.py): XLA cost-analysis FLOPs
+    # summed and peak HBM maxed across every statement the bench runs.
+    # Keys stay present (zero) on backends with no cost model, so the
+    # partial-line schema is stable.
+    "device": {"programs_profiled": 0, "total_flops": 0.0,
+               "peak_hbm_bytes": 0},
 }
 
 
 def _track_compile(res) -> None:
-    """Fold one StatementResult's program-cache telemetry into _RESULT."""
+    """Fold one StatementResult's program-cache + device-profiler
+    telemetry into _RESULT."""
     _RESULT["compile_ms"] = round(
         _RESULT["compile_ms"] + getattr(res, "compile_ms", 0.0), 1
     )
     _RESULT["cache_hits"] += getattr(res, "program_cache_hits", 0)
+    ds = getattr(res, "device_stats", None) or {}
+    dev = _RESULT["device"]
+    dev["programs_profiled"] += int(ds.get("programs_profiled") or 0)
+    dev["total_flops"] += float(ds.get("total_flops") or 0.0)
+    dev["peak_hbm_bytes"] = max(
+        dev["peak_hbm_bytes"], int(ds.get("peak_hbm_bytes") or 0)
+    )
 _EMITTED = False
 # RLock: the SIGALRM handler may re-enter _emit in the main thread while
 # it already holds the lock; the watchdog thread must block until the

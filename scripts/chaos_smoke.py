@@ -99,6 +99,21 @@ def main() -> int:
         retries = max(q.get("taskRetries", 0) for q in queries)
         spec_attempts = max(q.get("speculativeAttempts", 0) for q in queries)
         spec_wins = max(q.get("speculativeWins", 0) for q in queries)
+        # device-profiler rollup across every scraped query record:
+        # FLOPs sum / peak HBM max as merged by the coordinator from
+        # worker task stats (all-zero on backends with no cost model)
+        device = {"programs_profiled": 0, "total_flops": 0.0,
+                  "peak_hbm_bytes": 0}
+        for q in queries:
+            ds = q.get("deviceStats") or {}
+            device["programs_profiled"] += int(
+                ds.get("programs_profiled") or 0
+            )
+            device["total_flops"] += float(ds.get("total_flops") or 0.0)
+            device["peak_hbm_bytes"] = max(
+                device["peak_hbm_bytes"], int(ds.get("peak_hbm_bytes") or 0)
+            )
+        summary["device"] = device
         summary.update(
             seed=seed,
             rows=len(chaotic),

@@ -77,6 +77,71 @@ _SHARD_WEIGHTS = {
 }
 _SHARD_DEFAULT_WEIGHT = 10
 
+# Measured per-file wall clock from previous runs (seconds), recorded by
+# pytest_runtest_logreport below into tests/.tt_timings.json. When a file
+# has a measurement, it wins over the static _SHARD_WEIGHTS guess — the
+# static table only seeds files that have never run (same unit: rough
+# seconds), so shard balance tracks the suite as it grows instead of a
+# hand-maintained table going stale.
+_TIMINGS_PATH = os.path.join(os.path.dirname(__file__), ".tt_timings.json")
+_run_durations: dict = {}  # basename -> seconds accumulated this run
+
+
+def _load_measured_timings() -> dict:
+    import json
+
+    try:
+        with open(_TIMINGS_PATH) as f:
+            data = json.load(f)
+        return {
+            k: float(v)
+            for k, v in data.items()
+            if isinstance(v, (int, float)) and float(v) > 0
+        }
+    except Exception:
+        return {}
+
+
+def _file_weight(f: str, measured: dict) -> float:
+    if f in measured:
+        return measured[f]
+    return float(_SHARD_WEIGHTS.get(f, _SHARD_DEFAULT_WEIGHT))
+
+
+def pytest_runtest_logreport(report):
+    # all phases (setup/call/teardown) count — module fixtures like chaos
+    # clusters dominate some files' wall clock
+    try:
+        base = os.path.basename(report.location[0])
+    except Exception:
+        return
+    if base.endswith(".py"):
+        _run_durations[base] = _run_durations.get(base, 0.0) + float(
+            getattr(report, "duration", 0.0) or 0.0
+        )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _run_durations:
+        return
+    import json
+    import tempfile
+
+    try:
+        data = _load_measured_timings()
+        # merge: only files that ran this session are updated, so sharded
+        # lanes each refresh their own slice of the table
+        for base, dur in _run_durations.items():
+            data[base] = round(dur, 3)
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(_TIMINGS_PATH), suffix=".tmp"
+        )
+        with os.fdopen(fd, "w") as f:
+            json.dump(data, f, indent=0, sort_keys=True)
+        os.replace(tmp, _TIMINGS_PATH)
+    except Exception:
+        pass  # timing capture is best-effort; never fail the suite
+
 
 def pytest_addoption(parser):
     parser.addoption(
@@ -88,18 +153,21 @@ def pytest_addoption(parser):
     )
 
 
-def _shard_assignment(files, n):
-    """Map file basename -> shard index (0-based) by LPT packing."""
+def _shard_assignment(files, n, measured=None):
+    """Map file basename -> shard index (0-based) by LPT packing.
+    ``measured`` (basename -> seconds) overrides the static weight table
+    per file; defaults to the persisted tests/.tt_timings.json."""
+    if measured is None:
+        measured = _load_measured_timings()
     order = sorted(
-        files,
-        key=lambda f: (-_SHARD_WEIGHTS.get(f, _SHARD_DEFAULT_WEIGHT), f),
+        files, key=lambda f: (-_file_weight(f, measured), f)
     )
     loads = [0.0] * n
     assigned = {}
     for f in order:
         bucket = min(range(n), key=lambda b: (loads[b], b))
         assigned[f] = bucket
-        loads[bucket] += _SHARD_WEIGHTS.get(f, _SHARD_DEFAULT_WEIGHT)
+        loads[bucket] += _file_weight(f, measured)
     return assigned
 
 

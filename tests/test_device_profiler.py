@@ -1,0 +1,325 @@
+"""Device-level query profiler: XLA cost/memory accounting.
+
+Covers obs/profiler.py capture + rollup + merge, the fragment-compile
+capture path (exec/fragments.py AOT lower+compile), EXPLAIN ANALYZE
+rendering, the system.runtime.{programs,metrics,tasks} tables, degraded
+mode on backends with no cost model, and the on/off bit-identical
+guarantee. Distributed (2-node) merge coverage lives in
+tests/test_observability.py::TestDistributedDeviceStats next to the
+other cluster-scoped observability tests.
+"""
+
+import pytest
+
+from trino_tpu.config import Session
+from trino_tpu.testing import DistributedQueryRunner, LocalQueryRunner
+
+Q_AGG = (
+    "select o_orderpriority, count(*) c from tpch.tiny.orders "
+    "where o_orderkey <= 6000 group by o_orderpriority "
+    "order by o_orderpriority"
+)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    # fragments path (execution_mode=distributed + fragment_execution on
+    # by default): the profiler captures at fragment compile time
+    return DistributedQueryRunner()
+
+
+class TestCapture:
+    def test_fragment_programs_captured(self, runner):
+        res = runner.engine.execute_statement(Q_AGG, runner.session)
+        ds = res.device_stats
+        assert ds is not None
+        programs = ds["programs"]
+        assert any(label.startswith("frag:") for label in programs)
+        for st in programs.values():
+            assert st["executions"] >= 1
+        # CPU's XLA backend reports a cost model; the rollup must agree
+        # with the per-program stats it summarizes
+        assert ds["programs_profiled"] == len(programs)
+        if "total_flops" in ds:
+            assert ds["total_flops"] == sum(
+                st["flops"] * max(1, st["executions"])
+                for st in programs.values()
+                if "flops" in st
+            )
+        if "peak_hbm_bytes" in ds:
+            assert ds["peak_hbm_bytes"] == max(
+                st["peak_hbm_bytes"]
+                for st in programs.values()
+                if "peak_hbm_bytes" in st
+            )
+
+    def test_warm_cache_reuses_stats_without_recompile(self, runner):
+        sql = Q_AGG.replace("6000", "5000")
+        cold = runner.engine.execute_statement(sql, runner.session)
+        warm = runner.engine.execute_statement(sql, runner.session)
+        assert warm.rows == cold.rows
+        # warm hit: no retrace, but the cached programs' captured stats
+        # still roll up into this query's deviceStats
+        assert warm.trace_count == 0
+        assert warm.program_cache_hits > 0
+        assert warm.device_stats is not None
+        assert set(warm.device_stats["programs"]) >= {
+            label
+            for label in (cold.device_stats or {}).get("programs", {})
+            if label.startswith("frag:")
+        }
+
+    def test_explain_analyze_device_section(self, runner):
+        rows, _ = runner.execute("explain analyze " + Q_AGG)
+        text = "\n".join(r[0] for r in rows)
+        assert "Device programs (XLA cost/memory analysis)" in text
+        assert "frag:" in text
+        assert "executions=" in text
+
+    def test_profiler_on_off_bit_identical(self, runner):
+        sql = Q_AGG.replace("6000", "4000")
+        on = runner.engine.execute_statement(sql, runner.session)
+        assert on.device_stats is not None
+        sess = Session(properties={
+            "execution_mode": "distributed", "device_profiling": False,
+        })
+        off = runner.engine.execute_statement(sql, sess)
+        assert off.device_stats is None
+        assert on.rows == off.rows
+        # device_profiling must not perturb the plan fingerprint: the
+        # profiled run's cached programs serve the unprofiled run
+        assert off.trace_count == 0 and off.program_cache_hits > 0
+
+    def test_degraded_backend_reporting_nothing(self, runner, monkeypatch):
+        """A backend whose cost/memory analyses both fail yields
+        device_stats entries with executions but no cost fields — never
+        an error (CPU tier-1 is exactly this on some jax versions)."""
+        from trino_tpu.obs import profiler
+
+        monkeypatch.setattr(
+            profiler, "capture_device_stats", lambda compiled: None
+        )
+        sql = Q_AGG.replace("6000", "3000")
+        res = runner.engine.execute_statement(sql, runner.session)
+        assert res.rows
+        ds = res.device_stats
+        if ds is not None:  # executions-only entries still roll up
+            for st in ds["programs"].values():
+                assert st["executions"] >= 1
+            assert "total_flops" not in ds or ds["total_flops"] >= 0
+
+
+class TestProfilerUnit:
+    def test_finite_filters_unknown(self):
+        from trino_tpu.obs.profiler import _finite
+
+        assert _finite(-1) is None  # XLA's "unknown"
+        assert _finite(float("nan")) is None
+        assert _finite(float("inf")) is None
+        assert _finite(True) is None
+        assert _finite("3") is None
+        assert _finite(3.5) == 3.5
+
+    def test_capture_handles_list_and_raises(self):
+        from trino_tpu.obs.profiler import capture_device_stats
+
+        class _Compiled:
+            def cost_analysis(self):
+                return [{"flops": 10.0, "bytes accessed": -1}]
+
+            def memory_analysis(self):
+                raise RuntimeError("unsupported backend")
+
+        out = capture_device_stats(_Compiled())
+        assert out == {"flops": 10.0}
+
+        class _Nothing:
+            def cost_analysis(self):
+                return None
+
+            def memory_analysis(self):
+                return None
+
+        assert capture_device_stats(_Nothing()) is None
+
+    def test_capture_peak_fallback(self):
+        from trino_tpu.obs.profiler import capture_device_stats
+
+        class _Mem:
+            argument_size_in_bytes = 100
+            output_size_in_bytes = 20
+            temp_size_in_bytes = 30
+            generated_code_size_in_bytes = 5
+
+        class _Compiled:
+            def cost_analysis(self):
+                return {"flops": 1.0}
+
+            def memory_analysis(self):
+                return _Mem()
+
+        out = capture_device_stats(_Compiled())
+        assert out["peak_hbm_bytes"] == 150  # arg+out+temp upper bound
+
+    def test_merge_accumulates_executions(self):
+        from trino_tpu.obs.profiler import merge_device_stats
+
+        target: dict = {}
+        merge_device_stats(
+            target, {"frag:1": {"executions": 1, "flops": 5.0,
+                                "compile_ms": 10.0}}
+        )
+        merge_device_stats(
+            target, {"frag:1": {"executions": 2, "flops": 5.0,
+                                "compile_ms": 0.0}}
+        )
+        assert target["frag:1"]["executions"] == 3
+        assert target["frag:1"]["compile_ms"] == 10.0
+        assert target["frag:1"]["flops"] == 5.0
+
+    def test_rollup_weights_by_executions(self):
+        from trino_tpu.obs.profiler import rollup_device_stats
+
+        out = rollup_device_stats({
+            "a": {"executions": 2, "flops": 10.0, "peak_hbm_bytes": 100},
+            "b": {"executions": 1, "flops": 1.0, "peak_hbm_bytes": 300},
+            "c": {"executions": 4},  # degraded: nothing captured
+        })
+        assert out["programs_profiled"] == 3
+        assert out["total_flops"] == 21.0
+        assert out["peak_hbm_bytes"] == 300
+
+
+class TestSystemTables:
+    def test_runtime_programs_matches_query_counters(self, runner):
+        # structurally unique in this module -> fresh fingerprint, so the
+        # store's cumulative counters describe exactly this cold run
+        # (literal changes alone share a fingerprint via constant
+        # hoisting and would see earlier runs' counters)
+        sql = (
+            "select o_orderstatus, sum(o_totalprice) t from "
+            "tpch.tiny.orders group by o_orderstatus"
+        )
+        res = runner.engine.execute_statement(sql, runner.session)
+        fp, _ = runner.engine.fingerprint(sql, runner.session)
+        assert fp is not None
+        rows = [
+            p for p in runner.engine.runtime_programs()
+            if p["fingerprint"] == fp
+        ]
+        assert rows, "executed query missing from the program-cache table"
+        assert rows[0]["misses"] == res.program_cache_misses
+        assert rows[0]["hits"] == res.program_cache_hits
+        assert rows[0]["compile_ms"] == pytest.approx(
+            res.compile_ms, abs=1.0
+        )
+        assert {p["program"] for p in rows} >= {
+            label
+            for label in (res.device_stats or {}).get("programs", {})
+            if label.startswith("frag:")
+        }
+
+    def test_runtime_programs_sql(self, runner):
+        runner.engine.execute_statement(Q_AGG, runner.session)
+        local = LocalQueryRunner(engine=runner.engine)
+        rows, names = local.execute(
+            "select fingerprint, program, hits, misses, compile_ms, flops "
+            "from system.runtime.programs"
+        )
+        assert names[0] == "fingerprint"
+        assert rows
+        assert any(r[1].startswith("frag:") for r in rows)
+
+    def test_runtime_metrics_sql(self, runner):
+        runner.engine.execute_statement(Q_AGG, runner.session)
+        local = LocalQueryRunner(engine=runner.engine)
+        rows, _ = local.execute(
+            "select name, kind, value from system.runtime.metrics"
+        )
+        assert rows
+        kinds = {r[1] for r in rows}
+        assert kinds <= {"counter", "gauge", "histogram"}
+        flops_rows = [
+            r for r in rows if r[0].startswith("trino_tpu_program_flops")
+        ]
+        assert flops_rows and all(r[2] >= 0 for r in flops_rows)
+
+    def test_runtime_tasks_sql_standalone_empty(self, runner):
+        # no server installed _runtime_tasks_fn -> empty, not an error
+        local = LocalQueryRunner(engine=runner.engine)
+        rows, _ = local.execute(
+            "select task_id, state from system.runtime.tasks"
+        )
+        assert rows == []
+
+
+class TestPrometheusConformance:
+    def test_histogram_buckets_cumulative_with_inf(self):
+        from trino_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram("conf_ms", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 5.0, 5.0, 50.0, 500.0):
+            h.observe(v)
+        text = reg.render_prometheus()
+        lines = [ln for ln in text.splitlines() if ln.startswith("conf_ms")]
+        buckets = [
+            ln for ln in lines if ln.startswith("conf_ms_bucket")
+        ]
+        counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+        assert counts == sorted(counts), "le buckets must be cumulative"
+        assert 'le="+Inf"' in buckets[-1]
+        assert counts[-1] == 5
+        assert "conf_ms_count 5" in lines
+        assert any(ln.startswith("conf_ms_sum ") for ln in lines)
+        assert "# TYPE conf_ms histogram" in text
+
+    def test_label_values_escaped(self):
+        from trino_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter(
+            "esc_total", fragment='say "hi"\\path\nnext'
+        ).inc()
+        text = reg.render_prometheus()
+        assert (
+            'esc_total{fragment="say \\"hi\\"\\\\path\\nnext"} 1' in text
+        )
+        assert "\n" not in text.split("esc_total{", 1)[1].split("} ")[0]
+
+    def test_program_gauges_render_with_fragment_label(self, runner):
+        from trino_tpu.obs.metrics import get_registry
+
+        runner.engine.execute_statement(Q_AGG, runner.session)
+        text = get_registry().render_prometheus()
+        assert "# TYPE trino_tpu_program_flops gauge" in text
+        assert 'trino_tpu_program_flops{fragment="frag:' in text
+
+
+class TestBoundedRetention:
+    def test_span_sink_bounded(self):
+        from trino_tpu.obs.trace import InMemorySpanSink, Span
+
+        sink = InMemorySpanSink(max_traces=8)
+        for i in range(50):
+            sink.record(Span(
+                trace_id=f"q{i}", span_id=f"s{i}", parent_id=None,
+                name="query", start_epoch=0.0,
+            ))
+        assert len(sink.trace_ids()) <= 8
+        # the oldest traces are the ones evicted
+        assert sink.trace_ids()[-1] == "q49"
+
+    def test_query_cache_and_history_bounded(self, runner):
+        eng = runner.engine
+        for i in range(5):
+            eng.execute_statement(
+                f"select count(*) c{i} from tpch.tiny.region "
+                f"where r_regionkey <= {i}",
+                runner.session,
+            )
+        assert len(eng._query_cache) <= eng._QUERY_CACHE_MAX
+        assert eng._recent_queries.maxlen is not None
+        # per-query device stats live on the executor (dropped with it)
+        # and on bounded cache entries — nothing engine-global grows
+        # per query except the bounded structures above

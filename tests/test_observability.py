@@ -483,6 +483,79 @@ class TestDistributedSpans:
         assert after - before >= 1
 
 
+class TestDistributedDeviceStats:
+    """Coordinator-merged worker stats: distributed EXPLAIN ANALYZE and
+    the per-query deviceStats rollup (device profiler tentpole; local
+    coverage lives in tests/test_device_profiler.py)."""
+
+    DEA_MARKER = "dea_probe"
+
+    def test_distributed_explain_analyze(self, obs_cluster):
+        rows, _ = obs_cluster.execute(
+            "explain analyze select o_orderpriority as dea_probe, count(*)"
+            " from orders group by o_orderpriority"
+        )
+        text = "\n".join(r[0] for r in rows)
+        assert "Distributed plan:" in text
+        assert "Stages (stats merged from worker tasks):" in text
+        assert "Stage " in text and "[tasks: " in text
+        # merged per-stage output rows and task-wall percentiles
+        assert "output rows: " in text
+        assert "task wall p50/p99/max:" in text
+        assert "wall time:" in text
+
+    def test_stage_stats_merged_from_both_workers(self, obs_cluster):
+        rows, _ = obs_cluster.execute(
+            f"select o_orderpriority as {self.DEA_MARKER}, count(*) as c"
+            " from orders group by o_orderpriority"
+        )
+        assert rows
+        qid = _query_id_for(obs_cluster.coordinator_uri, self.DEA_MARKER)
+        info = _get_json(obs_cluster.coordinator_uri, f"/v1/query/{qid}")
+        stages = info["queryStats"]["stages"]
+        fanout = [s for s in stages if s.get("tasks", 0) >= 2]
+        assert fanout, "expected a 2-task stage on a 2-worker cluster"
+        # rows were summed across BOTH workers' FINISHED tasks; the scan
+        # stage's merged input covers the whole table (15k orders split
+        # between the workers — one task alone cannot reach it)
+        assert any(s.get("rows") for s in stages)
+        assert sum(s.get("inputRows") or 0 for s in stages) >= 15000
+        # per-fragment XLA cost analysis shipped back in task stats
+        flops_stages = [s for s in stages if s.get("flops")]
+        assert flops_stages, "no stage carried device cost analysis"
+        for s in flops_stages:
+            assert s["flops"] > 0
+            assert s.get("peakHbmBytes", 0) >= 0
+        # query-level rollup rode the same merge
+        ds = info["deviceStats"]
+        assert ds and ds["programs_profiled"] >= 1
+        assert ds.get("total_flops", 0) > 0
+        assert any(
+            label.startswith("frag:") for label in ds["programs"]
+        )
+
+    def test_worker_runtime_tasks_table(self, obs_cluster):
+        """system.runtime.tasks on a worker lists its (retained) tasks —
+        the SQL view of the registry /v1/task serves."""
+        from trino_tpu.client import Connection
+
+        obs_cluster.execute(
+            "select count(*) as tasks_probe from orders"
+        )
+        found = []
+        for uri in obs_cluster.worker_uris:
+            rows, _ = Connection(uri).execute(
+                "select task_id, state, fragment, elapsed_ms"
+                " from system.runtime.tasks"
+            )
+            found.extend(rows)
+        assert found, "workers retained no tasks"
+        assert all(r[1] in ("FINISHED", "FAILED", "RUNNING",
+                            "CANCELED", "CANCELED_SPECULATIVE")
+                   for r in found)
+        assert all(r[3] >= 0 for r in found)
+
+
 class TestFusedExplainAnalyze:
     def test_fragment_stats_without_fallback(self):
         """EXPLAIN ANALYZE on a fused query reports per-fragment compile/

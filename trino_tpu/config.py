@@ -154,6 +154,13 @@ class Session:
         # parameter vector so `x < 24` and `x < 25` share one traced
         # program; off -> literals bake into the trace (old behavior)
         ("constant_hoisting", True),
+        # --- device-level profiling (obs/profiler.py) ---------------------
+        # capture XLA cost_analysis/memory_analysis per compiled fragment
+        # program (AOT lower+compile of the SAME jitted function, so query
+        # results are bit-identical on or off); deliberately NOT part of
+        # the canonical-plan fingerprint (planner/canonicalize.py) for the
+        # same reason
+        ("device_profiling", True),
     )
 
     def get(self, name: str) -> Any:

@@ -32,6 +32,29 @@ _SCHEMAS: dict[str, list[tuple[str, T.SqlType]]] = {
         ("coordinator", T.BOOLEAN),
         ("state", T.VARCHAR),
     ],
+    ("runtime", "tasks"): [
+        ("task_id", T.VARCHAR),
+        ("state", T.VARCHAR),
+        ("fragment", T.BIGINT),
+        ("elapsed_ms", T.BIGINT),
+        ("execution_path", T.VARCHAR),
+        ("error", T.VARCHAR),
+    ],
+    ("runtime", "metrics"): [
+        ("name", T.VARCHAR),
+        ("kind", T.VARCHAR),
+        ("value", T.DOUBLE),
+    ],
+    ("runtime", "programs"): [
+        ("fingerprint", T.VARCHAR),
+        ("program", T.VARCHAR),
+        ("hits", T.BIGINT),
+        ("misses", T.BIGINT),
+        ("compile_ms", T.DOUBLE),
+        ("flops", T.DOUBLE),
+        ("peak_hbm_bytes", T.BIGINT),
+        ("bytes_accessed", T.DOUBLE),
+    ],
     ("metadata", "catalogs"): [
         ("catalog_name", T.VARCHAR),
         ("connector_name", T.VARCHAR),
@@ -86,6 +109,26 @@ class SystemConnector(Connector):
             ]
         if (schema, table) == ("runtime", "nodes"):
             return [n for n in eng.runtime_nodes()]
+        if (schema, table) == ("runtime", "tasks"):
+            return [
+                (
+                    t["taskId"], str(t["state"]), t.get("fragment"),
+                    int(float(t.get("elapsed") or 0.0) * 1000),
+                    t.get("executionPath", ""), t.get("error"),
+                )
+                for t in eng.runtime_tasks()
+            ]
+        if (schema, table) == ("runtime", "metrics"):
+            return list(eng.runtime_metrics())
+        if (schema, table) == ("runtime", "programs"):
+            return [
+                (
+                    p["fingerprint"], p["program"], p["hits"], p["misses"],
+                    p["compile_ms"], p.get("flops"),
+                    p.get("peak_hbm_bytes"), p.get("bytes_accessed"),
+                )
+                for p in eng.runtime_programs()
+            ]
         if (schema, table) == ("metadata", "catalogs"):
             return [
                 (name, eng.catalogs.get(name).name) for name in eng.catalogs.names()

@@ -52,6 +52,11 @@ class StatementResult:
     trace_count: int = 0  # programs traced (0 on a fully warm run)
     program_cache_hits: int = 0
     program_cache_misses: int = 0
+    # device-level profiling rollup (obs/profiler.py): per-program XLA
+    # FLOPs / bytes accessed / peak HBM + query totals — surfaced in
+    # /v1/query as ``deviceStats``; None when profiling is off or the
+    # backend reports nothing
+    device_stats: Optional[dict[str, Any]] = None
 
 
 class Engine:
@@ -200,6 +205,75 @@ class Engine:
             return self._runtime_nodes_fn()
         return [("local", "local://", "trino-tpu-0.1", True, "ACTIVE")]
 
+    def runtime_tasks(self) -> list[dict]:
+        """Live worker-task info for ``system.runtime.tasks``. The server
+        installs ``_runtime_tasks_fn`` (its SqlTaskManager registry);
+        standalone engines have no tasks."""
+        fn = getattr(self, "_runtime_tasks_fn", None)
+        if fn is not None:
+            return fn()
+        return []
+
+    def runtime_metrics(self) -> list[tuple]:
+        """Live metrics-registry snapshot for ``system.runtime.metrics``:
+        one row per (name{labels}, kind, value) — histograms expose their
+        count/sum/p50/p99 as separate rows."""
+        from trino_tpu.obs.metrics import get_registry
+
+        snap = get_registry().snapshot()
+        rows: list[tuple] = []
+        for key, val in sorted(snap.get("counters", {}).items()):
+            rows.append((key, "counter", float(val)))
+        for key, val in sorted(snap.get("gauges", {}).items()):
+            rows.append((key, "gauge", float(val)))
+        for key, h in sorted(snap.get("histograms", {}).items()):
+            for field in ("count", "sum", "p50", "p99"):
+                v = h.get(field)
+                if v is not None:
+                    rows.append((f"{key}.{field}", "histogram", float(v)))
+        return rows
+
+    def runtime_programs(self) -> list[dict]:
+        """Cross-query program-cache contents for
+        ``system.runtime.programs``: one row per cached compiled program,
+        with the store's cumulative compile counters (the same numbers
+        /v1/query reports per query) and the profiler's captured XLA
+        cost/memory stats where the backend provided them."""
+        from trino_tpu.exec.fragments import program_label
+
+        with self._query_cache_lock:
+            items = [
+                (key[0], entry["programs"])
+                for key, entry in self._query_cache.items()
+            ]
+        rows: list[dict] = []
+        for fingerprint, programs in items:
+            store_stats = programs.get("__stats__") or {}
+            for key, val in programs.items():
+                if not (
+                    isinstance(key, tuple)
+                    and len(key) == 2
+                    and isinstance(key[0], tuple)
+                    and isinstance(val, tuple)
+                    and len(val) == 2
+                ):
+                    continue
+                meta = val[1]
+                ds = getattr(meta, "device_stats", None) or {}
+                rows.append(
+                    {
+                        "fingerprint": fingerprint,
+                        "program": program_label(key[0]),
+                        "hits": int(store_stats.get("hits", 0)),
+                        "misses": int(store_stats.get("misses", 0)),
+                        "compile_ms": float(store_stats.get("compile_ms", 0.0)),
+                        "flops": ds.get("flops"),
+                        "peak_hbm_bytes": ds.get("peak_hbm_bytes"),
+                        "bytes_accessed": ds.get("bytes_accessed"),
+                    }
+                )
+        return rows
+
     # === entry ============================================================
 
     def execute_statement(
@@ -323,6 +397,13 @@ class Engine:
         for key, val in (res.exchange_stats or {}).items():
             if isinstance(val, (int, float)) and not isinstance(val, bool):
                 reg.counter(f"trino_tpu_exchange_{key}_total").inc(val)
+        ds = res.device_stats or {}
+        if isinstance(ds.get("total_flops"), (int, float)):
+            reg.counter("trino_tpu_query_flops_total").inc(ds["total_flops"])
+        if isinstance(ds.get("peak_hbm_bytes"), (int, float)):
+            reg.gauge("trino_tpu_query_peak_hbm_bytes").set(
+                ds["peak_hbm_bytes"]
+            )
 
     def _execute_statement_inner(
         self, sql: str, session: Session, query_id: Optional[str] = None
@@ -469,6 +550,7 @@ class Engine:
                     names,
                     [c.type for c in batch.columns],
                     cluster_stats=cluster_stats,
+                    device_stats=cluster_stats.get("deviceStats"),
                 )
         ctx = QueryMemoryContext(
             self.memory_pool,
@@ -488,6 +570,7 @@ class Engine:
                 else None
             )
             cs = getattr(executor, "compile_stats", None) or {}
+            dsnap = getattr(executor, "device_stats_snapshot", None)
             return StatementResult(
                 batch.to_pylist(),
                 names,
@@ -499,6 +582,7 @@ class Engine:
                 trace_count=int(cs.get("trace_count", 0)),
                 program_cache_hits=int(cs.get("program_cache_hits", 0)),
                 program_cache_misses=int(cs.get("program_cache_misses", 0)),
+                device_stats=dsnap() if callable(dsnap) else None,
             )
         finally:
             ctx.close()
@@ -602,16 +686,35 @@ class Engine:
             collector = StatsCollector()
             plan = self.plan(inner, session)
             res = self._execute_query_plan(plan, session, collector=collector)
-            text = render_plan_with_stats(plan, collector)
-            if collector.fragments:
-                from trino_tpu.stats import render_fragment_stats
+            stages = (res.cluster_stats or {}).get("stages")
+            if stages:
+                # cluster execution: render the Trino-style distributed
+                # plan from the per-stage stats the coordinator merged out
+                # of every worker's shipped task stats
+                from trino_tpu.stats import render_distributed_plan
 
-                text += "\n\n" + render_fragment_stats(collector.fragments)
+                text = render_distributed_plan(
+                    plan, res.cluster_stats, res.device_stats
+                )
+                wall_ms = max(
+                    (s.get("elapsedMs", 0.0) for s in stages), default=0.0
+                )
+            else:
+                text = render_plan_with_stats(plan, collector)
+                if collector.fragments:
+                    from trino_tpu.stats import render_fragment_stats
+
+                    text += "\n\n" + render_fragment_stats(collector.fragments)
+                if res.device_stats:
+                    from trino_tpu.stats import render_device_stats
+
+                    text += "\n\n" + render_device_stats(res.device_stats)
+                wall_ms = collector.total_wall() * 1000
             text += (
                 f"\n\npeak memory: {res.peak_memory_bytes} bytes"
                 f"\ndynamic filters: {res.dynamic_filters}"
                 f"\noutput rows: {len(res.rows)}"
-                f"\nwall time: {collector.total_wall() * 1000:.1f}ms"
+                f"\nwall time: {wall_ms:.1f}ms"
             )
             return StatementResult(
                 [(line,) for line in text.splitlines()], ["Query Plan"], [T.VARCHAR]

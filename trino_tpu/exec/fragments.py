@@ -35,7 +35,7 @@ Execution model:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -283,6 +283,13 @@ class _Meta:
     # plus statically-known per-execution stats (wire slots, bytes)
     counter_names: Optional[list[str]] = None
     exchange_static: Optional[dict] = None
+    # device profiling (obs/profiler.py): XLA cost/memory analysis of the
+    # compiled program — rides the program-cache entry so warm hits reuse
+    # it without recompiling — and the AOT executable itself (warm hits
+    # execute through it; None when profiling was off at trace time, the
+    # AOT path failed, or a later call saw different input shapes)
+    device_stats: Optional[dict] = None
+    aot: Any = None
 
     def capture(self, res: Result, tracer) -> None:
         self.layout = dict(res.layout)
@@ -300,6 +307,18 @@ class _Meta:
         aux = tuple(self._tracer.aux_out)
         data = tuple((c.data, c.valid) for c in res.batch.columns)
         return data, res.batch.selection_mask(), flags, counters, aux
+
+
+def program_label(program_key) -> str:
+    """Stable display label for a program-cache key: fragment identity
+    without the per-run root-object id (metrics labels and deviceStats
+    keys must not churn across executions of the same cached plan)."""
+    if isinstance(program_key, tuple) and len(program_key) >= 2:
+        if program_key[0] == "frag":
+            return f"frag:{program_key[1]}"
+        if program_key[0] == "post":
+            return f"post:{program_key[1]}"
+    return repr(program_key)
 
 
 class FragmentedExecutor(DistributedExecutor):
@@ -970,16 +989,55 @@ class FragmentedExecutor(DistributedExecutor):
                 else None
             )
             traced_now = cached is None
+            store_stats = (
+                self.programs.setdefault(
+                    "__stats__",
+                    {"hits": 0, "misses": 0, "trace_count": 0,
+                     "compile_ms": 0.0},
+                )
+                if program_key is not None
+                else None
+            )
             if cached is not None:
                 jf, meta = cached
                 self.compile_stats["program_cache_hits"] += 1
+                store_stats["hits"] += 1
             else:
                 meta = _Meta()
                 jf = jax.jit(build_fn(meta))
                 if program_key is not None:
                     self.compile_stats["program_cache_misses"] += 1
+                    store_stats["misses"] += 1
             t0 = _time.perf_counter()
-            data, sel, flags, counters, aux = jf(*args)
+            outs = None
+            if self._device_profiling:
+                # AOT-compile the SAME jitted function and execute through
+                # the resulting executable: identical program (bit-identical
+                # results, no double compile), but the Compiled object
+                # additionally exposes XLA's cost/memory analysis
+                if traced_now:
+                    try:
+                        compiled = jf.lower(*args).compile()
+                        meta.aot = compiled
+                        from trino_tpu.obs.profiler import (
+                            capture_device_stats,
+                        )
+
+                        meta.device_stats = capture_device_stats(compiled)
+                    except Exception:  # noqa: BLE001 — degrade to plain jit
+                        meta.aot = None
+                if meta.aot is not None:
+                    try:
+                        outs = meta.aot(*args)
+                    except Exception:  # noqa: BLE001 — e.g. new input
+                        # shapes on a warm hit: jf(*args) below retraces
+                        # transparently, exactly as the unprofiled path does
+                        meta.aot = None
+                        outs = None
+            if outs is None:
+                outs = jf(*args)
+            data, sel, flags, counters, aux = outs
+            compile_ms = 0.0
             if traced_now:
                 # trace + lower + (XLA or disk-cache) compile happen
                 # synchronously inside the first call; execution itself
@@ -987,6 +1045,11 @@ class FragmentedExecutor(DistributedExecutor):
                 compile_ms = (_time.perf_counter() - t0) * 1000.0
                 self.compile_stats["trace_count"] += 1
                 self.compile_stats["compile_ms"] += compile_ms
+                if store_stats is not None:
+                    store_stats["trace_count"] += 1
+                    store_stats["compile_ms"] = round(
+                        store_stats["compile_ms"] + compile_ms, 3
+                    )
                 get_tracer().record(
                     "program_compile", compile_ms,
                     attrs={
@@ -997,6 +1060,10 @@ class FragmentedExecutor(DistributedExecutor):
                 get_registry().histogram(
                     "trino_tpu_program_compile_ms"
                 ).observe(compile_ms)
+            if self._device_profiling and program_key is not None:
+                self._record_device_stats(
+                    program_label(program_key), meta.device_stats, compile_ms
+                )
             self._last_aux = aux
             if defer and getattr(self, "deferred_flags", None) is not None:
                 if flags:

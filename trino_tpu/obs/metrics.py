@@ -163,8 +163,23 @@ class MetricsRegistry:
 
     # -- rendering ------------------------------------------------------
     @staticmethod
-    def _label_str(labels: Tuple[Tuple[str, str], ...], extra: str = "") -> str:
-        parts = [f'{k}="{v}"' for k, v in labels]
+    def _escape_label_value(v: Any) -> str:
+        """Prometheus text-format label escaping: backslash, double quote,
+        and line feed must be escaped inside label values (exposition
+        format 0.0.4) — fragment labels carry repr()'d program keys that
+        can contain quotes."""
+        return (
+            str(v)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    @classmethod
+    def _label_str(
+        cls, labels: Tuple[Tuple[str, str], ...], extra: str = ""
+    ) -> str:
+        parts = [f'{k}="{cls._escape_label_value(v)}"' for k, v in labels]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""

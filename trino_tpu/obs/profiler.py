@@ -1,0 +1,133 @@
+"""Device-level program profiling: XLA cost/memory accounting.
+
+Reference: the coordinator's per-operator CPU accounting
+(``operator/OperatorStats.java``) has no analog for a compiled-program
+engine — the unit of execution is one XLA program per fragment, so the
+profiling signal comes from XLA itself: ``Compiled.cost_analysis()``
+(FLOPs, bytes accessed) and ``Compiled.memory_analysis()``
+(argument/output/temp/peak HBM) on the AOT-compiled executable.
+
+Both analyses are backend-dependent: CPU returns cost analysis but often
+no (or partial) memory analysis, and some backends return ``None`` or
+raise outright. Everything here degrades to absent fields — callers must
+treat every key as optional.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+# Compiled.memory_analysis() attribute -> our snake_case stat key
+_MEMORY_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+
+
+def _finite(v: Any) -> Optional[float]:
+    """Numeric, finite and non-negative — XLA reports -1 for unknown."""
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    f = float(v)
+    if not math.isfinite(f) or f < 0:
+        return None
+    return f
+
+
+def capture_device_stats(compiled) -> Optional[dict]:
+    """Extract cost/memory analysis from an AOT-compiled executable.
+
+    Returns a dict of whatever the backend reports — a subset of
+    ``flops``, ``bytes_accessed``, ``argument_bytes``, ``output_bytes``,
+    ``temp_bytes``, ``generated_code_bytes``, ``peak_hbm_bytes`` — or
+    ``None`` when the backend reports nothing at all.
+    """
+    out: dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend-dependent, optional
+        ca = None
+    # older jax returns a per-device list of dicts, newer a single dict
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        flops = _finite(ca.get("flops"))
+        if flops is not None:
+            out["flops"] = flops
+        ba = _finite(ca.get("bytes accessed"))
+        if ba is not None:
+            out["bytes_accessed"] = ba
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        ma = None
+    if ma is not None:
+        for attr, key in _MEMORY_FIELDS:
+            v = _finite(getattr(ma, attr, None))
+            if v is not None:
+                out[key] = int(v)
+        peak = _finite(getattr(ma, "peak_memory_in_bytes", None))
+        if peak is None and all(
+            k in out for k in ("argument_bytes", "output_bytes", "temp_bytes")
+        ):
+            # conservative upper bound when the backend has no peak
+            # estimate: everything the program touches resident at once
+            peak = float(
+                out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+            )
+        if peak is not None:
+            out["peak_hbm_bytes"] = int(peak)
+    return out or None
+
+
+def rollup_device_stats(programs: dict[str, dict]) -> dict:
+    """Query-level rollup over per-program stats: summed FLOPs/bytes
+    weighted by execution count, peak HBM as the max across programs
+    (programs run sequentially per query, so concurrent residency is
+    bounded by the largest single program)."""
+    total_flops = 0.0
+    total_bytes = 0.0
+    peak = 0
+    have_flops = have_bytes = have_peak = False
+    for st in programs.values():
+        execs = max(1, int(st.get("executions", 1)))
+        if "flops" in st:
+            have_flops = True
+            total_flops += st["flops"] * execs
+        if "bytes_accessed" in st:
+            have_bytes = True
+            total_bytes += st["bytes_accessed"] * execs
+        if "peak_hbm_bytes" in st:
+            have_peak = True
+            peak = max(peak, int(st["peak_hbm_bytes"]))
+    out: dict[str, Any] = {"programs_profiled": len(programs)}
+    if have_flops:
+        out["total_flops"] = total_flops
+    if have_bytes:
+        out["total_bytes_accessed"] = total_bytes
+    if have_peak:
+        out["peak_hbm_bytes"] = peak
+    return out
+
+
+def merge_device_stats(target: dict, source: Optional[dict]) -> dict:
+    """Merge one executor's ``device_stats_snapshot()['programs']`` (or a
+    worker's shipped copy) into an accumulating per-program dict — used by
+    the coordinator to combine device stats from many tasks. Cost fields
+    describe the compiled program (identical across executions), so they
+    overwrite; ``executions``/``compile_ms`` accumulate."""
+    for label, st in (source or {}).items():
+        if not isinstance(st, dict):
+            continue
+        ent = target.setdefault(label, {"executions": 0, "compile_ms": 0.0})
+        ent["executions"] += int(st.get("executions", 1))
+        ent["compile_ms"] = round(
+            ent["compile_ms"] + float(st.get("compile_ms", 0.0)), 3
+        )
+        for k, v in st.items():
+            if k not in ("executions", "compile_ms"):
+                ent[k] = v
+    return target

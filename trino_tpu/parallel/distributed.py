@@ -63,10 +63,93 @@ class DistributedExecutor(LocalExecutor):
             "salted_rows": 0,
             "overflow_retries": 0,
         }
+        # device-level profiling (obs/profiler.py): per-program XLA
+        # cost/memory stats keyed by a stable program label. The fused
+        # executor fills this at fragment compile time; this eager path
+        # captures its shard_map programs via _profiled_call.
+        self.device_stats: dict[str, dict] = {}
+        self._device_profiling = bool(session.get("device_profiling"))
+        self._profiled_cache: dict = {}  # (label, arg shapes) -> Compiled
 
     @property
     def n_shards(self) -> int:
         return self.mesh.devices.size
+
+    # === device profiling ===============================================
+
+    def _record_device_stats(
+        self, label: str, ds: Optional[dict] = None, compile_ms: float = 0.0
+    ) -> None:
+        """Fold one program execution's captured XLA stats into the
+        per-query map and export the per-program gauges. Called with
+        ``ds=None`` for executions of an already-profiled program."""
+        ent = self.device_stats.setdefault(
+            label, {"executions": 0, "compile_ms": 0.0}
+        )
+        ent["executions"] += 1
+        if compile_ms:
+            ent["compile_ms"] = round(ent["compile_ms"] + compile_ms, 3)
+        for k, v in (ds or {}).items():
+            ent[k] = v
+        if ds:
+            from trino_tpu.obs.metrics import get_registry
+
+            reg = get_registry()
+            if "flops" in ds:
+                reg.gauge("trino_tpu_program_flops", fragment=label).set(
+                    ds["flops"]
+                )
+            if "peak_hbm_bytes" in ds:
+                reg.gauge(
+                    "trino_tpu_program_peak_hbm_bytes", fragment=label
+                ).set(ds["peak_hbm_bytes"])
+
+    def device_stats_snapshot(self) -> Optional[dict]:
+        """Per-query device-profiling rollup (engine attaches this to the
+        statement result; /v1/query serves it as ``deviceStats``)."""
+        if not self.device_stats:
+            return None
+        from trino_tpu.obs.profiler import rollup_device_stats
+
+        snap = rollup_device_stats(self.device_stats)
+        snap["programs"] = {k: dict(v) for k, v in self.device_stats.items()}
+        return snap
+
+    def _profiled_call(self, label: str, fn, *args):
+        """Run one eager shard_map program; with ``device_profiling`` on
+        it is AOT-compiled (``jax.jit`` of the same function — identical
+        numerics) so XLA cost/memory analysis lands in
+        ``device_stats[label]``. Compiled executables are cached per
+        argument shapes; any failure falls back to the plain eager call,
+        so profiling can never fail a query."""
+        if not self._device_profiling:
+            return fn(*args)
+        import time as _time
+
+        try:
+            from trino_tpu.obs.profiler import capture_device_stats
+
+            shapes = tuple(
+                (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+                for a in jax.tree_util.tree_leaves(args)
+            )
+            key = (label, shapes)
+            compiled = self._profiled_cache.get(key)
+            if compiled is None:
+                t0 = _time.perf_counter()
+                compiled = jax.jit(fn).lower(*args).compile()
+                compile_ms = (_time.perf_counter() - t0) * 1000.0
+                self._record_device_stats(
+                    label, capture_device_stats(compiled), compile_ms
+                )
+                if len(self._profiled_cache) >= 64:
+                    self._profiled_cache.pop(next(iter(self._profiled_cache)))
+                self._profiled_cache[key] = compiled
+            else:
+                self._record_device_stats(label)
+            return compiled(*args)
+        except Exception:  # noqa: BLE001 — profiling must never fail a query
+            return fn(*args)
 
     # === scan: splits round-robin over shards ===========================
     def _exec_tablescan(self, node: P.TableScan) -> Result:
@@ -233,7 +316,9 @@ class DistributedExecutor(LocalExecutor):
                 PS(),
             ),
         )
-        key_data_g, key_valid_g, vals_g, cnts_g, live_g, ovf_g = mapped(*flat_inputs)
+        key_data_g, key_valid_g, vals_g, cnts_g, live_g, ovf_g = (
+            self._profiled_call("partial_agg", mapped, *flat_inputs)
+        )
         if bool(np.asarray(ovf_g).max()):
             # some shard exceeded G groups — retry with larger capacity
             if G > (1 << 24):
@@ -512,6 +597,7 @@ class DistributedExecutor(LocalExecutor):
                 per_shard_cap,
                 join_type,
                 nlk,
+                profiler=self._profiled_call,
             )
             out_cols, out_sel, overflow = out
             if not bool(np.asarray(overflow).max()):
@@ -618,6 +704,7 @@ class DistributedExecutor(LocalExecutor):
                 node.join_type,
                 nlk,
                 build_sharded=True,
+                profiler=self._profiled_call,
             )
             if not bool(np.asarray(overflow).max()):
                 break
@@ -731,9 +818,13 @@ def _sharded_probe(
     join_type,
     nlk,
     build_sharded=False,
+    profiler=None,
 ):
     """Per-shard join: build local table from (replicated or co-partitioned)
-    build side, probe local rows, expand into fixed capacity."""
+    build side, probe local rows, expand into fixed capacity.
+
+    ``profiler`` (``DistributedExecutor._profiled_call``) optionally wraps
+    the shard_map program so its XLA cost/memory analysis is captured."""
     n = mesh.devices.size
 
     def pad_side(cols, keys, h, sel):
@@ -825,5 +916,9 @@ def _sharded_probe(
         + list(build_keys)
         + [bh, build_sel]
     )
-    outs, osel, ovf = go(*args)
+    if profiler is not None:
+        label = "probe_join" + ("_partitioned" if build_sharded else "_broadcast")
+        outs, osel, ovf = profiler(label, go, *args)
+    else:
+        outs, osel, ovf = go(*args)
     return list(outs), osel, ovf

@@ -57,7 +57,10 @@ _HOIST_FORMS = frozenset({"in", "between"})
 # session properties that change what a fragment traces into (capacity
 # defaults, execution strategy, lowering decisions). Anything NOT listed
 # here must not affect codegen, or same-fingerprint queries would want
-# different programs.
+# different programs. ``device_profiling`` is deliberately absent: it
+# AOT-compiles the SAME jitted program (obs/profiler.py), so toggling it
+# must keep the fingerprint — and the cached program, with its captured
+# cost/memory stats riding the cache entry's _Meta — stable.
 _CODEGEN_PROPS = (
     "batch_capacity",
     "broadcast_join_threshold_rows",

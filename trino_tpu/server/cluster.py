@@ -1108,6 +1108,7 @@ class ClusterScheduler:
         task_attempts = stats.get("task_attempts", {})
         now = time.monotonic()
         stages = []
+        query_programs: dict[str, dict] = {}
         for fid in sorted(obs["stage_spans"]):
             start = obs["stage_start"].get(fid)
             elapsed_ms = (now - start) * 1000.0 if start is not None else 0.0
@@ -1135,6 +1136,9 @@ class ClusterScheduler:
                     "p99": percentile(vals, 99),
                     "max": max(vals),
                 }
+            self._merge_stage_task_stats(
+                entry, remote_tasks.get(fid, []), query_programs
+            )
             stages.append(entry)
             reg.histogram(
                 "trino_tpu_stage_elapsed_ms", stage=str(fid)
@@ -1158,6 +1162,85 @@ class ClusterScheduler:
                     )
                 )
         stats["stages"] = stages
+        if query_programs:
+            from trino_tpu.obs.profiler import rollup_device_stats
+
+            ds = rollup_device_stats(query_programs)
+            ds["programs"] = query_programs
+            stats["deviceStats"] = ds
+
+    @staticmethod
+    def _merge_stage_task_stats(
+        entry: dict,
+        tasks: list[HttpRemoteTask],
+        query_programs: dict[str, dict],
+    ) -> None:
+        """Merge every FINISHED sibling task's shipped stats (rows, bytes,
+        compile, exchange counters, device profiler snapshot —
+        ``server/task.py::SqlTask.info``) into one stage entry, and fold
+        the per-program device stats into the query-level accumulator.
+        Non-FINISHED attempts (failed, speculative losers) are skipped so
+        a retried partition counts once."""
+        from trino_tpu.obs.profiler import merge_device_stats
+
+        rows = in_rows = out_bytes = in_bytes = 0
+        have_rows = have_in = have_bytes = False
+        compile_ms = flops = 0.0
+        have_flops = have_peak = False
+        peak = 0
+        exchange: dict = {}
+        for t in tasks:
+            st = t.last_status or {}
+            if st.get("state") != "FINISHED":
+                continue
+            ts = st.get("stats") or {}
+            if "output_rows" in ts:
+                have_rows = True
+                rows += int(ts["output_rows"])
+            if "input_rows" in ts:
+                have_in = True
+                in_rows += int(ts["input_rows"])
+            if "output_bytes" in ts:
+                have_bytes = True
+                out_bytes += int(ts["output_bytes"])
+            if "input_bytes" in ts:
+                in_bytes += int(ts["input_bytes"])
+            compile_ms += float((ts.get("compile") or {}).get("compile_ms", 0.0))
+            for k, v in (ts.get("exchange") or {}).items():
+                # ratios/capacity maps don't sum — recomputed/dropped below
+                if k != "padding_ratio" and isinstance(
+                    v, (int, float)
+                ) and not isinstance(v, bool):
+                    exchange[k] = exchange.get(k, 0) + v
+            ds = ts.get("deviceStats") or {}
+            merge_device_stats(query_programs, ds.get("programs"))
+            if ds.get("total_flops") is not None:
+                have_flops = True
+                flops += float(ds["total_flops"])
+            if ds.get("peak_hbm_bytes") is not None:
+                have_peak = True
+                peak = max(peak, int(ds["peak_hbm_bytes"]))
+        if have_rows:
+            entry["rows"] = rows
+        if have_in:
+            entry["inputRows"] = in_rows
+        if have_bytes:
+            entry["outputBytes"] = out_bytes
+            entry["inputBytes"] = in_bytes
+        if compile_ms:
+            entry["compileMs"] = round(compile_ms, 3)
+        if exchange:
+            if exchange.get("shuffle_rows"):
+                exchange["padding_ratio"] = round(
+                    exchange.get("padded_shuffle_rows", 0)
+                    / max(1, exchange["shuffle_rows"]),
+                    4,
+                )
+            entry["exchange"] = exchange
+        if have_flops:
+            entry["flops"] = flops
+        if have_peak:
+            entry["peakHbmBytes"] = peak
 
     # --- root fragment on the coordinator --------------------------------
 

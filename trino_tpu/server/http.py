@@ -122,6 +122,11 @@ class TrinoTpuServer:
         self.engine._runtime_nodes_fn = lambda: [
             ("coordinator", self.base_uri, VERSION, True, self.state)
         ]
+        # live task registry for system.runtime.tasks (this node's
+        # SqlTaskManager — on a coordinator that includes any local tasks)
+        self.engine._runtime_tasks_fn = lambda: [
+            t.info() for t in self.task_manager.tasks()
+        ]
 
     # --- lifecycle --------------------------------------------------------
 

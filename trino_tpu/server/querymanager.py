@@ -271,6 +271,9 @@ class ManagedQuery:
             # skew-aware exchange counters (shuffle rows/bytes, padding
             # ratio, overflow retries, hot/salted keys, capacity provenance)
             "exchangeStats": self.result.exchange_stats if self.result else None,
+            # device profiler rollup (obs/profiler.py): per-program XLA
+            # flops / peak HBM merged across workers, plus query totals
+            "deviceStats": self.result.device_stats if self.result else None,
             # compile-time telemetry (cross-query program cache): a warm
             # run shows traceCount == 0 and programCacheHits > 0
             "compileMs": self.result.compile_ms if self.result else 0.0,

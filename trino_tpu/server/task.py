@@ -844,13 +844,13 @@ class SqlTask:
                     self.injector.stall_task(site, self.node_id)
                 from trino_tpu.memory import batch_nbytes
 
-                self._account(
-                    sum(
-                        batch_nbytes(b)
-                        for batches in prefetched.values()
-                        for b in batches
-                    )
+                in_bytes = sum(
+                    batch_nbytes(b)
+                    for batches in prefetched.values()
+                    for b in batches
                 )
+                self._account(in_bytes)
+                self.stats["input_bytes"] = int(in_bytes)
                 result = None
                 exec_t0 = time.monotonic()
                 mode = self.session.get("worker_execution")
@@ -949,6 +949,13 @@ class SqlTask:
                 runner.executor.dynamic_filters
             )
             self.stats["compile"] = dict(runner.executor.compile_stats)
+            # device profiler + exchange counters ride the task status back
+            # to the coordinator, which merges them per stage for the
+            # distributed EXPLAIN ANALYZE / queryStats rollup
+            dsnap = runner.executor.device_stats_snapshot()
+            if dsnap:
+                self.stats["deviceStats"] = dsnap
+            self.stats["exchange"] = runner.executor.exchange_stats_snapshot()
             return result
         except (FusedUnsupported, jax.errors.TracerArrayConversionError) as e:
             if strict:
@@ -982,7 +989,13 @@ class SqlTask:
         return executor._exec(root)
 
     def _emit(self, result: Result) -> None:
+        from trino_tpu.memory import batch_nbytes
+
         batch = result.batch.compact()
+        # per-task output volume — the coordinator's per-stage rows /
+        # exchange-bytes merge reads these off the final task status
+        self.stats["output_rows"] = int(batch.num_rows)
+        self.stats["output_bytes"] = int(batch_nbytes(batch))
         n = self.n_output_partitions
         ex = self.fragment.output_exchange
         if ex == "broadcast":

@@ -46,6 +46,10 @@ PAGE = """<!doctype html>
   .tlbar.spec.cancelled { background: #565f89; }
   .tlms { width: 6rem; font-size: .72rem; color: #9aa0b0;
           text-align: right; }
+  table.stages { width: auto; margin: .4rem 0 .6rem .6rem; }
+  table.stages th, table.stages td { font-size: .72rem;
+          padding: .2rem .5rem; border-bottom: 1px solid #2a2a38; }
+  table.stages td.num { text-align: right; }
 </style>
 </head>
 <body>
@@ -106,6 +110,33 @@ function renderTimeline(tl) {
   ).join('') + '</div>';
 }
 
+// per-stage device-profiler columns (queryStats.stages merged by the
+// coordinator from worker task stats: rows / wall / exchange bytes /
+// XLA cost-analysis FLOPs / peak HBM). Blank cells mean the backend
+// reported no cost model (e.g. CPU) — the row layout stays stable.
+function renderStages(q) {
+  const stages = ((q.queryStats || {}).stages) || [];
+  if (!stages.length) return '';
+  const esc = s => String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;');
+  const num = v => (v === null || v === undefined) ? '' :
+      Number(v).toLocaleString();
+  const flops = v => (v === null || v === undefined) ? '' :
+      Number(v).toExponential(2);
+  const rows = stages.map(s => {
+    const ex = s.exchange || {};
+    return `<tr><td>${esc(s.stage)}</td>` +
+      `<td class="num">${num(s.tasks)}</td>` +
+      `<td class="num">${num(s.rows)}</td>` +
+      `<td class="num">${(s.elapsedMs || 0).toFixed(1)}</td>` +
+      `<td class="num">${num(ex.shuffle_bytes)}</td>` +
+      `<td class="num">${flops(s.flops)}</td>` +
+      `<td class="num">${num(s.peakHbmBytes)}</td></tr>`;
+  });
+  return '<table class="stages"><tr><th>stage</th><th>tasks</th>' +
+    '<th>rows</th><th>wall ms</th><th>shuffle B</th>' +
+    '<th>flops</th><th>peak HBM B</th></tr>' + rows.join('') + '</table>';
+}
+
 async function toggleTimeline(qid) {
   if (open.has(qid)) open.delete(qid); else open.add(qid);
   refresh();
@@ -138,7 +169,7 @@ async function refresh() {
         tl = await (await fetch(
             '/v1/query/' + encodeURIComponent(q.queryId) + '/timeline')).json();
       } catch (e) { /* timeline unavailable */ }
-      rows.push(`<tr><td colspan="5">${renderTimeline(tl)}</td></tr>`);
+      rows.push(`<tr><td colspan="5">${renderStages(q)}${renderTimeline(tl)}</td></tr>`);
     }
   }
   document.getElementById('qtable').innerHTML =

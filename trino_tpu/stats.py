@@ -80,6 +80,102 @@ def render_fragment_stats(fragments: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_device_stats(device_stats: dict) -> str:
+    """EXPLAIN ANALYZE section for the device profiler: per-program XLA
+    cost/memory analysis (obs/profiler.py) plus the query rollup. Every
+    field is backend-dependent and rendered only when captured."""
+    lines = ["Device programs (XLA cost/memory analysis):"]
+    for label, st in sorted((device_stats.get("programs") or {}).items()):
+        parts = [f"  {label}:"]
+        if "flops" in st:
+            parts.append(f"flops={st['flops']:.4g}")
+        if "bytes_accessed" in st:
+            parts.append(f"bytes_accessed={int(st['bytes_accessed']):,}")
+        if "peak_hbm_bytes" in st:
+            parts.append(f"peak_hbm={int(st['peak_hbm_bytes']):,}B")
+        if st.get("compile_ms"):
+            parts.append(f"compile={st['compile_ms']:.1f}ms")
+        parts.append(f"executions={st.get('executions', 0)}")
+        lines.append(" ".join(parts))
+    totals = []
+    if device_stats.get("total_flops") is not None:
+        totals.append(f"total_flops={device_stats['total_flops']:.4g}")
+    if device_stats.get("peak_hbm_bytes") is not None:
+        totals.append(f"peak_hbm={int(device_stats['peak_hbm_bytes']):,}B")
+    if totals:
+        lines.append("  query: " + " ".join(totals))
+    return "\n".join(lines)
+
+
+def render_distributed_plan(
+    node: P.PlanNode,
+    cluster_stats: dict,
+    device_stats: Optional[dict] = None,
+) -> str:
+    """Trino-style distributed EXPLAIN ANALYZE
+    (``PlanPrinter.textDistributedPlan`` analog): the logical plan
+    followed by one section per stage, annotated with task counts, rows,
+    wall, exchange bytes / padding ratio, and per-stage FLOPs / peak HBM —
+    all merged by the coordinator from every worker's shipped task stats
+    (``server/cluster.py::_finalize_query``)."""
+    lines = ["Distributed plan:", render_plan_with_stats(node, None, 1), ""]
+    lines.append("Stages (stats merged from worker tasks):")
+    for st in cluster_stats.get("stages") or []:
+        lines.append(
+            f"Stage {st.get('stage')} "
+            f"[tasks: {st.get('tasks', 0)}, attempts: {st.get('attempts', 0)},"
+            f" wall: {st.get('elapsedMs', 0.0):.1f}ms]"
+        )
+        parts = []
+        if st.get("rows") is not None:
+            parts.append(f"output rows: {st['rows']:,}")
+        if st.get("inputRows") is not None:
+            parts.append(f"input rows: {st['inputRows']:,}")
+        if st.get("outputBytes") is not None:
+            parts.append(f"output bytes: {st['outputBytes']:,}")
+        if parts:
+            lines.append("    " + "  ".join(parts))
+        te = st.get("taskElapsedMs")
+        if te:
+            lines.append(
+                f"    task wall p50/p99/max: {te['p50']:.1f}/"
+                f"{te['p99']:.1f}/{te['max']:.1f} ms"
+            )
+        ex = st.get("exchange") or {}
+        exparts = [
+            f"{k}={ex[k]}"
+            for k in (
+                "shuffle_rows", "shuffle_bytes", "padding_ratio",
+                "hot_keys", "salted_rows", "overflow_retries",
+            )
+            if ex.get(k)
+        ]
+        if exparts:
+            lines.append("    exchange: " + " ".join(exparts))
+        dparts = []
+        if st.get("flops") is not None:
+            dparts.append(f"flops={st['flops']:.4g}")
+        if st.get("peakHbmBytes") is not None:
+            dparts.append(f"peak_hbm={int(st['peakHbmBytes']):,}B")
+        if st.get("compileMs"):
+            dparts.append(f"compile={st['compileMs']:.1f}ms")
+        if dparts:
+            lines.append("    device: " + " ".join(dparts))
+    counters = []
+    for key, lab in (
+        ("task_retries", "task retries"),
+        ("speculative_attempts", "speculative attempts"),
+        ("speculative_wins", "speculative wins"),
+    ):
+        if cluster_stats.get(key):
+            counters.append(f"{lab}: {cluster_stats[key]}")
+    if counters:
+        lines.append("    " + "  ".join(counters))
+    if device_stats:
+        lines.extend(["", render_device_stats(device_stats)])
+    return "\n".join(lines)
+
+
 def render_plan_with_stats(
     node: P.PlanNode, collector: Optional[StatsCollector], indent: int = 0
 ) -> str:
