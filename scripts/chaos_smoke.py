@@ -11,6 +11,14 @@ Boots a 2-worker cluster and runs three scenarios:
    ``speculation=true`` — the straggler detector must hedge at least
    one attempt onto the healthy worker, results stay bit-identical,
    and the speculative counters land in the summary line.
+4. ``node-death`` (runs last — a worker does not survive it): with
+   ``retry_policy=TASK`` + ``exchange_spooling=true``, the worker that
+   ran Q1's scan fragment ``os._exit``s right after that task finishes
+   (``fault_worker_exit_site=2.0``; every task stalls 1s pre-execute so
+   the partial-agg consumers provably pull AFTER the death). Spool
+   recovery must keep the result bit-identical with NO query-level
+   retry (queryAttempts == 1); spooled-bytes and recovered-task
+   counters land in the summary.
 
 Quick manual repro for the fault-tolerance stack (CI runs the same
 scenarios as ``tests/test_fault_tolerance.py -m faults`` /
@@ -68,6 +76,21 @@ def main() -> int:
         "speculation_multiplier": 2.0,
         "speculation_max_fraction": 1.0,
     }
+    # node-death: the worker hosting Q1's scan task (fragment 2,
+    # partition 0 — Q1 fragments as root 0 <- partial agg 1 <- scan 2)
+    # kills itself 300ms after that task finishes; the 1s pre-execute
+    # stall on every task guarantees the fragment-1 consumers pull after
+    # the death, so spooled output / lineage recovery must absorb it
+    death_props = {
+        "retry_policy": "TASK",
+        "exchange_spooling": True,
+        "task_retry_attempts": 8,
+        "retry_initial_delay_ms": 20,
+        "retry_max_delay_ms": 200,
+        "fault_worker_exit_site": "2.0",
+        "fault_worker_exit_delay_ms": 300,
+        "fault_task_stall_ms": 1000,
+    }
     # the summary dict is built incrementally and emitted in a finally, so
     # a crash mid-scenario still prints one machine-readable JSON line with
     # whatever was gathered (partial: true)
@@ -83,6 +106,8 @@ def main() -> int:
                 Q_SKEW, session_properties={**chaos, **skew_props}
             )
             slow_spec, _ = runner.execute(Q1, session_properties=slow_props)
+            # LAST scenario: one worker dies mid-query and stays dead
+            death, _ = runner.execute(Q1, session_properties=death_props)
             from trino_tpu.server import auth
 
             req = urllib.request.Request(
@@ -99,6 +124,15 @@ def main() -> int:
         retries = max(q.get("taskRetries", 0) for q in queries)
         spec_attempts = max(q.get("speculativeAttempts", 0) for q in queries)
         spec_wins = max(q.get("speculativeWins", 0) for q in queries)
+        death_info = max(
+            (q for q in queries if q.get("spooledBytes", 0) > 0
+             or q.get("recoveredTasks", 0) > 0),
+            key=lambda q: q.get("recoveredTasks", 0),
+            default={},
+        )
+        recovered = death_info.get("recoveredTasks", 0)
+        spooled = death_info.get("spooledBytes", 0)
+        death_attempts = death_info.get("queryAttempts", 1)
         # device-profiler rollup across every scraped query record:
         # FLOPs sum / peak HBM max as merged by the coordinator from
         # worker task stats (all-zero on backends with no cost model)
@@ -120,12 +154,17 @@ def main() -> int:
             task_retries=retries,
             speculative_attempts=spec_attempts,
             speculative_wins=spec_wins,
+            recovered_tasks=recovered,
+            recovered_levels=death_info.get("recoveredTaskLevels", {}),
+            spooled_bytes=spooled,
+            node_death_query_attempts=death_attempts,
             partial=False,
         )
         print(
             f"seed={seed} rows={len(chaotic)} task_retries={retries}"
             f" speculative_attempts={spec_attempts}"
             f" speculative_wins={spec_wins}"
+            f" recovered_tasks={recovered} spooled_bytes={spooled}"
         )
         if chaotic != clean:
             print("FAIL: chaotic result differs from fault-free result")
@@ -139,13 +178,27 @@ def main() -> int:
             print("FAIL: slow-worker speculative result differs from fault-free")
             summary["ok"] = False
             return 1
+        if death != clean:
+            print("FAIL: node-death result differs from fault-free")
+            summary["ok"] = False
+            return 1
+        if death_attempts > 1:
+            print(
+                "FAIL: node-death escalated to a query-level retry"
+                f" (queryAttempts={death_attempts})"
+            )
+            summary["ok"] = False
+            return 1
+        if recovered == 0:
+            print("WARN: no recovered tasks — the worker-exit fault"
+                  " never bit a consumer")
         if retries == 0:
             print("WARN: no retries at this seed — injection never fired")
         if spec_attempts == 0:
             print("WARN: no speculative attempts — straggler never flagged")
         print(
             "OK: bit-identical under 30% task-crash injection"
-            " (incl. skewed join + 10x slow worker)"
+            " (incl. skewed join, 10x slow worker, node death)"
         )
         summary["ok"] = True
         return 0

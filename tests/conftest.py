@@ -61,6 +61,7 @@ _SHARD_WEIGHTS = {
     "test_tpcds_suite.py": 90,
     "test_tpch_suite.py": 90,
     "test_fault_tolerance.py": 80,
+    "test_spool.py": 20,
     "test_queries.py": 60,
     "test_tpcds_fused.py": 55,
     "test_tpch_fused.py": 55,

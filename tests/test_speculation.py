@@ -442,6 +442,92 @@ class TestHedgedDispatch:
         assert stats.get("task_retries", 0) == 0
 
 
+# === unit: QUEUED-but-undispatched hedging ===============================
+
+
+class TestQueuedHedging:
+    """An attempt whose dispatch POST never landed (start_error set) is
+    hedged immediately on a different healthy node — no straggler
+    threshold, there is nothing running to outwait. The queued twin is
+    cancelled (plain, not speculative) when the hedge promotes."""
+
+    def _await(self, sched, tasks, obs, stats=None):
+        stats = stats if stats is not None else {}
+        sched._await_fragment(
+            "cq5", SimpleNamespace(id=0), tasks,
+            Session(properties={"retry_initial_delay_ms": 1,
+                                "retry_max_delay_ms": 2}),
+            stats, {}, obs=obs,
+        )
+        return stats
+
+    def test_undispatched_task_hedges_without_threshold(self, fake_cluster):
+        sched, nodes = fake_cluster
+        stuck = _FakeTask(nodes[1], "cq5.0.0", {})
+        stuck.start_error = "connection refused"
+
+        tasks = [stuck]
+        stats = self._await(sched, tasks, _spec_obs())
+
+        hedge = _FakeTask.created[-1]
+        assert hedge is not stuck and hedge.speculative
+        assert hedge.task_id == "cq5.0.0s1"
+        assert hedge.node.node_id != stuck.node.node_id
+        # the instantly-finishing hedge won the race outright: the queued
+        # twin is cancelled speculatively before it ever dispatched
+        assert tasks[0] is hedge
+        assert stuck.cancels == [True]
+        assert stats["speculative_attempts"] == 1
+        assert stats["speculative_wins"] == 1
+        # hedge path, not the backoff/retry path
+        assert stats.get("task_retries", 0) == 0
+
+    def test_slow_hedge_promoted_over_queued_twin(self, fake_cluster):
+        sched, nodes = fake_cluster
+        # hedge still in flight when the twin's start_error is acted on:
+        # the promotion path swaps it in with a PLAIN cancel of the twin
+        _FakeTask.hedge_script = [{"state": "RUNNING"},
+                                  {"state": "FINISHED", "elapsed": 0.02}]
+        stuck = _FakeTask(nodes[1], "cq5.0.0", {})
+        stuck.start_error = "connection refused"
+
+        tasks = [stuck]
+        stats = self._await(sched, tasks, _spec_obs())
+
+        hedge = _FakeTask.created[-1]
+        assert hedge.speculative and tasks[0] is hedge
+        assert stuck.cancels == [False]
+        assert stats["speculative_attempts"] == 1
+        assert stats.get("task_retries", 0) == 0
+
+    def test_no_budget_falls_back_to_retry(self, fake_cluster):
+        sched, nodes = fake_cluster
+        stuck = _FakeTask(nodes[1], "cq5.0.0", {})
+        stuck.start_error = "connection refused"
+
+        tasks = [stuck]
+        stats = self._await(sched, tasks, _spec_obs(budget=0))
+
+        retry = _FakeTask.created[-1]
+        assert not retry.speculative
+        assert retry.task_id == "cq5.0.0r1"
+        assert tasks[0] is retry
+        assert stats.get("speculative_attempts", 0) == 0
+        assert stats["task_retries"] == 1
+
+    def test_disabled_speculation_never_hedges_queued(self, fake_cluster):
+        sched, nodes = fake_cluster
+        stuck = _FakeTask(nodes[1], "cq5.0.0", {})
+        stuck.start_error = "connection refused"
+
+        tasks = [stuck]
+        stats = self._await(
+            sched, tasks, _spec_obs(enabled=False, budget=1)
+        )
+        assert stats.get("speculative_attempts", 0) == 0
+        assert stats["task_retries"] == 1
+
+
 # === unit: query-completed single-fire under race ========================
 
 

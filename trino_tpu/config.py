@@ -102,6 +102,15 @@ class Session:
         ("query_retry_attempts", 3),  # total attempts per query (incl. first)
         ("retry_initial_delay_ms", 100),
         ("retry_max_delay_ms", 2000),
+        # spooled exchange (trino_tpu/exchange/spool.py): under TASK
+        # retry, workers asynchronously copy finished output-buffer pages
+        # to a coordinator-hosted spool store, so a producer's death
+        # recovers by re-pointing consumers at the spool (level=task) or
+        # re-executing only the lost producers (level=lineage) instead of
+        # falling back to a QUERY retry
+        ("exchange_spooling", False),
+        ("spool_dir", ""),  # "" = host-RAM backend; path = local disk
+        ("spool_max_bytes", 256 << 20),
         # deterministic fault injection (chaos testing; ft/injection.py):
         # all probabilities zero -> injection fully disabled
         ("fault_injection_seed", 0),
@@ -116,6 +125,14 @@ class Session:
         ("fault_slow_workers", ""),
         ("fault_task_stall_ms", 0),
         ("fault_task_slow_factor", 1.0),
+        # worker-death faults: once a task at fault site
+        # "task:{fragment}.{partition}" finishes on a matching node
+        # (fault_worker_exit_node, "" = any), the worker process exits
+        # hard (os._exit) after fault_worker_exit_delay_ms — simulating
+        # SIGKILL for spool/lineage recovery tests. "" site = disabled.
+        ("fault_worker_exit_node", ""),
+        ("fault_worker_exit_site", ""),
+        ("fault_worker_exit_delay_ms", 0),
         # --- speculative (hedged) task execution (server/cluster.py) ------
         # under retry_policy=TASK: when a running attempt's elapsed exceeds
         # max(floor, multiplier * p99 of completed siblings), dispatch one

@@ -61,12 +61,21 @@ class FaultInjector:
         slow_workers: str = "",
         task_stall_ms: float = 0.0,
         task_slow_factor: float = 1.0,
+        worker_exit_node: str = "",
+        worker_exit_site: str = "",
+        worker_exit_delay_ms: float = 0.0,
     ):
         self.seed = int(seed)
         self.salt = salt  # varies per query attempt under QUERY retry
         self.task_crash_p = float(task_crash_p)
         self.http_drop_p = float(http_drop_p)
         self.http_delay_ms = float(http_delay_ms)
+        # worker-death fault: after a task at "task:{worker_exit_site}"
+        # finishes on a matching node ("" = any), the worker process
+        # os._exit()s — a deterministic stand-in for SIGKILL
+        self.worker_exit_node = str(worker_exit_node or "")
+        self.worker_exit_site = str(worker_exit_site or "")
+        self.worker_exit_delay_ms = max(0.0, float(worker_exit_delay_ms))
         # delay faults: which nodes run slow ("" = all), and how — a fixed
         # pre-execute stall and/or a multiplicative execution slowdown
         self.slow_workers = frozenset(
@@ -91,12 +100,14 @@ class FaultInjector:
             delay_ms = float(session.get("fault_http_delay_ms"))
             stall_ms = float(session.get("fault_task_stall_ms"))
             slow_factor = float(session.get("fault_task_slow_factor"))
+            exit_site = str(session.get("fault_worker_exit_site") or "")
             if (
                 crash_p <= 0
                 and drop_p <= 0
                 and delay_ms <= 0
                 and stall_ms <= 0
                 and slow_factor <= 1.0
+                and not exit_site
             ):
                 return None
             return cls(
@@ -108,6 +119,13 @@ class FaultInjector:
                 slow_workers=str(session.get("fault_slow_workers")),
                 task_stall_ms=stall_ms,
                 task_slow_factor=slow_factor,
+                worker_exit_node=str(
+                    session.get("fault_worker_exit_node") or ""
+                ),
+                worker_exit_site=exit_site,
+                worker_exit_delay_ms=float(
+                    session.get("fault_worker_exit_delay_ms")
+                ),
             )
         except (KeyError, TypeError, ValueError):
             return None
@@ -204,6 +222,46 @@ class FaultInjector:
         per-run identifiers (ports, query counters)."""
         return f"http:{op}:{target}:t{attempt}"
 
+    # --- worker-death faults ----------------------------------------------
+
+    def should_exit_worker(self, site: str, node_id: Optional[str]) -> bool:
+        if not self.worker_exit_site:
+            return False
+        if site != f"task:{self.worker_exit_site}":
+            return False
+        if self.worker_exit_node and node_id != self.worker_exit_node:
+            return False
+        return True
+
+    def maybe_exit_worker(self, site: str, node_id: Optional[str]) -> None:
+        """Kill this worker process (``os._exit`` — no cleanup, no spool
+        flush beyond what already happened) when ``site`` matches the
+        configured fault point. Called after a task's terminal-state
+        bookkeeping, so the death lands exactly once the fault-site task
+        FINISHED; ``worker_exit_delay_ms`` lets the coordinator observe
+        that state before the node vanishes. Fires at most once per
+        process."""
+        if not self.should_exit_worker(site, node_id):
+            return
+        if _worker_exit_fired.is_set():
+            return
+        _worker_exit_fired.set()
+        self._record(site, "worker-exit", self.worker_exit_delay_ms / 1000.0)
+        delay_s = self.worker_exit_delay_ms / 1000.0
+
+        def _die():
+            if delay_s > 0:
+                time.sleep(delay_s)
+            import os
+
+            os._exit(137)  # SIGKILL-grade: skip atexit, flushes, finally
+
+        threading.Thread(target=_die, daemon=True).start()
+
+
+# process-wide: one injected death per worker process, even across tasks
+_worker_exit_fired = threading.Event()
+
 
 def injection_properties(
     seed: int,
@@ -213,6 +271,9 @@ def injection_properties(
     slow_workers: str = "",
     task_stall_ms: float = 0.0,
     task_slow_factor: float = 1.0,
+    worker_exit_node: str = "",
+    worker_exit_site: str = "",
+    worker_exit_delay_ms: float = 0.0,
 ) -> dict:
     """Session-property dict enabling injection (test/CLI convenience)."""
     return {
@@ -223,6 +284,9 @@ def injection_properties(
         "fault_slow_workers": slow_workers,
         "fault_task_stall_ms": task_stall_ms,
         "fault_task_slow_factor": task_slow_factor,
+        "fault_worker_exit_node": worker_exit_node,
+        "fault_worker_exit_site": worker_exit_site,
+        "fault_worker_exit_delay_ms": worker_exit_delay_ms,
     }
 
 

@@ -123,6 +123,36 @@ class SpeculationConfig:
         return max(self.floor_ms, self.multiplier * p99)
 
 
+class SpoolConfig:
+    """Spooled-exchange knobs — the recovery tier between TASK and QUERY.
+
+    The recovery ladder: a failed *attempt* retries on another node
+    (TASK); a straggler gets hedged (speculation); a *dead producer's*
+    finished output is served from the spool or, if un-spooled, the
+    producer alone is re-executed via lineage (this tier); only when all
+    of that is exhausted does the whole statement re-run (QUERY). Spooling
+    only engages under ``retry_policy=TASK`` — it extends the retained-
+    buffer exchange that policy already materializes.
+    """
+
+    def __init__(self, enabled: bool = False, spool_dir: str = "",
+                 max_bytes: int = 256 << 20):
+        self.enabled = bool(enabled)
+        self.spool_dir = str(spool_dir or "")
+        self.max_bytes = max(0, int(max_bytes))
+
+    @classmethod
+    def from_session(cls, session) -> "SpoolConfig":
+        try:
+            return cls(
+                enabled=bool(session.get("exchange_spooling")),
+                spool_dir=str(session.get("spool_dir") or ""),
+                max_bytes=int(session.get("spool_max_bytes")),
+            )
+        except (KeyError, TypeError, ValueError):
+            return cls()
+
+
 class Backoff:
     """Exponential backoff with bounded, deterministic jitter.
 

@@ -17,6 +17,7 @@ ENV_VAR = "TRINO_TPU_INTERNAL_SECRET"
 #: request paths that are cluster-internal (prefix match)
 INTERNAL_PREFIXES = (
     "/v1/task", "/v1/announce", "/v1/spmd", "/v1/discovery", "/v1/write",
+    "/v1/spool",
 )
 
 

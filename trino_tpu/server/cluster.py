@@ -84,7 +84,14 @@ class ClusterNodeManager:
                 self.failure_detector.register(node_id, uri)
             else:
                 node.last_announce = time.time()
-                node.uri = uri.rstrip("/")
+                new_uri = uri.rstrip("/")
+                if node.uri != new_uri:
+                    # restarted worker, same identity, new port: the
+                    # detector must ping the NEW uri (and forget the dead
+                    # port's failure history) or the fresh process would
+                    # be flagged failed on its predecessor's evidence
+                    node.uri = new_uri
+                    self.failure_detector.register(node_id, new_uri)
         if not self._started:
             self._started = True
             try:
@@ -95,6 +102,14 @@ class ClusterNodeManager:
     def remove(self, node_id: str) -> None:
         with self._lock:
             self._nodes.pop(node_id, None)
+
+    def decommission(self, node_id: str) -> None:
+        """Drained-worker deregistration (DELETE /v1/announce/{nodeId}):
+        drop from membership AND the failure detector, so a cleanly
+        departed node neither attracts placements nor gets pinged and
+        counted as failed."""
+        self.remove(node_id)
+        self.failure_detector.unregister(node_id)
 
     def all_nodes(self) -> list[WorkerNode]:
         with self._lock:
@@ -137,13 +152,23 @@ class NodeScheduler:
         same lock, so two fragments scheduling concurrently see each
         other's placements instead of both dog-piling the least-loaded
         node. Callers release via :meth:`release` when the task finishes
-        (or fails to start)."""
+        (or fails to start).
+
+        Among equally-loaded nodes, the failure detector's ping-latency
+        EWMA breaks the tie (slow node last); nodes without latency
+        evidence rank neutral (0.0), preserving the node-id round-robin."""
+        det = getattr(self.node_manager, "failure_detector", None)
+        lat = getattr(det, "latency_ms", None)
         out: list[WorkerNode] = []
         with self._lock:
             for _ in range(count):
                 best = min(
                     nodes,
-                    key=lambda n: (self._assigned.get(n.node_id, 0), n.node_id),
+                    key=lambda n: (
+                        self._assigned.get(n.node_id, 0),
+                        lat(n.node_id) if lat is not None else 0.0,
+                        n.node_id,
+                    ),
                 )
                 self._assigned[best.node_id] = (
                     self._assigned.get(best.node_id, 0) + 1
@@ -254,6 +279,9 @@ class HttpRemoteTask:
         # speculative marks a duplicate (hedge) attempt of a straggler
         self.started_mono: Optional[float] = None
         self.speculative = False
+        # recovery: set on lineage re-executions of a dead producer
+        # (rendered like speculative attempts in the waterfall)
+        self.recovered = False
 
     def _site_target(self) -> str:
         # "cq7.3.0r1" -> "3.0r1": stable across runs, fresh per attempt
@@ -327,6 +355,46 @@ class HttpRemoteTask:
             pass
 
 
+class SpoolHandle:
+    """Stand-in for a dead producer whose completed output now serves
+    from the coordinator's spool store.
+
+    Quacks like :class:`HttpRemoteTask` everywhere the scheduler touches
+    producer tasks: ``.uri`` points at ``/v1/spool/{taskId}`` (whose
+    results route speaks the task-results wire shape, so consumers'
+    ``ExchangeClient`` pulls it unchanged), ``status()`` is always
+    FINISHED, ``cancel()`` is a no-op (spool lifetime belongs to the
+    query, not the attempt). Its node is a dummy the NodeScheduler never
+    reserved — the query-level release is a harmless no-op."""
+
+    def __init__(self, base_uri: str, task_id: str):
+        self.task_id = task_id
+        self.uri = f"{base_uri.rstrip('/')}/v1/spool/{task_id}"
+        self.node = WorkerNode("__spool__", base_uri)
+        self.payload: dict = {}
+        self.last_status = {"state": "FINISHED", "spool": True}
+        self.start_error: Optional[str] = None
+        self.trace = None
+        self.span = None
+        self.attempt = 1
+        self.speculative = False
+        self.recovered = True
+        self._obs_done = True  # the recovery span already closed
+        self.started_mono: Optional[float] = None
+
+    def start(self) -> None:  # pragma: no cover — never dispatched
+        pass
+
+    def status(self, max_wait: float = 0.0) -> dict:
+        return self.last_status
+
+    def cancel(self, speculative: bool = False) -> None:
+        pass
+
+    def elapsed_ms(self) -> float:
+        return 0.0
+
+
 class ClusterScheduler:
     """Schedules a fragmented plan over the worker set and gathers output.
 
@@ -379,7 +447,11 @@ class ClusterScheduler:
         """Returns (Batch, column_names). ``stats_sink`` (dict) receives
         retry/attempt counters plus a per-stage ``stages`` rollup for
         query stats and /v1/query."""
-        from trino_tpu.ft.retry import RetryPolicy, SpeculationConfig
+        from trino_tpu.ft.retry import (
+            RetryPolicy,
+            SpeculationConfig,
+            SpoolConfig,
+        )
 
         tracer = get_tracer()
         with tracer.span("fragment"):
@@ -450,11 +522,54 @@ class ClusterScheduler:
             "spec_budget": spec.budget(sum(task_counts.values())),
             "spec_active": 0,
         }
+        # spooled exchange + lineage recovery (only under TASK retry: both
+        # extend the retained-buffer materialized exchange). ``store`` is
+        # the coordinator-hosted spool; ``rc`` is the recovery context the
+        # heal paths thread through — which producer ran where, how to
+        # rebuild source URIs, and where the spool lives.
+        spool_cfg = SpoolConfig.from_session(session)
+        spool_base = getattr(self.engine, "spool_base_uri", None)
+        store = None
+        spool_payload = None
+        if spool_cfg.enabled and policy == RetryPolicy.TASK and spool_base:
+            from trino_tpu.exchange.spool import get_spool_store
+
+            store = get_spool_store(
+                self.engine, spool_cfg.spool_dir, spool_cfg.max_bytes
+            )
+            spool_payload = {"uri": spool_base, "queryId": query_id}
+        rc = None
+        if policy == RetryPolicy.TASK:
+            stats.setdefault("recovered_tasks", 0)
+            stats.setdefault("recovered_levels", {})
+        if policy == RetryPolicy.TASK and spool_cfg.enabled:
+            # dead-producer recovery (spool re-point / lineage
+            # re-execution) is part of the opt-in spooled-exchange mode:
+            # without it, a retry keeps the plain PR-6 semantics — no
+            # liveness probes of upstream producers on the retry path
+            rc = {
+                "query_id": query_id,
+                "fragments": fragments,
+                "remote_tasks": remote_tasks,
+                "session": session,
+                "http": http,
+                "stats": stats,
+                "store": store,
+                "base_uri": spool_base,
+                "lineage_seq": itertools.count(1),
+                "obs": obs,
+            }
         ok = False
         try:
             for frag in order:
                 if frag.id == sub.fragment.id:
                     continue
+                if rc is not None:
+                    # lineage heal: a producer whose node left the cluster
+                    # since its barrier is recovered (spool re-point or
+                    # re-execution) BEFORE this consumer's source URIs are
+                    # baked into its payloads
+                    self._heal_sources(frag, rc)
                 obs["stage_start"][frag.id] = time.monotonic()
                 stage_span = tracer.start_span(
                     "stage",
@@ -473,6 +588,7 @@ class ClusterScheduler:
                     policy=policy,
                     http=http,
                     stage_span=stage_span,
+                    spool=spool_payload,
                 )
                 if policy == RetryPolicy.TASK:
                     # stage barrier: producers must FINISH (with retained
@@ -482,7 +598,7 @@ class ClusterScheduler:
                     self._await_fragment(
                         query_id, frag, remote_tasks[frag.id],
                         session, stats, http,
-                        stage_span=stage_span, obs=obs,
+                        stage_span=stage_span, obs=obs, rc=rc,
                     )
             obs["stage_start"][sub.fragment.id] = time.monotonic()
             root_span = tracer.start_span(
@@ -496,7 +612,8 @@ class ClusterScheduler:
             obs["stage_spans"][sub.fragment.id] = root_span
             with tracer.activate(root_span):
                 result = self._execute_root(
-                    sub.fragment, session, remote_tasks, task_counts, policy
+                    sub.fragment, session, remote_tasks, task_counts, policy,
+                    rc=rc,
                 )
             ok = True
             if policy == RetryPolicy.TASK:
@@ -511,6 +628,11 @@ class ClusterScheduler:
                     t.cancel()
             raise
         finally:
+            if store is not None:
+                # the query is done either way: record what got spooled,
+                # then free the spool (results already left the cluster)
+                stats["spooled_bytes"] = store.query_bytes(query_id)
+                store.delete_query(query_id)
             # close attempt/stage spans, fire stage/task events, and build
             # stats["stages"] BEFORE releasing nodes — the caller reads
             # ``stats`` right after execute() returns
@@ -572,6 +694,7 @@ class ClusterScheduler:
         policy: str = "NONE",
         http: Optional[dict] = None,
         stage_span=None,
+        spool: Optional[dict] = None,
     ) -> list[HttpRemoteTask]:
         from trino_tpu.ft.retry import RetryPolicy, is_retryable
         from trino_tpu.planner.serde import fragment_to_json
@@ -612,7 +735,13 @@ class ClusterScheduler:
                         )
         frag_json = fragment_to_json(frag)
         tasks: list[HttpRemoteTask] = []
-        placements = self.node_scheduler.select(nodes, n_tasks)
+        # membership can shrink between execute()'s snapshot and this
+        # fragment's turn (node died or drained during an earlier stage
+        # barrier): place on the currently-live subset when one exists.
+        # task_counts stay as planned — fewer nodes just take more tasks.
+        live = {x.node_id for x in self.node_manager.active_nodes()}
+        candidates = [x for x in nodes if x.node_id in live] or nodes
+        placements = self.node_scheduler.select(candidates, n_tasks)
         try:
             for p in range(n_tasks):
                 payload = {
@@ -627,6 +756,10 @@ class ClusterScheduler:
                     # a retried consumer attempt can re-pull them
                     "retain_output": policy == RetryPolicy.TASK,
                 }
+                if spool is not None:
+                    # async durable copy: the worker spools finished pages
+                    # to the coordinator so output survives its death
+                    payload["spool"] = spool
                 task = HttpRemoteTask(
                     placements[p], f"{query_id}.{frag.id}.{p}", payload, **http
                 )
@@ -671,17 +804,43 @@ class ClusterScheduler:
 
     # --- stage barrier + task retry (retry_policy=TASK) -------------------
 
+    def _prune_slowest(self, candidates: list[WorkerNode]) -> list[WorkerNode]:
+        """Drop the slowest healthy node from hedge/retry placement when
+        its ping-latency EWMA is far off the fastest's (over both 2x the
+        fastest AND fastest + 25ms — absolute floor so sub-millisecond
+        jitter on a quiet loopback cluster never triggers it). Hedges and
+        recovery re-dispatches exist to dodge slowness; landing them on
+        the known-slowest node defeats the point."""
+        if len(candidates) < 2:
+            return candidates
+        det = getattr(self.node_manager, "failure_detector", None)
+        lat_fn = getattr(det, "latency_ms", None)
+        if lat_fn is None:
+            return candidates
+        lats = {n.node_id: lat_fn(n.node_id) for n in candidates}
+        known = [v for v in lats.values() if v > 0.0]
+        if len(known) < 2:
+            return candidates
+        fastest, slowest = min(known), max(known)
+        if slowest > max(2.0 * fastest, fastest + 25.0):
+            keep = [n for n in candidates if lats[n.node_id] < slowest]
+            if keep:
+                return keep
+        return candidates
+
     def _retry_node(self, exclude: str) -> WorkerNode:
         """Placement for a re-dispatched attempt: prefer a *different*
-        worker with positive health evidence from the failure detector;
-        fall back to any active node (single-worker clusters retry in
-        place rather than fail). ``select()`` reserves the slot."""
+        worker with positive health evidence from the failure detector
+        (avoiding the slowest of them); fall back to any active node
+        (single-worker clusters retry in place rather than fail).
+        ``select()`` reserves the slot."""
         active = self.node_manager.active_nodes()
         healthy = set(self.node_manager.failure_detector.active_nodes())
         candidates = [
             n for n in active
             if n.node_id != exclude and (not healthy or n.node_id in healthy)
         ]
+        candidates = self._prune_slowest(candidates)
         if not candidates:
             candidates = [n for n in active if n.node_id != exclude] or active
         if not candidates:
@@ -689,21 +848,229 @@ class ClusterScheduler:
         return self.node_scheduler.select(candidates, 1)[0]
 
     def _speculation_node(self, exclude: str) -> Optional[WorkerNode]:
-        """Placement for a hedged attempt: a *different* healthy node, or
-        None (unlike retries, a hedge on the straggler's own node is
-        pointless — skip hedging instead). ``select()`` reserves the
-        slot; the caller must release on every hedge outcome."""
+        """Placement for a hedged attempt: a *different* healthy node
+        (never the slowest of them), or None (unlike retries, a hedge on
+        the straggler's own node is pointless — skip hedging instead).
+        ``select()`` reserves the slot; the caller must release on every
+        hedge outcome."""
         active = self.node_manager.active_nodes()
         healthy = set(self.node_manager.failure_detector.active_nodes())
         candidates = [
             n for n in active
             if n.node_id != exclude and (not healthy or n.node_id in healthy)
         ]
+        candidates = self._prune_slowest(candidates)
         if not candidates:
             candidates = [n for n in active if n.node_id != exclude]
         if not candidates:
             return None
         return self.node_scheduler.select(candidates, 1)[0]
+
+    # --- lineage recovery (spooled exchange, worker death) -----------------
+
+    def _producer_alive(self, t, probe: bool) -> bool:
+        """Is this finished producer's retained output still reachable?
+        Membership first (cheap); with ``probe`` also one live status GET
+        — membership lags a fresh SIGKILL by several detector cycles, but
+        the dead socket refuses instantly."""
+        if isinstance(t, SpoolHandle):
+            return True  # already durable on the coordinator
+        active = {n.node_id for n in self.node_manager.active_nodes()}
+        if t.node.node_id not in active:
+            return False
+        if not probe:
+            return True
+        try:
+            st = t.status(max_wait=0.0)
+        except Exception:  # noqa: BLE001 — unreachable == lost output
+            return False
+        return st.get("state") == "FINISHED"
+
+    def _heal_sources(self, frag, rc, probe: bool = False) -> bool:
+        """Recover every dead producer feeding ``frag``: spool re-point
+        when the task's output spooled completely (level=task), else
+        re-execute just that producer — recursively healing ITS sources
+        first (level=lineage). Returns whether anything was recovered
+        (callers then rebuild consumer source URIs from remote_tasks)."""
+        if rc is None:
+            return False
+        healed = False
+        for fid in getattr(frag, "source_fragment_ids", ()) or ():
+            tasks = rc["remote_tasks"].get(fid)
+            if not tasks:
+                continue
+            for idx in range(len(tasks)):
+                if self._producer_alive(tasks[idx], probe):
+                    continue
+                self._recover_task(fid, idx, rc, probe=probe)
+                healed = True
+        return healed
+
+    def _recover_task(self, fid: int, idx: int, rc: dict,
+                      probe: bool = False) -> None:
+        """Recover one lost producer task. Tier 1 (level=task): its spool
+        is complete — swap a :class:`SpoolHandle` into remote_tasks so
+        consumers read the durable copy; no re-execution at all. Tier 2
+        (level=lineage): re-run only this producer on a healthy node,
+        healing its own sources first."""
+        tasks = rc["remote_tasks"][fid]
+        old = tasks[idx]
+        store = rc.get("store")
+        stats = rc["stats"]
+        stage_span = (rc.get("obs") or {}).get("stage_spans", {}).get(fid)
+        if (
+            store is not None
+            and rc.get("base_uri")
+            and store.is_complete(old.task_id)
+        ):
+            handle = SpoolHandle(rc["base_uri"], old.task_id)
+            handle.payload = old.payload
+            handle.attempt = getattr(old, "attempt", 1)
+            tasks[idx] = handle
+            self.node_scheduler.release(old.node)
+            get_registry().counter(
+                "trino_tpu_recovered_tasks_total", level="task"
+            ).inc()
+            stats["recovered_tasks"] = stats.get("recovered_tasks", 0) + 1
+            levels = stats.setdefault("recovered_levels", {})
+            levels["task"] = levels.get("task", 0) + 1
+            # synthetic zero-length attempt span: the waterfall shows the
+            # recovery point without pretending work re-ran
+            span = get_tracer().start_span(
+                "task_attempt",
+                trace_id=getattr(stage_span, "trace_id", None),
+                parent_id=getattr(stage_span, "span_id", None),
+                attrs={
+                    "taskId": old.task_id,
+                    "stage": fid,
+                    "worker": "__spool__",
+                    "attempt": handle.attempt,
+                    "recovered": True,
+                    "spool": True,
+                },
+            )
+            span.finish(status="OK", state="FINISHED")
+            return
+        frag = rc["fragments"].get(fid)
+        if frag is not None:
+            # the producer's own inputs may have died with the same node:
+            # heal them first so the re-execution pulls live sources
+            self._heal_sources(frag, rc, probe=probe)
+        self._run_recovery_task(fid, idx, rc)
+
+    def _run_recovery_task(self, fid: int, idx: int, rc: dict,
+                           max_attempts: int = 3) -> None:
+        """Re-execute one lost producer task to completion (lineage tier).
+        Runs synchronously — recovery sits on a consumer's critical path
+        anyway. Task ids take an ``l{k}`` suffix (fresh injection sites,
+        distinct from ``r``etries and ``s``peculation)."""
+        from trino_tpu.ft.retry import (
+            TaskFailure,
+            TaskRetriesExhausted,
+            is_retryable,
+        )
+
+        tasks = rc["remote_tasks"][fid]
+        frag = rc["fragments"].get(fid)
+        session = rc["session"]
+        stats = rc["stats"]
+        try:
+            budget_s = float(session.get("exchange_timeout_s"))
+        except KeyError:
+            budget_s = 300.0
+        stage_span = (rc.get("obs") or {}).get("stage_spans", {}).get(fid)
+        exclude = tasks[idx].node.node_id
+        last_error: Optional[str] = None
+        for _ in range(max_attempts):
+            old = tasks[idx]
+            k = next(rc["lineage_seq"])
+            node = self._retry_node(exclude=exclude)
+            new_id = f"{rc['query_id']}.{fid}.{idx}l{k}"
+            payload = dict(old.payload)
+            if frag is not None:
+                # sources rebuilt NOW: they may point at spool handles or
+                # other just-recovered attempts
+                payload["sources"] = self._sources_payload(
+                    frag, idx, rc["remote_tasks"], rc["fragments"]
+                )
+            task = HttpRemoteTask(node, new_id, payload, **rc["http"])
+            task.attempt = getattr(old, "attempt", 1) + 1
+            task.recovered = True
+            att = get_tracer().start_span(
+                "task_attempt",
+                trace_id=getattr(stage_span, "trace_id", None),
+                parent_id=getattr(stage_span, "span_id", None),
+                attrs={
+                    "taskId": new_id,
+                    "stage": fid,
+                    "worker": node.node_id,
+                    "attempt": task.attempt,
+                    "recovered": True,
+                    "lineage": True,
+                },
+            )
+            task.span = att
+            task.trace = att.context()
+            # swap in before start(): query-level cleanup releases whatever
+            # sits in remote_tasks; the dead attempt's slot frees here
+            tasks[idx] = task
+            self.node_scheduler.release(old.node)
+            deadline = time.monotonic() + budget_s
+            failed_st: Optional[dict] = None
+            try:
+                task.start()
+                while True:
+                    st = task.status(max_wait=1.0)
+                    state = st.get("state")
+                    if state == "FINISHED":
+                        self._finish_attempt(
+                            rc["query_id"], fid, task, st, rc.get("obs")
+                        )
+                        get_registry().counter(
+                            "trino_tpu_recovered_tasks_total", level="lineage"
+                        ).inc()
+                        stats["recovered_tasks"] = (
+                            stats.get("recovered_tasks", 0) + 1
+                        )
+                        levels = stats.setdefault("recovered_levels", {})
+                        levels["lineage"] = levels.get("lineage", 0) + 1
+                        return
+                    if state == "FAILED":
+                        r = st.get("retryable")
+                        if r is not None and not bool(r):
+                            self._finish_attempt(
+                                rc["query_id"], fid, task, st, rc.get("obs")
+                            )
+                            raise TaskFailure(
+                                new_id, node.node_id, st.get("error"),
+                                retryable=False,
+                            )
+                        failed_st, last_error = st, st.get("error")
+                        break
+                    if time.monotonic() > deadline:
+                        last_error = (
+                            f"lineage recovery exceeded {budget_s}s budget"
+                        )
+                        failed_st = {"state": "FAILED", "error": last_error}
+                        break
+            except TaskFailure:
+                raise
+            except Exception as e:  # noqa: BLE001
+                if not is_retryable(e):
+                    raise
+                last_error = str(e)
+                failed_st = {"state": "FAILED", "error": last_error}
+            task.cancel()
+            self._finish_attempt(
+                rc["query_id"], fid, task, failed_st, rc.get("obs")
+            )
+            exclude = node.node_id
+        raise TaskRetriesExhausted(
+            f"{rc['query_id']}.{fid}.{idx}",
+            exclude,
+            f"lineage recovery failed: {last_error}",
+            max_attempts,
+        )
 
     def _await_fragment(
         self,
@@ -715,6 +1082,7 @@ class ClusterScheduler:
         http: dict,
         stage_span=None,
         obs: Optional[dict] = None,
+        rc: Optional[dict] = None,
     ) -> None:
         """Block until every task of ``frag`` is FINISHED, re-dispatching
         failed attempts (``{qid}.{frag}.{p}`` -> ``...{p}r{k}``) to other
@@ -778,11 +1146,73 @@ class ClusterScheduler:
                 obs["spec_active"] = max(0, obs.get("spec_active", 1) - 1)
             _spec_counter(outcome)
 
+        def _dispatch_hedge(i: int, t: HttpRemoteTask, node: WorkerNode,
+                            extra_attrs: dict) -> None:
+            """Launch one hedge of ``tasks[i]`` on ``node`` (whose slot
+            ``_speculation_node`` already reserved) and register it in
+            ``hedges``. Shared by the straggler detector and the
+            queued-task hedging path."""
+            hedge_id = f"{query_id}.{frag.id}.{i}s{attempts[i]}"
+            hedge = HttpRemoteTask(node, hedge_id, t.payload, **http)
+            hedge.attempt = attempts[i]
+            hedge.speculative = True
+            att = get_tracer().start_span(
+                "task_attempt",
+                trace_id=getattr(stage_span, "trace_id", None),
+                parent_id=getattr(stage_span, "span_id", None),
+                attrs={
+                    "taskId": hedge_id,
+                    "stage": frag.id,
+                    "worker": node.node_id,
+                    "attempt": attempts[i],
+                    "speculative": True,
+                    "hedgeOf": t.task_id,
+                    **extra_attrs,
+                },
+            )
+            hedge.span = att
+            hedge.trace = att.context()
+            stats["speculative_attempts"] = (
+                stats.get("speculative_attempts", 0) + 1
+            )
+            obs["spec_active"] = obs.get("spec_active", 0) + 1
+            hedges[i] = hedge
+            try:
+                hedge.start()
+            except Exception as e:  # noqa: BLE001
+                if not is_retryable(e):
+                    raise
+                hedge.start_error = str(e)
+
         try:
             while pending:
                 for i in sorted(pending):
                     t = tasks[i]
                     if t.start_error is not None:
+                        # QUEUED-but-undispatched hedging: an attempt whose
+                        # POST never landed is hedged immediately on a
+                        # different healthy node (no straggler threshold —
+                        # there is nothing running to outwait). The queued
+                        # twin is cancelled when the hedge promotes, one
+                        # poll round later.
+                        if (
+                            i not in hedges
+                            and spec is not None
+                            and spec.enabled
+                            and obs is not None
+                            and obs.get("spec_active", 0)
+                            < obs.get("spec_budget", 0)
+                            # promotion bumps attempts[i]; the cap keeps a
+                            # cluster-wide dispatch outage from ping-ponging
+                            # hedge->promote->hedge forever
+                            and attempts[i] < max_attempts
+                        ):
+                            node = self._speculation_node(
+                                exclude=t.node.node_id
+                            )
+                            if node is not None:
+                                _dispatch_hedge(i, t, node, {"queued": True})
+                                continue
                         failure, retryable = t.start_error, True
                         fail_st = {"state": "FAILED", "error": failure}
                     elif time.monotonic() > deadlines[i]:
@@ -860,6 +1290,19 @@ class ClusterScheduler:
                     t.cancel()
                     self.node_scheduler.release(t.node)
                     time.sleep(backoff.delay(attempts[i]))
+                    payload = t.payload
+                    if rc is not None:
+                        # the failure may be the symptom of a dead
+                        # producer: probe this fragment's sources, recover
+                        # lost ones (spool re-point / lineage
+                        # re-execution), and rebuild the source URIs the
+                        # retry will pull — remote_tasks may now hold
+                        # spool handles or recovered attempts
+                        self._heal_sources(frag, rc, probe=True)
+                        payload = dict(t.payload)
+                        payload["sources"] = self._sources_payload(
+                            frag, i, rc["remote_tasks"], rc["fragments"]
+                        )
                     node = self._retry_node(exclude=t.node.node_id)
                     attempts[i] += 1
                     base = f"{query_id}.{frag.id}.{i}"
@@ -867,7 +1310,7 @@ class ClusterScheduler:
                     stats["task_retries"] = stats.get("task_retries", 0) + 1
                     stats.setdefault("task_attempts", {})[base] = attempts[i]
                     reg.counter("trino_tpu_task_retries_total").inc()
-                    retry = HttpRemoteTask(node, new_id, t.payload, **http)
+                    retry = HttpRemoteTask(node, new_id, payload, **http)
                     retry.attempt = attempts[i]
                     att = get_tracer().start_span(
                         "task_attempt",
@@ -967,41 +1410,10 @@ class ClusterScheduler:
                             )
                             if node is None:
                                 continue  # no distinct healthy node
-                            hedge_id = (
-                                f"{query_id}.{frag.id}.{i}s{attempts[i]}"
+                            _dispatch_hedge(
+                                i, t, node,
+                                {"thresholdMs": round(threshold, 1)},
                             )
-                            hedge = HttpRemoteTask(
-                                node, hedge_id, t.payload, **http
-                            )
-                            hedge.attempt = attempts[i]
-                            hedge.speculative = True
-                            att = get_tracer().start_span(
-                                "task_attempt",
-                                trace_id=getattr(stage_span, "trace_id", None),
-                                parent_id=getattr(stage_span, "span_id", None),
-                                attrs={
-                                    "taskId": hedge_id,
-                                    "stage": frag.id,
-                                    "worker": node.node_id,
-                                    "attempt": attempts[i],
-                                    "speculative": True,
-                                    "hedgeOf": t.task_id,
-                                    "thresholdMs": round(threshold, 1),
-                                },
-                            )
-                            hedge.span = att
-                            hedge.trace = att.context()
-                            stats["speculative_attempts"] = (
-                                stats.get("speculative_attempts", 0) + 1
-                            )
-                            obs["spec_active"] = obs.get("spec_active", 0) + 1
-                            hedges[i] = hedge
-                            try:
-                                hedge.start()
-                            except Exception as e:  # noqa: BLE001
-                                if not is_retryable(e):
-                                    raise
-                                hedge.start_error = str(e)
                             if obs["spec_active"] >= obs["spec_budget"]:
                                 break
         finally:
@@ -1047,6 +1459,8 @@ class ClusterScheduler:
             attrs = {"state": state, "elapsedMs": elapsed_ms}
             if t.speculative:
                 attrs["speculative"] = True
+            if getattr(t, "recovered", False):
+                attrs["recovered"] = True
             if st.get("error"):
                 attrs["error"] = st.get("error")
             # a speculatively-cancelled loser is not an error: a sibling
@@ -1251,44 +1665,56 @@ class ClusterScheduler:
         remote_tasks: dict[int, list[HttpRemoteTask]],
         task_counts: dict[int, int],
         policy: str = "NONE",
+        rc: Optional[dict] = None,
     ):
         from trino_tpu.ft.retry import RetryPolicy, TaskFailure
         from trino_tpu.server.task import WorkerExecutor
 
-        sources = {
-            fid: {"locations": [t.uri for t in tasks], "partition": 0}
-            for fid, tasks in remote_tasks.items()
-            if fid in frag.source_fragment_ids
-        }
-        local_session = Session(
-            user=session.user, catalog=session.catalog, schema=session.schema
-        )
-        for k, v in session.properties.items():
-            if k != "execution_mode":
-                local_session.properties[k] = v
-        executor = WorkerExecutor(self.engine.catalogs, local_session, {}, sources)
         root = frag.root
-        try:
-            if isinstance(root, P.Output):
-                batch, names = executor.execute(root)
-            else:
-                res = executor._exec(root)
-                batch = res.batch.compact()
-                names = [s.name for s in root.output_symbols]
-        except Exception as e:  # noqa: BLE001
-            # the coordinator-side symptom (empty exchange, timeout) is
-            # usually downstream of a worker task failure — surface the
-            # root cause with the worker's retryable classification
-            failed = self._first_failed_status(remote_tasks)
-            if failed is not None:
-                t, st = failed
-                raise TaskFailure(
-                    st.get("taskId") or t.task_id,
-                    t.node.node_id,
-                    st.get("error"),
-                    retryable=bool(st.get("retryable", True)),
-                ) from e
-            raise
+        # A producer can die between the stage barrier and the root pull.
+        # With spooling (rc set) heal the lost producers and re-pull; the
+        # sources dict is rebuilt each attempt so SpoolHandle / lineage
+        # re-execution URIs are picked up automatically.
+        attempts = 3 if rc is not None else 1
+        batch = names = None
+        for attempt in range(attempts):
+            sources = {
+                fid: {"locations": [t.uri for t in tasks], "partition": 0}
+                for fid, tasks in remote_tasks.items()
+                if fid in frag.source_fragment_ids
+            }
+            local_session = Session(
+                user=session.user, catalog=session.catalog, schema=session.schema
+            )
+            for k, v in session.properties.items():
+                if k != "execution_mode":
+                    local_session.properties[k] = v
+            executor = WorkerExecutor(self.engine.catalogs, local_session, {}, sources)
+            try:
+                if isinstance(root, P.Output):
+                    batch, names = executor.execute(root)
+                else:
+                    res = executor._exec(root)
+                    batch = res.batch.compact()
+                    names = [s.name for s in root.output_symbols]
+                break
+            except Exception as e:  # noqa: BLE001
+                if rc is not None and attempt < attempts - 1:
+                    if self._heal_sources(frag, rc, probe=True):
+                        continue
+                # the coordinator-side symptom (empty exchange, timeout) is
+                # usually downstream of a worker task failure — surface the
+                # root cause with the worker's retryable classification
+                failed = self._first_failed_status(remote_tasks)
+                if failed is not None:
+                    t, st = failed
+                    raise TaskFailure(
+                        st.get("taskId") or t.task_id,
+                        t.node.node_id,
+                        st.get("error"),
+                        retryable=bool(st.get("retryable", True)),
+                    ) from e
+                raise
         # surface any worker failure even if results looked complete; the
         # TASK stage barrier already verified every producer FINISHED
         if policy != RetryPolicy.TASK:

@@ -30,6 +30,10 @@ class NodeState:
     last_update: float = 0.0
     last_seen: Optional[float] = None
     consecutive_failures: int = 0
+    # EWMA of successful-ping round-trip latency; None until the first
+    # success. Task placement uses it to steer hedges and recovery
+    # re-dispatches away from the slowest healthy node.
+    latency_ewma_ms: Optional[float] = None
 
     @property
     def known(self) -> bool:
@@ -38,7 +42,8 @@ class NodeState:
         reported as healthy on the strength of its initial 0.0 ratio."""
         return self.last_update > 0.0
 
-    def record(self, success: bool, now: float) -> None:
+    def record(self, success: bool, now: float,
+               latency_ms: Optional[float] = None) -> None:
         # exponential decay toward the new observation
         # (HeartbeatFailureDetector.Stats.DecayCounter)
         if self.last_update:
@@ -52,6 +57,12 @@ class NodeState:
         if success:
             self.last_seen = now
             self.consecutive_failures = 0
+            if latency_ms is not None:
+                self.latency_ewma_ms = (
+                    latency_ms
+                    if self.latency_ewma_ms is None
+                    else 0.75 * self.latency_ewma_ms + 0.25 * latency_ms
+                )
         else:
             self.consecutive_failures += 1
 
@@ -108,11 +119,14 @@ class HeartbeatFailureDetector:
             nodes = list(self._nodes.values())
         now = time.time()
         for n in nodes:
+            t0 = time.monotonic()
             try:
                 ok = bool(self.ping_fn(n.uri))
             except Exception:  # noqa: BLE001 — any ping error is a failure
                 ok = False
-            n.record(ok, now)
+            n.record(
+                ok, now, latency_ms=(time.monotonic() - t0) * 1000.0
+            )
 
     def is_failed(self, node_id: str) -> bool:
         """Positive evidence of failure. A never-pinged node is NOT
@@ -123,6 +137,15 @@ class HeartbeatFailureDetector:
         if n is None:
             return True
         return n.known and n.failure_ratio > self.threshold
+
+    def latency_ms(self, node_id: str) -> float:
+        """Ping-latency EWMA for placement ranking; 0.0 when unknown (a
+        fresh node ranks neutral, preserving round-robin tie-breaks)."""
+        with self._lock:
+            n = self._nodes.get(node_id)
+        if n is None or n.latency_ewma_ms is None:
+            return 0.0
+        return n.latency_ewma_ms
 
     def active_nodes(self) -> list[str]:
         """Nodes with positive evidence of health: pinged at least once
@@ -147,6 +170,11 @@ class HeartbeatFailureDetector:
                 "known": n.known,
                 "failed": n.known and n.failure_ratio > self.threshold,
                 "lastSeen": n.last_seen,
+                "latencyEwmaMs": (
+                    round(n.latency_ewma_ms, 3)
+                    if n.latency_ewma_ms is not None
+                    else None
+                ),
             }
             for n in nodes
         ]

@@ -117,6 +117,10 @@ class TrinoTpuServer:
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
+        if role == "coordinator":
+            # where workers spool finished output buffers (the scheduler
+            # passes this to tasks as payload["spool"]["uri"])
+            self.engine.spool_base_uri = self.base_uri
         self._thread: Optional[threading.Thread] = None
         # live node info for system.runtime.nodes
         self.engine._runtime_nodes_fn = lambda: [
@@ -185,18 +189,59 @@ class TrinoTpuServer:
         get_tracer().remove_sink(self.span_sink)
 
     def graceful_shutdown(self) -> None:
-        """Drain: refuse new queries, wait for active ones, then stop
-        (GracefulShutdownHandler.java:142)."""
+        """Drain, then stop (GracefulShutdownHandler.java:142).
+
+        Coordinator: refuse new queries, wait for active ones.
+        Worker decommission: refuse new tasks (task POST 503s while not
+        ACTIVE), finish running tasks, force-publish every retained
+        buffer's spool manifest so consumers can re-read the output after
+        this process is gone, deregister from the coordinator, and exit —
+        the rolling-restart path with zero query failures."""
         self.state = "SHUTTING_DOWN"
-
-        def drain():
-            while any(
-                not q.state.is_terminal() for q in self.query_manager.queries()
-            ):
-                time.sleep(0.05)
-            self.stop()
-
+        drain = self._drain_worker if self.role == "worker" else self._drain
         threading.Thread(target=drain, daemon=True).start()
+
+    def _drain(self) -> None:
+        while any(
+            not q.state.is_terminal() for q in self.query_manager.queries()
+        ):
+            time.sleep(0.05)
+        self.stop()
+
+    def _drain_worker(self, timeout: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline and any(
+            t.state == "RUNNING" for t in self.task_manager.tasks()
+        ):
+            time.sleep(0.05)
+        # force-spool retained buffers: a consumer stage that has not yet
+        # pulled this worker's output reads it from the coordinator's
+        # spool once we are gone (finish() is idempotent — tasks that
+        # already published on FINISHED return their cached result)
+        for t in self.task_manager.tasks():
+            writer = getattr(t.buffer, "spool_writer", None)
+            if writer is not None and t.state == "FINISHED":
+                try:
+                    writer.finish(timeout=30.0)
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+        if self.discovery_uri and not self.discovery_uri.startswith("@"):
+            import urllib.request as _rq
+
+            from trino_tpu.server import auth
+
+            try:
+                req = _rq.Request(
+                    f"{self.discovery_uri}/v1/announce/{self.node_id}",
+                    method="DELETE",
+                    headers=auth.headers(),
+                )
+                _rq.urlopen(req, timeout=10)
+            except Exception:  # noqa: BLE001 — coordinator may be gone too
+                pass
+        # grace: let in-flight result GETs finish before the socket closes
+        time.sleep(0.5)
+        self.stop()
 
     @property
     def base_uri(self) -> str:
@@ -379,6 +424,11 @@ def _make_handler(server: TrinoTpuServer):
             parts = [p for p in path.split("/") if p]
             if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                 # TaskResource.createOrUpdateTask (reference :127)
+                if server.state != "ACTIVE":
+                    # draining worker: refuse admission; the coordinator
+                    # classifies the 503 retryable and re-dispatches the
+                    # attempt to another node
+                    return self._error(503, "worker is shutting down")
                 from trino_tpu.obs.trace import TRACE_HEADER, parse_trace_header
 
                 length = int(self.headers.get("Content-Length", 0))
@@ -421,6 +471,23 @@ def _make_handler(server: TrinoTpuServer):
                 length = int(self.headers.get("Content-Length", 0))
                 payload = json.loads(self.rfile.read(length).decode())
                 return self._send_json(server.spmd.execute_remote(payload))
+            if len(parts) == 3 and parts[:2] == ["v1", "spool"]:
+                # spooled exchange: a worker POSTs one finished-output page
+                # (raw bytes; idempotent per (task, partition, seq))
+                from trino_tpu.exchange.spool import get_spool_store
+
+                q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+                length = int(self.headers.get("Content-Length", 0))
+                page = self.rfile.read(length)
+                store = get_spool_store(server.engine)
+                accepted = store.put_page(
+                    q.get("query", [""])[0],
+                    parts[2],
+                    int(q.get("partition", ["0"])[0]),
+                    int(q.get("seq", ["0"])[0]),
+                    page,
+                )
+                return self._send_json({"accepted": accepted})
             return self._error(404, f"unknown path: {path}")
 
         def do_GET(self):
@@ -506,6 +573,28 @@ def _make_handler(server: TrinoTpuServer):
                     max_wait = 1.0
                 return self._send_json(
                     task.results(int(parts[4]), int(parts[5]), max_wait=max_wait)
+                )
+            if (
+                len(parts) == 6
+                and parts[:2] == ["v1", "spool"]
+                and parts[3] == "results"
+            ):
+                # GET /v1/spool/{taskId}/results/{partition}/{token} — the
+                # exact task-results wire shape, so ExchangeClient pulls a
+                # spool URI exactly like a live worker's buffer
+                store = getattr(server.engine, "spool_store", None)
+                out = (
+                    store.read(parts[2], int(parts[4]), int(parts[5]))
+                    if store is not None
+                    else None
+                )
+                if out is None:
+                    return self._error(404, "spooled task not found")
+                return self._send_json(out)
+            if path == "/v1/spool":
+                store = getattr(server.engine, "spool_store", None)
+                return self._send_json(
+                    store.stats() if store is not None else {}
                 )
             if path == "/v1/node":
                 if server.node_manager is None:
@@ -623,6 +712,20 @@ def _make_handler(server: TrinoTpuServer):
                 if server.task_manager.cancel(parts[2], speculative=speculative):
                     return self._send_no_content()
                 return self._error(404, "task not found")
+            if len(parts) == 3 and parts[:2] == ["v1", "spool"]:
+                # aborted spool write / cancelled attempt: drop its pages
+                store = getattr(server.engine, "spool_store", None)
+                if store is not None:
+                    store.delete_task(parts[2])
+                return self._send_no_content()
+            if len(parts) == 3 and parts[:2] == ["v1", "announce"]:
+                # worker decommission: deregister from discovery AND the
+                # failure detector (a drained node must not be pinged or
+                # counted failed afterwards)
+                if server.node_manager is None:
+                    return self._error(400, "not a coordinator")
+                server.node_manager.decommission(parts[2])
+                return self._send_no_content()
             return self._error(404, f"unknown path: {path}")
 
         def do_PUT(self):
@@ -655,6 +758,27 @@ def _make_handler(server: TrinoTpuServer):
                     server.graceful_shutdown()
                     return self._send_json({}, 200)
                 return self._error(400, f"unsupported state: {body}")
+            parts = [p for p in path.split("/") if p]
+            if (
+                len(parts) == 4
+                and parts[:2] == ["v1", "spool"]
+                and parts[3] == "complete"
+            ):
+                # spool completion manifest: {queryId, partitions: {p: n}}
+                from trino_tpu.exchange.spool import get_spool_store
+
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length).decode())
+                store = get_spool_store(server.engine)
+                ok = store.complete(
+                    parts[2],
+                    body.get("queryId", ""),
+                    {
+                        int(p): int(n)
+                        for p, n in body.get("partitions", {}).items()
+                    },
+                )
+                return self._send_json({"complete": ok})
             return self._error(404, f"unknown path: {path}")
 
     return Handler

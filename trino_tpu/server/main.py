@@ -90,10 +90,14 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, on_term)
     signal.signal(signal.SIGINT, on_term)
     try:
-        while not stop["flag"]:
+        # a drained worker (PUT /v1/info/state SHUTTING_DOWN) stops its
+        # server itself; the process must then exit so rolling restarts
+        # can respawn it
+        while not stop["flag"] and server.state != "STOPPED":
             time.sleep(0.2)
     finally:
-        server.stop()
+        if server.state != "STOPPED":
+            server.stop()
     return 0
 
 

@@ -265,6 +265,12 @@ class ManagedQuery:
             # stragglers, and how many of them finished first
             "speculativeAttempts": cluster_stats.get("speculative_attempts", 0),
             "speculativeWins": cluster_stats.get("speculative_wins", 0),
+            # spooled-exchange recovery (trino_tpu/exchange/spool.py):
+            # tasks healed after producer death, by tier (task = spool
+            # re-point, lineage = producer re-execution)
+            "recoveredTasks": cluster_stats.get("recovered_tasks", 0),
+            "recoveredTaskLevels": cluster_stats.get("recovered_levels", {}),
+            "spooledBytes": cluster_stats.get("spooled_bytes", 0),
             # per-stage rollup (obs): elapsed + sibling task elapsed
             # p50/p99 — the speculative-execution straggler signal
             "queryStats": self._query_stats(elapsed, cluster_stats),
@@ -296,6 +302,8 @@ class ManagedQuery:
             ),
             "speculativeAttempts": cluster_stats.get("speculative_attempts", 0),
             "speculativeWins": cluster_stats.get("speculative_wins", 0),
+            "recoveredTasks": cluster_stats.get("recovered_tasks", 0),
+            "spooledBytes": cluster_stats.get("spooled_bytes", 0),
             "stages": cluster_stats.get("stages", []),
         }
 

@@ -119,6 +119,9 @@ class OutputBuffer:
         self._complete = False
         self._aborted = False
         self.dropped_unacked = False  # abort() discarded undelivered pages
+        # spooled exchange (exchange/spool.py): mirrors every enqueued
+        # page to the coordinator's spool store off the critical path
+        self.spool_writer = None
         self._lock = threading.Condition()
 
     def enqueue(self, partition: int, page: bytes) -> None:
@@ -136,6 +139,8 @@ class OutputBuffer:
             self._pages[partition].append(page)
             self._buffered += len(page)
             self._lock.notify_all()
+        if self.spool_writer is not None:
+            self.spool_writer.offer(partition, page)
 
     def set_complete(self) -> None:
         with self._lock:
@@ -143,7 +148,10 @@ class OutputBuffer:
             self._lock.notify_all()
 
     def abort(self) -> None:
-        """Unblock producers and drop buffered pages (task cancel/fail)."""
+        """Unblock producers and drop buffered pages (task cancel/fail).
+        Also aborts any in-flight spool write — DELETE /v1/task and
+        speculative cancels must not leave half-spooled (or now-stale)
+        pages in the coordinator's store."""
         with self._lock:
             self._aborted = True
             self._complete = True
@@ -154,6 +162,8 @@ class OutputBuffer:
             self._pages = [[] for _ in range(self.n)]
             self._buffered = 0
             self._lock.notify_all()
+        if self.spool_writer is not None:
+            self.spool_writer.abort()
 
     def get(self, partition: int, token: int, max_wait: float = 1.0):
         """Pages from `token` on; blocks up to max_wait for more data.
@@ -748,6 +758,16 @@ class SqlTask:
             max_buffered_bytes=buffer_bytes,
             retain=bool(payload.get("retain_output")),
         )
+        # spooled exchange: the coordinator asks (payload["spool"]) for an
+        # async durable copy of this task's retained output, so a consumer
+        # can re-read it after this worker dies
+        spool = payload.get("spool")
+        if spool and self.buffer.retain:
+            from trino_tpu.exchange.spool import SpoolWriter
+
+            self.buffer.spool_writer = SpoolWriter(
+                spool["uri"], task_id, spool.get("queryId", self.query_id)
+            )
         from trino_tpu.ft.injection import FaultInjector
 
         self.injector = FaultInjector.from_session(self.session)
@@ -900,6 +920,16 @@ class SqlTask:
             if self.injector is not None and self.injector.total_injected:
                 self.stats["faults_injected"] = self.injector.total_injected
             self.buffer.set_complete()
+            writer = self.buffer.spool_writer
+            if writer is not None:
+                if self.state == TaskState.FINISHED:
+                    # publish the completion manifest before the task is
+                    # observable as durable; a failure here just leaves the
+                    # spool incomplete (lineage recovery covers the gap)
+                    if writer.finish():
+                        self.stats["spooled_bytes"] = writer.spooled_bytes
+                else:
+                    writer.abort()
             if self._reserved:
                 self.engine.memory_pool.free(self.query_id, self._reserved)
             # one-shot handoff (atomic pop): tests drive _run() directly on
@@ -908,6 +938,16 @@ class SqlTask:
             entry = self.__dict__.pop("_frag_entry", None)
             if entry is not None:
                 entry["lock"].release()
+            if self.injector is not None:
+                # worker-death fault LAST: by now the terminal state is
+                # set, the buffer is complete, and (on FINISHED) the spool
+                # manifest published — the deterministic death models a
+                # node crashing right after its task output became durable
+                from trino_tpu.ft.injection import task_site
+
+                self.injector.maybe_exit_worker(
+                    task_site(self.task_id), self.node_id
+                )
 
     def _try_fused(self, prefetched, strict: bool = False) -> Optional[Result]:
         """Fragment as one compiled program on worker-local devices; None

@@ -44,6 +44,7 @@ PAGE = """<!doctype html>
   .tlbar.err { background: #f7768e; }
   .tlbar.spec { background: #e0af68; }
   .tlbar.spec.cancelled { background: #565f89; }
+  .tlbar.rec { background: #73daca; }
   .tlms { width: 6rem; font-size: .72rem; color: #9aa0b0;
           text-align: right; }
   table.stages { width: auto; margin: .4rem 0 .6rem .6rem; }
@@ -74,6 +75,7 @@ function bar(span, t0, total, cls) {
   // speculative attempts render distinctly: amber for the hedge,
   // muted for whichever attempt lost the race and was cancelled
   if (a.speculative) c += ' spec';
+  if (a.recovered) c += ' rec';
   if (a.state === 'CANCELED_SPECULATIVE') c += ' spec cancelled';
   if (span.status === 'ERROR') c += ' err';
   return `<div class="tlbar ${c}" style="left:${Math.max(0, left).toFixed(2)}%;` +
@@ -98,6 +100,7 @@ function renderTimeline(tl) {
     if (s.name === 'task_attempt') return `  ${a.taskId}` +
         (a.retry ? ' (retry)' : '') +
         (a.speculative ? ' (speculative)' : '') +
+        (a.recovered ? ' (recovered)' : '') +
         (a.state === 'CANCELED_SPECULATIVE' ? ' (lost race)' : '');
     if (s.name === 'task_execute') return `  exec ${a.taskId}`;
     return s.name;

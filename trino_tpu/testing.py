@@ -87,8 +87,6 @@ class MultiProcessQueryRunner:
     ):
         import os
         import subprocess
-        import sys
-        import threading
         import time
         import urllib.request
 
@@ -96,6 +94,7 @@ class MultiProcessQueryRunner:
 
         self._procs: list[subprocess.Popen] = []
         self.spmd = spmd
+        self.platform = platform
         env = dict(os.environ)
         # one internal credential per PROCESS (not per cluster): rotating
         # it would 401 the parent's calls to an older still-live cluster
@@ -117,40 +116,10 @@ class MultiProcessQueryRunner:
         )
 
         self._logs: list[list[str]] = []
-
-        def popen(args):
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "trino_tpu.server.main", *args],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-                env=env,
-                cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            )
-            self._procs.append(proc)
-            return proc
-
-        def await_listening(proc):
-            deadline = time.time() + 180
-            while time.time() < deadline:
-                line = proc.stdout.readline()
-                if line.startswith("LISTENING "):
-                    # keep draining the pipe: an undrained 64KB pipe buffer
-                    # blocks the child on its next write and freezes it
-                    log: list[str] = []
-                    self._logs.append(log)
-
-                    def drain(stream=proc.stdout, log=log):
-                        for ln in stream:
-                            log.append(ln)
-
-                    threading.Thread(target=drain, daemon=True).start()
-                    return line.split()[1].strip()
-                if proc.poll() is not None:
-                    raise RuntimeError(
-                        f"server process exited: {proc.stdout.read()}"
-                    )
-            raise TimeoutError("server did not start in time")
+        self._env = env
+        self._cwd = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        popen = self._popen
+        await_listening = self._await_listening
 
         spmd_args: list[list[str]] = []
         if spmd:
@@ -177,6 +146,7 @@ class MultiProcessQueryRunner:
         catalog_args: list[str] = []
         for spec in catalogs or []:
             catalog_args += ["--catalog", spec]
+        self._catalog_args = catalog_args
         coord_args = ["--role", "coordinator", "--platform", platform]
         coord_args += catalog_args
         if cluster_memory_limit_bytes is not None:
@@ -207,6 +177,7 @@ class MultiProcessQueryRunner:
                 for i in range(n_workers)
             ]
             self.coordinator_uri = await_listening(coord_proc)
+            self._worker_procs = worker_procs
             self.worker_uris = [await_listening(p) for p in worker_procs]
             # late discovery: tell each worker where the coordinator is
             import json as _json
@@ -225,23 +196,11 @@ class MultiProcessQueryRunner:
                 urllib.request.urlopen(req, timeout=10)
         else:
             self.coordinator_uri = await_listening(coord_proc)
+            self._worker_procs = [
+                popen(self._worker_args(i)) for i in range(n_workers)
+            ]
             self.worker_uris = [
-                await_listening(
-                    popen(
-                        [
-                            "--role",
-                            "worker",
-                            "--node-id",
-                            f"worker-{i}",
-                            "--discovery",
-                            self.coordinator_uri,
-                            "--platform",
-                            platform,
-                        ]
-                        + catalog_args
-                    )
-                )
-                for i in range(n_workers)
+                await_listening(p) for p in self._worker_procs
             ]
         # wait for every worker to be announced and healthy
         deadline = time.time() + 60
@@ -256,6 +215,54 @@ class MultiProcessQueryRunner:
         else:
             raise TimeoutError("workers did not announce in time")
 
+    def _popen(self, args):
+        import subprocess
+        import sys
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "trino_tpu.server.main", *args],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=self._env,
+            cwd=self._cwd,
+        )
+        self._procs.append(proc)
+        return proc
+
+    def _await_listening(self, proc):
+        import threading
+        import time
+
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("LISTENING "):
+                # keep draining the pipe: an undrained 64KB pipe buffer
+                # blocks the child on its next write and freezes it
+                log: list[str] = []
+                self._logs.append(log)
+
+                def drain(stream=proc.stdout, log=log):
+                    for ln in stream:
+                        log.append(ln)
+
+                threading.Thread(target=drain, daemon=True).start()
+                return line.split()[1].strip()
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"server process exited: {proc.stdout.read()}"
+                )
+        raise TimeoutError("server did not start in time")
+
+    def _worker_args(self, i: int) -> list[str]:
+        return [
+            "--role", "worker",
+            "--node-id", f"worker-{i}",
+            "--discovery", self.coordinator_uri,
+            "--platform", self.platform,
+        ] + self._catalog_args
+
     def execute(self, sql: str, session_properties: Optional[dict] = None):
         from trino_tpu.client import ClientSession, StatementClient
 
@@ -266,6 +273,55 @@ class MultiProcessQueryRunner:
         rows = list(client.rows())
         names = [c.name for c in client.columns] if client.columns else []
         return rows, names
+
+    # --- chaos / lifecycle hooks (non-SPMD clusters only) ----------------
+
+    def kill_worker(self, i: int, timeout: float = 10.0) -> None:
+        """SIGKILL worker ``i`` — no drain, no goodbye; simulates node
+        death for spool/lineage recovery tests."""
+        p = self._worker_procs[i]
+        p.kill()
+        p.wait(timeout=timeout)
+
+    def drain_worker(self, i: int, timeout: float = 120.0) -> None:
+        """Graceful decommission: ``PUT /v1/info/state SHUTTING_DOWN``
+        stops admission, finishes running tasks, force-spools retained
+        buffers, deregisters, and exits the process."""
+        import urllib.request
+
+        from trino_tpu.server import auth as _auth
+
+        req = urllib.request.Request(
+            f"{self.worker_uris[i]}/v1/info/state",
+            data=b'"SHUTTING_DOWN"',
+            method="PUT",
+            headers=_auth.headers(),
+        )
+        urllib.request.urlopen(req, timeout=10)
+        self._worker_procs[i].wait(timeout=timeout)
+
+    def restart_worker(self, i: int, timeout: float = 60.0) -> str:
+        """Respawn worker ``i`` (same node id, fresh port) and wait until
+        the coordinator has re-registered its announce."""
+        import json as _json
+        import time
+        import urllib.request
+
+        proc = self._popen(self._worker_args(i))
+        uri = self._await_listening(proc)
+        self._worker_procs[i] = proc
+        self.worker_uris[i] = uri
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"{self.coordinator_uri}/v1/node", timeout=10
+            ) as r:
+                info = _json.loads(r.read().decode())
+            for n in info.get("nodes", []):
+                if n.get("nodeId") == f"worker-{i}" and n.get("uri") == uri:
+                    return uri
+            time.sleep(0.2)
+        raise TimeoutError(f"worker-{i} did not re-announce in time")
 
     def close(self) -> None:
         for p in self._procs:
