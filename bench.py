@@ -333,6 +333,10 @@ def _tpch_cold_warm(small: bool = False) -> None:
         warm_s = time.time() - t0
         _track_compile(cold)
         _track_compile(warm)
+        # pipeline-fusion telemetry: device dispatches this query cost
+        # (fused chains collapse N fragment dispatches into 1) and how
+        # many fragments rode fused programs
+        ex = warm.exchange_stats or {}
         out[f"q{qid}"] = {
             "cold_ms": round(cold_s * 1000, 1),
             "warm_ms": round(warm_s * 1000, 1),
@@ -340,6 +344,8 @@ def _tpch_cold_warm(small: bool = False) -> None:
             "compile_ms": cold.compile_ms,
             "warm_cache_hits": warm.program_cache_hits,
             "warm_trace_count": warm.trace_count,
+            "dispatch_round_trips": ex.get("dispatchRoundTrips"),
+            "fused_fragments": ex.get("fusedFragments"),
         }
 
 

@@ -43,6 +43,20 @@ def _median_time(runner, sql: str, runs: int = 3) -> float:
     return times[len(times) // 2]
 
 
+def _dispatch_stats(runner, sql: str) -> dict:
+    """Pipeline-fusion telemetry for one warm run: how many device
+    dispatches the query costs (fused chains collapse N fragment
+    dispatches into 1) and how many fragments rode in fused programs."""
+    res = runner.engine.execute_statement(sql, runner.session)
+    ex = res.exchange_stats or {}
+    out = {}
+    if ex.get("dispatchRoundTrips") is not None:
+        out["dispatch_round_trips"] = ex["dispatchRoundTrips"]
+    if ex.get("fusedFragments"):
+        out["fused_fragments"] = ex["fusedFragments"]
+    return out
+
+
 def tpch_sf1(queries=(1, 3, 5, 10)) -> dict:
     from trino_tpu.benchmarks.tpch import queries as corpus
     from trino_tpu.testing import LocalQueryRunner
@@ -53,6 +67,8 @@ def tpch_sf1(queries=(1, 3, 5, 10)) -> dict:
     out = {}
     for q in queries:
         out[f"q{q:02d}_s"] = round(_median_time(runner, texts[q]), 3)
+        for k, v in _dispatch_stats(runner, texts[q]).items():
+            out[f"q{q:02d}_{k}"] = v
     return out
 
 
@@ -64,7 +80,10 @@ def tpcds_q(qnum: int) -> dict:
     runner = LocalQueryRunner()
     runner.session.set("execution_mode", "distributed")
     texts = corpus("tpcds.tiny")
-    return {f"q{qnum}_s": round(_median_time(runner, texts[qnum]), 3)}
+    out = {f"q{qnum}_s": round(_median_time(runner, texts[qnum]), 3)}
+    for k, v in _dispatch_stats(runner, texts[qnum]).items():
+        out[f"q{qnum}_{k}"] = v
+    return out
 
 
 def columnar_scan_rates(sf: float = 0.1) -> dict:

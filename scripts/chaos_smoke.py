@@ -67,6 +67,9 @@ def main() -> int:
     # after compute, before emit — so a speculative cancel can still
     # abort delivery); speculation hedges onto the healthy worker-0
     slow_props = {
+        # hedging needs sibling tasks fanned out across workers: a fused
+        # pipeline unit is a single task and can never be speculated
+        "pipeline_fusion": False,
         "retry_policy": "TASK",
         "fault_injection_seed": seed,
         "fault_slow_workers": "worker-1",

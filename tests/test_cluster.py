@@ -146,7 +146,9 @@ class TestWorkerDeviceExecution:
             if t["state"] == "FINISHED"
         ]
         assert paths, "no finished tasks found for this query"
-        assert all(p == "fused" for p in paths), (
+        # "fused" = one fragment per program; "fused-pipeline" = a whole
+        # fused-unit chain in one program — both are the device path
+        assert all(p in ("fused", "fused-pipeline") for p in paths), (
             f"expected fused execution for every fragment of this"
             f" fusable query, got {[(t['taskId'], t['executionPath'], t['stats'].get('fused_error')) for t in mine.values()]}"
         )

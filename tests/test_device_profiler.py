@@ -34,7 +34,9 @@ class TestCapture:
         ds = res.device_stats
         assert ds is not None
         programs = ds["programs"]
-        assert any(label.startswith("frag:") for label in programs)
+        assert any(
+            label.startswith(("frag:", "fused:")) for label in programs
+        )
         for st in programs.values():
             assert st["executions"] >= 1
         # CPU's XLA backend reports a cost model; the rollup must agree
@@ -66,14 +68,14 @@ class TestCapture:
         assert set(warm.device_stats["programs"]) >= {
             label
             for label in (cold.device_stats or {}).get("programs", {})
-            if label.startswith("frag:")
+            if label.startswith(("frag:", "fused:"))
         }
 
     def test_explain_analyze_device_section(self, runner):
         rows, _ = runner.execute("explain analyze " + Q_AGG)
         text = "\n".join(r[0] for r in rows)
         assert "Device programs (XLA cost/memory analysis)" in text
-        assert "frag:" in text
+        assert "frag:" in text or "fused:" in text
         assert "executions=" in text
 
     def test_profiler_on_off_bit_identical(self, runner):
@@ -216,7 +218,7 @@ class TestSystemTables:
         assert {p["program"] for p in rows} >= {
             label
             for label in (res.device_stats or {}).get("programs", {})
-            if label.startswith("frag:")
+            if label.startswith(("frag:", "fused:"))
         }
 
     def test_runtime_programs_sql(self, runner):
@@ -228,7 +230,7 @@ class TestSystemTables:
         )
         assert names[0] == "fingerprint"
         assert rows
-        assert any(r[1].startswith("frag:") for r in rows)
+        assert any(r[1].startswith(("frag:", "fused:")) for r in rows)
 
     def test_runtime_metrics_sql(self, runner):
         runner.engine.execute_statement(Q_AGG, runner.session)
@@ -293,7 +295,10 @@ class TestPrometheusConformance:
         runner.engine.execute_statement(Q_AGG, runner.session)
         text = get_registry().render_prometheus()
         assert "# TYPE trino_tpu_program_flops gauge" in text
-        assert 'trino_tpu_program_flops{fragment="frag:' in text
+        assert (
+            'trino_tpu_program_flops{fragment="frag:' in text
+            or 'trino_tpu_program_flops{fragment="fused:' in text
+        )
 
 
 class TestBoundedRetention:

@@ -507,7 +507,11 @@ class TestDistributedDeviceStats:
     def test_stage_stats_merged_from_both_workers(self, obs_cluster):
         rows, _ = obs_cluster.execute(
             f"select o_orderpriority as {self.DEA_MARKER}, count(*) as c"
-            " from orders group by o_orderpriority"
+            " from orders group by o_orderpriority",
+            # this test is about merging one stage's stats across BOTH
+            # workers' tasks; pipeline fusion would collapse the chain
+            # into a single fused task with no fan-out
+            session_properties={"pipeline_fusion": False},
         )
         assert rows
         qid = _query_id_for(obs_cluster.coordinator_uri, self.DEA_MARKER)

@@ -588,6 +588,9 @@ class TestFireCompletedRace:
 
 
 SLOW_WORKER_PROPS = {
+    # speculation needs sibling tasks to hedge against: keep the chain
+    # on the per-fragment fan-out path (a fused unit is one task)
+    "pipeline_fusion": False,
     "retry_policy": "TASK",
     "fault_injection_seed": 7,
     "fault_slow_workers": "worker-1",

@@ -92,6 +92,16 @@ class Session:
         # distributed mode: compile each plan fragment into one SPMD
         # program (exec/fragments.py); off -> materialized interpreter
         ("fragment_execution", True),
+        # --- whole-pipeline fusion (planner/fragmenter.py fuse_groups) ----
+        # compile chains of fragments connected by eligible HASH (and
+        # gather) exchanges into ONE jitted program with the repartition
+        # collectives inside the jit, instead of one dispatch per
+        # fragment; ineligible links fall back to the per-fragment path
+        # bit-identically
+        ("pipeline_fusion", True),
+        # cap on fragments per fused program (bounds compile time and
+        # scoped-vmem pressure of the merged XLA program)
+        ("fusion_max_fragments", 8),
         # --- fault tolerance (trino_tpu/ft/) ------------------------------
         # NONE | TASK | QUERY (reference: io.trino.execution.RetryPolicy).
         # TASK re-dispatches a failed fragment attempt to another worker

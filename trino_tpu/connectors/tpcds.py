@@ -293,8 +293,14 @@ class TpcdsConnector(Connector):
     name = "tpcds"
 
     def __init__(self, split_rows: int = 1 << 20):
+        from trino_tpu.connectors.diskcache import DbgenDiskCache
+
         self.split_rows = split_rows
         self._dict_cache: dict[tuple, Dictionary] = {}
+        # cross-process split cache (connectors/diskcache.py): generation
+        # is deterministic per (schema, table, split), so cold processes
+        # read back previous runs' bytes instead of regenerating
+        self._disk_cache = DbgenDiskCache()
 
     # --- metadata --------------------------------------------------------
 
@@ -351,12 +357,20 @@ class TpcdsConnector(Connector):
     # --- generation ------------------------------------------------------
 
     def read_split(self, schema, table, columns, split):
+        key = (
+            "tpcds", schema, table, tuple(columns), split.index, split.total
+        )
+        batch = self._disk_cache.get(key)
+        if batch is not None:
+            return batch
         sf = scale_factor(schema)
         gen = getattr(self, f"_gen_{table}")
         cols = gen(sf, split.index, split.total)
         out = [cols[c] for c in columns]
         n = out[0].data.shape[0] if out else 0
-        return Batch(out, n)
+        batch = Batch(out, n)
+        self._disk_cache.put(key, batch)
+        return batch
 
     def _rng(self, table: str, index: int) -> np.random.Generator:
         return np.random.default_rng(_stable_seed("tpcds", table, index))

@@ -132,6 +132,8 @@ class TpchConnector(Connector):
 
     def __init__(self, split_rows: int = 1 << 20,
                  cache_bytes: int = 2 << 30):
+        from trino_tpu.connectors.diskcache import DbgenDiskCache
+
         self.split_rows = split_rows
         self._dict_cache: dict[str, Dictionary] = {}
         # generated splits are deterministic: cache them so repeated
@@ -139,6 +141,10 @@ class TpchConnector(Connector):
         self._batch_cache: dict[tuple, Batch] = {}
         self._batch_cache_bytes = 0
         self._batch_cache_limit = cache_bytes
+        # ...and the same batches on disk, shared ACROSS processes: cold
+        # bench subprocesses and fresh test sessions read back what a
+        # previous run generated (see connectors/diskcache.py)
+        self._disk_cache = DbgenDiskCache()
         # one HBM slab per (schema, table, columns); see device_slab
         self._device_slabs: dict[tuple, tuple] = {}
 
@@ -332,23 +338,52 @@ class TpchConnector(Connector):
         hit = self._batch_cache.get(key)
         if hit is not None:
             return hit
-        sf = scale_factor(schema)
-        gen = getattr(self, f"_gen_{table}")
-        cols = gen(sf, split.index, split.total, columns=set(columns))
-        out = [cols[c] for c in columns]
-        n = out[0].data.shape[0] if out else 0
-        batch = Batch(out, n)
+        disk_key = ("tpch",) + key
+        batch = self._disk_cache.get(disk_key)
+        if batch is not None:
+            batch = self._reintern(columns, batch)
+        else:
+            sf = scale_factor(schema)
+            gen = getattr(self, f"_gen_{table}")
+            cols = gen(sf, split.index, split.total, columns=set(columns))
+            out = [cols[c] for c in columns]
+            n = out[0].data.shape[0] if out else 0
+            batch = Batch(out, n)
+            self._disk_cache.put(disk_key, batch)
         import numpy as np
 
         nbytes = sum(
             np.asarray(c.data).nbytes
             + (np.asarray(c.valid).nbytes if c.valid is not None else 0)
-            for c in out
+            for c in batch.columns
         )
         if self._batch_cache_bytes + nbytes <= self._batch_cache_limit:
             self._batch_cache[key] = batch
             self._batch_cache_bytes += nbytes
         return batch
+
+    def _reintern(self, columns, batch: Batch) -> Batch:
+        """Swap disk-loaded dictionaries for the connector's shared
+        instances where the values match: distribution-valued columns
+        (l_shipmode, c_mktsegment, …) otherwise get one Dictionary object
+        per split, inflating cross-batch dictionary merges downstream."""
+        from trino_tpu.connectors import dbgen as G
+
+        cols = []
+        for name, col in zip(columns, batch.columns):
+            if (
+                col.dictionary is not None
+                and name in G.DIST_VALUES
+                and list(col.dictionary.values) == list(G.DIST_VALUES[name])
+            ):
+                col = Column(
+                    col.type,
+                    col.data,
+                    col.valid,
+                    self._strings(name, G.DIST_VALUES[name]),
+                )
+            cols.append(col)
+        return Batch(cols, batch.num_rows)
 
     # Each generator returns {column_name: Column} for this split's rows.
     def _range(self, total_rows: int, index: int, total: int) -> tuple[int, int]:

@@ -551,6 +551,7 @@ class Engine:
                     [c.type for c in batch.columns],
                     cluster_stats=cluster_stats,
                     device_stats=cluster_stats.get("deviceStats"),
+                    exchange_stats=cluster_stats.get("exchangeStats"),
                 )
         ctx = QueryMemoryContext(
             self.memory_pool,

@@ -54,7 +54,14 @@ from trino_tpu.parallel import exchange as X
 from trino_tpu.parallel.distributed import DistributedExecutor, _sharded_probe
 from trino_tpu.parallel.mesh import AXIS, shard_batch, smap
 from trino_tpu.planner import plan as P
-from trino_tpu.planner.fragmenter import PlanFragment, SubPlan, fragment_plan
+from trino_tpu.planner.fragmenter import (
+    FusedFragment,
+    PlanFragment,
+    SubPlan,
+    fragment_plan,
+    fuse_groups,
+    partitioned_join_pairs,
+)
 
 
 class FusedUnsupported(Exception):
@@ -145,6 +152,30 @@ def _expr_blocks_fusion(e) -> bool:
     if isinstance(e, SpecialForm):
         return any(_expr_blocks_fusion(a) for a in e.args)
     return False
+
+
+# XLA failure signatures that a SMALLER program can fix: scoped-vmem
+# allocation failures at compile time and HBM exhaustion at run time
+# (NOTES_r05 known issue 1: SF1 Q5's 33MB fragment program dies in
+# scoped allocation before any overflow flag can fire)
+_RESOURCE_ERROR_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Resource exhausted",
+    "resource exhausted",
+    "Scoped allocation",
+    "scoped allocation",
+    "vmem limit",
+    "VMEM limit",
+    "out of memory",
+    "Out of memory",
+)
+
+
+def _is_resource_exhausted(e: BaseException) -> bool:
+    """True when an XLA compile/allocation failure should enter the
+    capacity-HALVING ladder instead of failing the query."""
+    msg = f"{type(e).__name__}: {e}"
+    return any(m in msg for m in _RESOURCE_ERROR_MARKERS)
 
 
 def grow_or_raise(name: str, caps: "_Caps") -> None:
@@ -265,6 +296,25 @@ class _Caps:
         if not prev.endswith("+grown"):
             self.provenance[name] = prev + "+grown"
 
+    def shrink_all(self, factor: int = 2, floor: int = 64) -> bool:
+        """Inverse ladder for RESOURCE_EXHAUSTED compile/alloc failures:
+        the program's static shapes exceed scoped vmem (or HBM) before any
+        overflow flag can run, so halve every capacity still above
+        ``floor`` and retrace smaller. Returns False when nothing can
+        shrink (caller re-raises). Row overflow after a halve re-grows
+        through the normal ladder — both walks land on the same
+        power-of-two buckets."""
+        changed = False
+        for nm, v in list(self.vals.items()):
+            nv = max(floor, bucket_capacity(max(1, v // factor), minimum=1))
+            if nv < v:
+                self.vals[nm] = nv
+                prev = self.provenance.get(nm, "default")
+                if not prev.endswith("+halved"):
+                    self.provenance[nm] = prev + "+halved"
+                changed = True
+        return changed
+
     def signature(self) -> tuple:
         """Hashable view of the current capacity values — the part of a
         traced program's shape that the plan fingerprint cannot see."""
@@ -309,6 +359,28 @@ class _Meta:
         return data, res.batch.selection_mask(), flags, counters, aux
 
 
+class _TracerSummary:
+    """Merged view over the per-member tracers of a fused program, duck-
+    typed to what ``_Meta.capture``/``_Meta.outputs`` read from a single
+    :class:`_FragmentTracer`. Overflow flags and counters concatenate
+    (site names are unique per node/fragment id); static exchange stats
+    sum; ``aux_out`` carries only the ROOT member's exported hot set —
+    interior probes' hot sets are consumed in-trace by their in-unit
+    build peer and never leave the program."""
+
+    def __init__(self):
+        self.overflows: list = []
+        self.counters: list = []
+        self.exchange_static: dict = {}
+        self.aux_out: tuple = ()
+
+    def absorb(self, tracer) -> None:
+        self.overflows.extend(tracer.overflows)
+        self.counters.extend(tracer.counters)
+        for k, v in tracer.exchange_static.items():
+            self.exchange_static[k] = self.exchange_static.get(k, 0) + v
+
+
 def program_label(program_key) -> str:
     """Stable display label for a program-cache key: fragment identity
     without the per-run root-object id (metrics labels and deviceStats
@@ -318,6 +390,8 @@ def program_label(program_key) -> str:
             return f"frag:{program_key[1]}"
         if program_key[0] == "post":
             return f"post:{program_key[1]}"
+        if program_key[0] == "fused":
+            return "fused:" + "+".join(str(i) for i in program_key[1])
     return repr(program_key)
 
 
@@ -405,18 +479,19 @@ class FragmentedExecutor(DistributedExecutor):
     def _store_program(self, program_key, sig, jf, meta) -> None:
         """Insert a traced program under (program_key, capacity signature).
 
-        ``("frag", id, apply_exchange, id(root))`` keys embed the root
-        node's identity because dynamic filtering rebuilds probe roots per
-        execution; on a shared cross-query store those per-run keys would
-        accumulate (each cached closure pins its root alive, keeping ids
-        unique), so storing a new root's program evicts every entry for
-        the same fragment traced against a different — now unreachable —
-        root.
+        ``("frag", id, apply_exchange, id(root))`` keys (and their
+        ``("fused", ids, apply_exchange, root_ids)`` counterparts) embed
+        root-node identities because dynamic filtering rebuilds probe
+        roots per execution; on a shared cross-query store those per-run
+        keys would accumulate (each cached closure pins its root alive,
+        keeping ids unique), so storing a new root's program evicts every
+        entry for the same fragment(s) traced against a different — now
+        unreachable — root.
         """
         if (
             isinstance(program_key, tuple)
             and len(program_key) == 4
-            and program_key[0] == "frag"
+            and program_key[0] in ("frag", "fused")
         ):
             prefix, rid = program_key[:3], program_key[3]
             stale = [
@@ -607,21 +682,30 @@ class FragmentedExecutor(DistributedExecutor):
 
         results: dict[int, Result] = {}
         names_holder: dict[int, list[str]] = {}
+        units = self._fusion_units(sub)
 
-        def run(sp: SubPlan):
-            for child in sp.children:
-                run(child)
-            if self.fault_injector is not None:
-                # fragment-level injection site: deterministic per
-                # (seed, fragment id); in a worker's fused path the
-                # crash surfaces as a task failure (fused_strict) or a
-                # visible interpreter fallback
-                self.fault_injector.maybe_crash_task(
-                    f"frag:{sp.fragment.id}"
-                )
-            results[sp.fragment.id] = self._run_fragment(
-                sp.fragment, results, names_holder
-            )
+        def run_units():
+            for unit in units:
+                fused = isinstance(unit, FusedFragment)
+                if self.fault_injector is not None:
+                    # fragment-level injection sites: deterministic per
+                    # (seed, fragment id). A fused unit keeps one site per
+                    # MEMBER so chaos schedules are identical with fusion
+                    # on or off; in a worker's fused path the crash
+                    # surfaces as a task failure (fused_strict) or a
+                    # visible interpreter fallback
+                    for fid in (
+                        unit.fragment_ids if fused else (unit.id,)
+                    ):
+                        self.fault_injector.maybe_crash_task(f"frag:{fid}")
+                if fused:
+                    results[unit.id] = self._run_fused_unit(
+                        unit, results, names_holder
+                    )
+                else:
+                    results[unit.id] = self._run_fragment(
+                        unit, results, names_holder
+                    )
 
         # Optimistic overflow protocol: fragments enqueue their overflow
         # flags (device scalars) in `deferred_flags` instead of pulling
@@ -643,7 +727,7 @@ class FragmentedExecutor(DistributedExecutor):
             results.clear()
             names_holder.clear()
             self._hot_sets.clear()
-            run(sub)
+            run_units()
             root = results[sub.fragment.id]
             if jax.process_count() > 1:
                 # multi-host: replicate the (small) root result so every
@@ -705,6 +789,230 @@ class FragmentedExecutor(DistributedExecutor):
         ]
         return out, names
 
+    def _df_build_lookup(self, results: dict[int, Result]):
+        """Dynamic-filter domain accessor over completed fragment results
+        (None for fragments that haven't materialized — e.g. fused-unit
+        interiors — or for cross-host sharded intermediates)."""
+
+        def build_lookup(fid):
+            res = results.get(fid)
+            if res is None:
+                return None
+            if jax.process_count() > 1:
+                # intermediate fragment results are sharded across hosts;
+                # host-side domains would need a collective — skip
+                return None
+            sel = np.asarray(res.batch.selection_mask())
+
+            def get_column(name):
+                idx = res.layout.get(name)
+                if idx is None:
+                    return None
+                c = res.batch.columns[idx]
+                return c.data, np.asarray(c.valid_mask()) & sel
+
+            return get_column, int(sel.sum())
+
+        return build_lookup
+
+    # === whole-pipeline fusion ==========================================
+
+    def _fusion_units(self, sub: SubPlan) -> list:
+        """Bottom-up execution units: :class:`FusedFragment` groups where
+        pipeline fusion applies, plain fragments elsewhere. Cached per
+        plan entry — the grouping references fragment identities, so like
+        the subplan itself it must be stable across executions."""
+        units = self.programs.get("__fusedunits__")
+        if units is None:
+            if bool(self.session.get("pipeline_fusion")):
+                units = fuse_groups(
+                    sub,
+                    fusable=fragment_fusable,
+                    max_fragments=max(
+                        1, int(self.session.get("fusion_max_fragments"))
+                    ),
+                    blocked=frozenset(self._fusion_blocked(sub)),
+                    skew_pairs=(
+                        partitioned_join_pairs(sub)
+                        if bool(self.session.get("skew_handling"))
+                        else ()
+                    ),
+                )
+            else:
+                units = []
+
+                def visit(sp: SubPlan):
+                    for child in sp.children:
+                        visit(child)
+                    units.append(sp.fragment)
+
+                visit(sub)
+            self.programs["__fusedunits__"] = units
+        return units
+
+    def _fusion_blocked(self, sub: SubPlan) -> set:
+        """Fragment ids that must stay on the per-fragment path: scans
+        big enough for the streaming chunk loop (bounded memory beats one
+        materialized program) or for the interpreter's spill fallback.
+        Estimate-based, mirroring the per-fragment gates; tables without
+        estimates are discovered at materialization time and fall back
+        via FusedUnsupported instead."""
+        from trino_tpu.exec.streaming import streamable_chain
+
+        blocked: set[int] = set()
+        stream_threshold = int(
+            self.session.get("stream_scan_threshold_rows")
+        )
+        spill_threshold = (
+            int(self.session.get("spill_threshold_rows"))
+            if self.session.get("spill_enabled")
+            else None
+        )
+        for frag in sub.all_fragments():
+            chain = streamable_chain(frag.root)
+            stream_scan = chain[1] if chain is not None else None
+            for n in P.walk_plan(frag.root):
+                if not isinstance(n, P.TableScan):
+                    continue
+                try:
+                    est = self.catalogs.get(n.catalog).estimate_rows(
+                        n.schema, n.table
+                    )
+                except Exception:  # noqa: BLE001 — treat as unknown
+                    est = None
+                if est is None:
+                    continue
+                if n is stream_scan and est > stream_threshold:
+                    blocked.add(frag.id)
+                if spill_threshold is not None and est > spill_threshold:
+                    blocked.add(frag.id)
+        return blocked
+
+    def _run_fused_unit(
+        self,
+        unit: FusedFragment,
+        results: dict[int, Result],
+        names_holder: dict[int, list[str]],
+    ) -> Result:
+        span = get_tracer().start_span(
+            "fused_execute",
+            attrs={"stage": unit.id, "fragments": len(unit.fragments)},
+        )
+        try:
+            with span:
+                return self._run_fused_spanned(
+                    unit, results, names_holder, span
+                )
+        except (FusedUnsupported, CapacityRetryExceeded):
+            # bit-identical fallback: run the members as the ordinary
+            # per-fragment dispatches the grouping pass replaced (a member
+            # that is itself ineligible — e.g. a spill-sized input found
+            # only at materialization — then escalates to the interpreter
+            # exactly as before)
+            for frag in unit.fragments:
+                results[frag.id] = self._run_fragment(
+                    frag, results, names_holder
+                )
+            return results[unit.id]
+
+    def _run_fused_spanned(
+        self,
+        unit: FusedFragment,
+        results: dict[int, Result],
+        names_holder: dict[int, list[str]],
+        span,
+    ) -> Result:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        from trino_tpu.dynfilter import fragment_dynamic_filters
+
+        member_ids = set(unit.fragment_ids)
+        # dynamic filtering sees only OUTSIDE-unit build results (interior
+        # producers haven't run — they exist solely inside the trace);
+        # lookups for them return None, which the rewrite treats as
+        # "domain unavailable", a pure pruning loss, never a wrong result
+        lookup = self._df_build_lookup(results)
+        members = []
+        for frag in unit.fragments:
+            root = fragment_dynamic_filters(
+                frag.root, lookup, self.session, self.dynamic_filters
+            )
+            members.append(dataclasses.replace(frag, root=root))
+
+        inputs: dict[str, Any] = {}
+        input_layouts: dict[str, dict[str, int]] = {}
+        spill_threshold = (
+            int(self.session.get("spill_threshold_rows"))
+            if self.session.get("spill_enabled")
+            else None
+        )
+        for frag in members:
+            for n in P.walk_plan(frag.root):
+                if isinstance(n, P.TableScan):
+                    res = self._exec_tablescan(n)
+                    if (
+                        spill_threshold is not None
+                        and res.batch.capacity > spill_threshold
+                    ):
+                        raise FusedUnsupported("spill-sized input")
+                    inputs[f"scan{id(n)}"] = res.batch
+                    input_layouts[f"scan{id(n)}"] = res.layout
+                elif (
+                    isinstance(n, P.RemoteSource)
+                    and n.fragment_id not in member_ids
+                ):
+                    r = results[n.fragment_id]
+                    inputs[f"remote{n.fragment_id}"] = r.batch
+                    input_layouts[f"remote{n.fragment_id}"] = r.layout
+                elif isinstance(n, P.Output):
+                    names_holder[frag.id] = list(n.column_names)
+        # the unit ROOT's own output exchange may pair with an
+        # outside-unit peer (the grouping pass keeps in-unit pairs whole,
+        # so only the root can face an external probe/build mate)
+        skew = None
+        role = self._skew_roles().get(unit.id)
+        if role is not None:
+            if role["role"] == "probe":
+                skew = {
+                    "detect": (
+                        max(1, int(self.session.get("skew_hot_k"))),
+                        float(self.session.get("skew_hot_threshold_frac")),
+                    )
+                }
+            else:
+                hs = self._hot_sets.get(role["peer"])
+                if hs is not None:
+                    skew = {"salt": True}
+                    inputs["__hotset__"] = (hs[0], hs[1])
+        sink = {} if self.stats_collector is not None else None
+        out = self.run_fused_program(
+            members, inputs, input_layouts, stats_sink=sink, defer=True,
+            skew=skew,
+        )
+        aux = getattr(self, "_last_aux", ())
+        if aux:
+            self._hot_sets[unit.id] = aux
+        span.set("mode", "fused-pipeline")
+        if sink:
+            span.set("attempts", sink.get("attempts", 1))
+        get_registry().counter("trino_tpu_fused_programs_total").inc()
+        if self.stats_collector is not None:
+            self.stats_collector.record_fragment(
+                unit.id,
+                {
+                    "mode": "fused-pipeline",
+                    "fragments": list(unit.fragment_ids),
+                    "wall_s": _time.perf_counter() - t0,
+                    "attempts": (sink or {}).get("attempts", 1),
+                    "input_rows": (sink or {}).get("input_rows", 0),
+                    "output_rows": int(
+                        np.asarray(out.batch.selection_mask()).sum()
+                    ),
+                },
+            )
+        return out
+
     def _run_fragment(
         self,
         frag: PlanFragment,
@@ -750,27 +1058,11 @@ class FragmentedExecutor(DistributedExecutor):
         # fragment's probe scans before any input materializes
         from trino_tpu.dynfilter import fragment_dynamic_filters
 
-        def build_lookup(fid):
-            res = results.get(fid)
-            if res is None:
-                return None
-            if jax.process_count() > 1:
-                # intermediate fragment results are sharded across hosts;
-                # host-side domains would need a collective — skip
-                return None
-            sel = np.asarray(res.batch.selection_mask())
-
-            def get_column(name):
-                idx = res.layout.get(name)
-                if idx is None:
-                    return None
-                c = res.batch.columns[idx]
-                return c.data, np.asarray(c.valid_mask()) & sel
-
-            return get_column, int(sel.sum())
-
         root = fragment_dynamic_filters(
-            frag.root, build_lookup, self.session, self.dynamic_filters
+            frag.root,
+            self._df_build_lookup(results),
+            self.session,
+            self.dynamic_filters,
         )
         frag = dataclasses.replace(frag, root=root)
 
@@ -927,6 +1219,7 @@ class FragmentedExecutor(DistributedExecutor):
                 out = tracer.apply_output_exchange(
                     frag, Result(batch, res.layout)
                 )
+                tracer.exchange_static["dispatchRoundTrips"] = 1
                 meta.capture(out, tracer)
                 return meta.outputs(out)
 
@@ -1035,7 +1328,29 @@ class FragmentedExecutor(DistributedExecutor):
                         meta.aot = None
                         outs = None
             if outs is None:
-                outs = jf(*args)
+                try:
+                    outs = jf(*args)
+                except Exception as e:  # noqa: BLE001 — inspect and rethrow
+                    if not _is_resource_exhausted(e) or not caps.shrink_all():
+                        raise
+                    # the program failed to COMPILE (scoped-vmem / HBM
+                    # exhaustion) before any overflow flag could run:
+                    # enter the same retry ladder as row overflow,
+                    # inverted — halve every capacity and retrace smaller
+                    self.exchange_stats["compile_halvings"] = (
+                        self.exchange_stats.get("compile_halvings", 0) + 1
+                    )
+                    get_registry().counter(
+                        "trino_tpu_compile_halvings_total"
+                    ).inc()
+                    get_tracer().record(
+                        "compile_halving", 0.0,
+                        attrs={
+                            "key": repr(program_key) if program_key else None,
+                            "attempt": attempts,
+                        },
+                    )
+                    continue
             data, sel, flags, counters, aux = outs
             compile_ms = 0.0
             if traced_now:
@@ -1180,6 +1495,10 @@ class FragmentedExecutor(DistributedExecutor):
                 res = tracer._exec(frag.root)
                 if apply_exchange:
                     res = tracer.apply_output_exchange(frag, res)
+                # every execution of this program is one dispatch
+                # round-trip; the static rides the counter protocol so
+                # only the surviving (non-overflowed) attempt counts
+                tracer.exchange_static["dispatchRoundTrips"] = 1
                 meta.capture(res, tracer)
                 return meta.outputs(res)
 
@@ -1198,6 +1517,137 @@ class FragmentedExecutor(DistributedExecutor):
             # traced against old node ids must not serve new inputs (the
             # cached closure pins the old root alive, so its id is unique)
             program_key=("frag", frag.id, apply_exchange, id(frag.root)),
+            defer=defer,
+        )
+
+    def run_fused_program(
+        self,
+        frags: Sequence[PlanFragment],
+        inputs: dict[str, Any],
+        input_layouts: dict[str, dict[str, int]],
+        apply_exchange: bool = True,
+        stats_sink: Optional[dict] = None,
+        defer: bool = False,
+        skew: Optional[dict] = None,
+    ) -> Result:
+        """Compile + run a CHAIN of exchange-connected fragments as ONE
+        jitted SPMD program — the whole-pipeline fusion path.
+
+        ``frags`` is in bottom-up execution order (producers first, the
+        consumer root LAST). ``inputs`` holds only EXTERNAL feeds: table
+        scans of every member plus ``remote{fid}`` batches from producers
+        outside the unit; interior exchange links never leave the device —
+        each producer's output exchange lowers to in-program collectives
+        (``skewed_repartition``'s all_to_all/all_gather) and feeds the
+        consumer's RemoteSource as a traced value. ``skew`` configures the
+        ROOT member's output exchange; in-unit partitioned-join pairs
+        detect and salt entirely in-trace, hot-set tables passing from the
+        probe member's exchange to the build member's without ever
+        becoming a jit input. One program = one dispatch round-trip,
+        whatever the member count.
+        """
+        frags = list(frags)
+        fids = tuple(f.id for f in frags)
+        member_ids = set(fids)
+        caps = self.programs.setdefault(("caps", "fused", fids), _Caps())
+        for f in frags:
+            self._seed_caps(f, caps)
+        pvec = self._param_arrays()
+        if pvec is not None:
+            inputs = dict(inputs)
+            inputs["__params__"] = pvec
+        # in-unit skew roles (host-side, static): the grouping pass
+        # absorbs partitioned-join pairs atomically, so an interior
+        # member's peer is always a member too; only the root can face an
+        # external mate (handled by the caller through ``skew``)
+        roles = self._skew_roles()
+        member_skew: dict[int, dict] = {}
+        for fid in fids[:-1]:
+            role = roles.get(fid)
+            if role is None:
+                continue
+            if role["role"] == "probe":
+                member_skew[fid] = {
+                    "detect": (
+                        max(1, int(self.session.get("skew_hot_k"))),
+                        float(self.session.get("skew_hot_threshold_frac")),
+                    )
+                }
+            elif role["peer"] in member_ids:
+                member_skew[fid] = {"salt": True, "peer": role["peer"]}
+
+        def build(meta: _Meta):
+            def fn(inp: dict[str, Any]):
+                avail = dict(inp)
+                layouts = dict(input_layouts)
+                combined = _TracerSummary()
+                hot_sets: dict[int, tuple] = {}
+                res = None
+                tracer = None
+                for frag in frags:
+                    last = frag is frags[-1]
+                    mskew = member_skew.get(frag.id)
+                    if mskew is not None and mskew.get("salt"):
+                        hs = hot_sets.get(mskew["peer"])
+                        if hs is None:
+                            mskew = None
+                        else:
+                            # in-trace hot-set handoff: the probe member
+                            # ran earlier in this same trace (fragmenter
+                            # cuts Join.left first, so bottom-up order
+                            # puts the probe before its build mate). The
+                            # handoff key is peer-scoped: the plain
+                            # "__hotset__" slot belongs to the CALLER
+                            # (the root may salt against an external
+                            # probe), and a unit can hold several pairs
+                            key = f"__hotset__{mskew['peer']}"
+                            avail = dict(avail)
+                            avail[key] = (hs[0], hs[1])
+                            mskew = {"salt": True, "hotset_key": key}
+                    if last:
+                        mskew = skew
+                    tracer = _FragmentTracer(
+                        self, avail, layouts, caps, skew=mskew
+                    )
+                    res = tracer._exec(frag.root)
+                    if not last or apply_exchange:
+                        res = tracer.apply_output_exchange(frag, res)
+                    combined.absorb(tracer)
+                    if tracer.aux_out:
+                        hot_sets[frag.id] = tracer.aux_out
+                    if not last:
+                        avail = dict(avail)
+                        layouts = dict(layouts)
+                        avail[f"remote{frag.id}"] = res.batch
+                        layouts[f"remote{frag.id}"] = res.layout
+                # one program = one dispatch, whatever the member count;
+                # fusedFragments rides the same surviving-attempt protocol
+                combined.exchange_static["dispatchRoundTrips"] = 1
+                combined.exchange_static["fusedFragments"] = len(frags)
+                # only the ROOT's hot set leaves the program (interior
+                # probes' tables were consumed in-trace above)
+                combined.aux_out = tracer.aux_out
+                meta.capture(res, combined)
+                return meta.outputs(res)
+
+            return fn
+
+        return self._retry_traced(
+            caps,
+            build,
+            (inputs,),
+            stats_sink=stats_sink,
+            input_rows=sum(
+                b.capacity for b in inputs.values() if isinstance(b, Batch)
+            ),
+            # root identities of every member key the entry, for the same
+            # dynamic-filter staleness reason as the per-fragment path
+            program_key=(
+                "fused",
+                fids,
+                apply_exchange,
+                tuple(id(f.root) for f in frags),
+            ),
             defer=defer,
         )
 
@@ -2178,7 +2628,9 @@ class _FragmentTracer(DistributedExecutor):
         n = max(self.n, 1)
         detect = self.skew.get("detect")
         hot_set = (
-            self._inputs.get("__hotset__") if self.skew.get("salt") else None
+            self._inputs.get(self.skew.get("hotset_key", "__hotset__"))
+            if self.skew.get("salt")
+            else None
         )
         salted = detect is not None or hot_set is not None
         # cold tier: ~2x the uniform per-(src,dst) share; when a hot set

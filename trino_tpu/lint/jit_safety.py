@@ -20,6 +20,11 @@ become correctness or performance bugs:
 - ``JIT006`` numpy-on-device — ``np.*`` compute calls inside a
   ``jnp``-using function pull values to the host and break tracing; use
   ``jnp.*`` (or hoist the host work out of the kernel).
+- ``JIT007`` inter-fragment host pull — a ``to_host``/``.item()`` sync
+  followed by another fragment dispatch in the same function. With
+  pipeline fusion the interior fragment boundary lives *inside* one jit
+  program, so the pull is a dead device→host round trip (and blocks the
+  fused chain); keep the value on device and let the program chain it.
 
 Violations are keyed against a checked-in suppression baseline
 (``baseline.json``) so CI fails only on *new* violations. A line comment
@@ -50,7 +55,23 @@ RULES = {
     "JIT004": "float literal constructor without dtype= widens to float64 under x64",
     "JIT005": "iteration over an unordered set feeds collective/concat order",
     "JIT006": "np.* compute on device values inside a jnp-using function",
+    "JIT007": "host pull (to_host/.item()) before a later fragment dispatch: "
+    "fusion keeps the boundary in-jit, making the sync dead",
 }
+
+# entry points that dispatch a fragment program (or a fused chain of
+# them) to the device — a host pull lexically before one of these in the
+# same function straddles a fragment boundary fusion can keep on device
+_FRAGMENT_DISPATCH = frozenset(
+    {
+        "run_fragment_program",
+        "run_fused_program",
+        "run_chain",
+        "_run_fragment",
+        "_run_fused_unit",
+        "_run_fused_spanned",
+    }
+)
 
 # np.* attrs that compute over array *values* (vs constructors/dtype meta,
 # which are legitimate host-side prep even in device code)
@@ -143,6 +164,11 @@ class _Visitor(ast.NodeVisitor):
         self.np = np
         self.stack: list[str] = []  # enclosing function names
         self.fn_uses_jnp: list[bool] = []
+        # per-function-scope JIT007 events: host pulls and fragment
+        # dispatches, resolved when the scope closes (a pull only becomes
+        # a violation if a dispatch follows it lexically)
+        self.fn_pulls: list[list[tuple[int, ast.AST, str]]] = []
+        self.fn_dispatches: list[list[int]] = []
         self.out: list[Violation] = []
 
     # --- helpers ----------------------------------------------------------
@@ -168,7 +194,16 @@ class _Visitor(ast.NodeVisitor):
     def _visit_fn(self, node) -> None:
         self.stack.append(node.name)
         self.fn_uses_jnp.append(_mentions(node, self.jnp))
+        self.fn_pulls.append([])
+        self.fn_dispatches.append([])
         self.generic_visit(node)
+        # JIT007 resolves at scope close: flag each pull that a fragment
+        # dispatch follows (nested defs are their own scope, so the root
+        # pull after run_units() in the driver loop stays clean)
+        dispatches = self.fn_dispatches.pop()
+        for lineno, call, label in self.fn_pulls.pop():
+            if any(d > lineno for d in dispatches):
+                self._flag(call, "JIT007", label)
         self.fn_uses_jnp.pop()
         self.stack.pop()
 
@@ -213,6 +248,21 @@ class _Visitor(ast.NodeVisitor):
             and _rooted_at(fn, self.np)
         ):
             self._flag(node, "JIT006", f"np.{fn.attr}")
+        # JIT007: record host pulls and fragment dispatches per scope
+        if self.fn_pulls:
+            name = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name == "to_host" or (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "item"
+                and not node.args
+            ):
+                self.fn_pulls[-1].append((node.lineno, node, f"{name}()"))
+            elif name in _FRAGMENT_DISPATCH:
+                self.fn_dispatches[-1].append(node.lineno)
         self.generic_visit(node)
 
     def _check_branch(self, node) -> None:

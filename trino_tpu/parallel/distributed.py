@@ -62,6 +62,14 @@ class DistributedExecutor(LocalExecutor):
             "hot_keys": 0,
             "salted_rows": 0,
             "overflow_retries": 0,
+            # dispatched compiled programs on the surviving attempt
+            # (whole-pipeline fusion exists to push this toward 1) and
+            # fragments that executed inside fused multi-fragment programs
+            "dispatchRoundTrips": 0,
+            "fusedFragments": 0,
+            # RESOURCE_EXHAUSTED compile failures recovered by halving
+            # capacities (exec/fragments.py::_Caps.shrink_all)
+            "compile_halvings": 0,
         }
         # device-level profiling (obs/profiler.py): per-program XLA
         # cost/memory stats keyed by a stable program label. The fused

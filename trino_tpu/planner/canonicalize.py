@@ -68,8 +68,13 @@ _CODEGEN_PROPS = (
     "enable_dynamic_filtering",
     "execution_mode",
     "fragment_execution",
+    "fusion_max_fragments",
     "join_distribution_type",
     "join_reordering_strategy",
+    # fusion regroups fragments into multi-fragment programs, and the
+    # grouping itself is cached per entry (__fusedunits__), so fused and
+    # unfused runs of the same plan must not share a fingerprint
+    "pipeline_fusion",
     "skew_handling",
     "skew_hot_k",
     "skew_hot_threshold_frac",

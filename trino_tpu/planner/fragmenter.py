@@ -354,6 +354,161 @@ def _fragment_partitioning(root: P.PlanNode) -> Partitioning:
     return Partitioning(kind, hash_keys)
 
 
+# === whole-pipeline fusion ==================================================
+
+
+@dataclasses.dataclass
+class FusedFragment:
+    """A chain/tree of exchange-connected fragments compiled as ONE
+    program: interior HASH (or gather) exchanges become in-jit
+    collectives instead of fragment boundaries, so the whole group costs
+    a single dispatch round-trip. ``fragments`` is in bottom-up execution
+    order — producers first, the consumer root LAST (the root's output
+    exchange is the unit's output exchange)."""
+
+    fragments: tuple[PlanFragment, ...]
+
+    @property
+    def root(self) -> PlanFragment:
+        return self.fragments[-1]
+
+    @property
+    def id(self) -> int:
+        return self.fragments[-1].id
+
+    @property
+    def fragment_ids(self) -> tuple[int, ...]:
+        return tuple(f.id for f in self.fragments)
+
+
+def partitioned_join_pairs(sub) -> list[tuple[int, int]]:
+    """(probe_fid, build_fid) producer pairs of every partitioned
+    hash/hash equi-join (the skew-role pairing — mirrors
+    ``FragmentedExecutor._skew_roles``). The fusion pass keeps each pair
+    in the same unit or out of fusion entirely: the probe exchange
+    detects heavy hitters and the build exchange salts with the
+    resulting hot set, so splitting a pair across a fusion boundary
+    would break their ordering/co-partitioning contract.
+
+    ``sub`` is a :class:`SubPlan` or a bare fragment iterable (workers
+    hold only the shipped member list, never the SubPlan)."""
+    pairs: list[tuple[int, int]] = []
+    frags = sub.all_fragments() if isinstance(sub, SubPlan) else sub
+    for frag in frags:
+        for node in P.walk_plan(frag.root):
+            if (
+                isinstance(node, P.Join)
+                and node.join_type in ("INNER", "LEFT")
+                and node.criteria
+                and not node.single_row
+                and isinstance(node.left, P.RemoteSource)
+                and node.left.exchange_type == "hash"
+                and isinstance(node.right, P.RemoteSource)
+                and node.right.exchange_type == "hash"
+            ):
+                pairs.append(
+                    (node.left.fragment_id, node.right.fragment_id)
+                )
+    return pairs
+
+
+def fuse_groups(
+    sub: SubPlan,
+    *,
+    fusable,
+    max_fragments: int = 8,
+    blocked: frozenset = frozenset(),
+    skew_pairs=(),
+    include_root: bool = True,
+):
+    """Post-fragmentation grouping: partition the fragment tree into
+    fused units. Returns a list of units in bottom-up execution order;
+    each unit is either a plain :class:`PlanFragment` (unfused) or a
+    :class:`FusedFragment` of 2+ members.
+
+    A producer fuses into its consumer's unit when every leg of the link
+    is eligible:
+
+    - both sides trace (``fusable(frag)`` — the exec layer passes
+      ``fragment_fusable``) and neither is in ``blocked`` (the caller
+      blocks spill-sized / streaming-eligible fragments, and cluster
+      callers block spool-required boundaries);
+    - the connecting exchange is plain or skew-salted HASH, or a gather
+      ('single' — e.g. into a final global aggregation). Broadcast links
+      stay fragment boundaries;
+    - skew-paired producers (``skew_pairs``) are absorbed atomically —
+      both or neither;
+    - the unit stays within ``max_fragments`` members.
+
+    Grouping is greedy consumer-down: a consumer claims its eligible
+    producers, and claimed producers extend the same unit with their own
+    producers transitively. ``include_root=False`` keeps the root
+    fragment (coordinator-executed in cluster mode) out of any unit.
+    """
+    order: list[PlanFragment] = []
+    children: dict[int, list[PlanFragment]] = {}
+
+    def visit(sp: SubPlan) -> None:
+        children[sp.fragment.id] = [c.fragment for c in sp.children]
+        for c in sp.children:
+            visit(c)
+        order.append(sp.fragment)
+
+    visit(sub)
+    peer: dict[int, int] = {}
+    for a, b in skew_pairs:
+        peer[a] = b
+        peer[b] = a
+    max_fragments = max(1, int(max_fragments))
+    ok = {
+        f.id
+        for f in order
+        if f.id not in blocked and fusable(f)
+    }
+    owner: dict[int, int] = {}  # fid -> unit-root fid
+    size: dict[int, int] = {}
+    for frag in reversed(order):  # consumers before their producers
+        if frag.id not in ok:
+            continue
+        if frag.id == sub.fragment.id and not include_root:
+            continue
+        ru = owner.setdefault(frag.id, frag.id)
+        size.setdefault(ru, 1)
+        kids = children.get(frag.id, [])
+        kid_ids = {k.id for k in kids}
+        claimed: set[int] = set()
+        for child in kids:
+            if child.id in claimed:
+                continue
+            group = [child]
+            mate = peer.get(child.id)
+            if mate is not None:
+                if mate not in kid_ids:
+                    continue  # pair split across consumers: stay unfused
+                group.append(next(k for k in kids if k.id == mate))
+            claimed.update(c.id for c in group)
+            if any(c.id not in ok for c in group):
+                continue
+            if any(
+                c.output_exchange not in ("hash", "single") for c in group
+            ):
+                continue
+            if size[ru] + len(group) > max_fragments:
+                continue
+            for c in group:
+                owner[c.id] = ru
+            size[ru] += len(group)
+    units: list = []
+    for frag in order:
+        if owner.get(frag.id, frag.id) != frag.id:
+            continue  # interior member; emitted with its unit root
+        members = [f for f in order if owner.get(f.id, f.id) == frag.id]
+        units.append(
+            FusedFragment(tuple(members)) if len(members) > 1 else frag
+        )
+    return units
+
+
 # === EXPLAIN rendering ======================================================
 
 

@@ -24,7 +24,7 @@ import json
 import threading
 import time
 import urllib.request
-from typing import Optional
+from typing import Any, Optional
 
 from trino_tpu.config import Session
 from trino_tpu.events import StageCompletedEvent, TaskCompletedEvent
@@ -36,9 +36,12 @@ from trino_tpu.planner.fragmenter import (
     HASH,
     SINGLE,
     SOURCE,
+    FusedFragment,
     PlanFragment,
     SubPlan,
     fragment_plan,
+    fuse_groups,
+    partitioned_join_pairs,
 )
 
 _task_counter = itertools.count(1)
@@ -479,11 +482,51 @@ class ClusterScheduler:
         else:
             order = self._bottom_up(sub)
 
+        # whole-pipeline fusion: chains of eligible fragments collapse
+        # into single-task stage-groups — ONE task POST runs the whole
+        # chain as one compiled program on one worker's local mesh
+        # (in-jit collectives cannot cross worker process boundaries, so
+        # a fused unit trades cross-worker fan-out for zero interior
+        # dispatch round-trips). Speculation/retry operate on the unit
+        # task. Spooled exchange needs per-fragment retained boundaries,
+        # so it keeps the per-fragment path.
+        units_members: dict[int, list[PlanFragment]] = {}
+        unit_root_of: dict[int, int] = {}
+        if (
+            bool(session.get("pipeline_fusion"))
+            and str(session.get("worker_execution")).startswith("fused")
+            and not bool(session.get("exchange_spooling"))
+        ):
+            from trino_tpu.exec.fragments import fragment_fusable
+
+            units = fuse_groups(
+                sub,
+                fusable=fragment_fusable,
+                max_fragments=max(
+                    1, int(session.get("fusion_max_fragments"))
+                ),
+                skew_pairs=(
+                    partitioned_join_pairs(sub)
+                    if bool(session.get("skew_handling"))
+                    else ()
+                ),
+                include_root=False,  # the root runs on the coordinator
+            )
+            for u in units:
+                if isinstance(u, FusedFragment):
+                    units_members[u.id] = list(u.fragments)
+                    for m in u.fragments:
+                        unit_root_of[m.id] = u.id
+
         # task counts per fragment (root runs on the coordinator)
         task_counts: dict[int, int] = {}
         for frag in order:
             if frag.id == sub.fragment.id:
                 task_counts[frag.id] = 0  # coordinator
+            elif frag.id in units_members:
+                task_counts[frag.id] = 1  # one fused program, one worker
+            elif unit_root_of.get(frag.id, frag.id) != frag.id:
+                task_counts[frag.id] = 0  # interior: rides its unit task
             elif frag.partitioning.kind in (SOURCE, HASH):
                 task_counts[frag.id] = n
             else:
@@ -492,7 +535,9 @@ class ClusterScheduler:
         consumer_of: dict[int, int] = {}
         for frag in order:
             for fid in frag.source_fragment_ids:
-                consumer_of[fid] = frag.id
+                # producers feeding a fused unit's interior address the
+                # unit's single task: partition counts follow the unit
+                consumer_of[fid] = unit_root_of.get(frag.id, frag.id)
 
         remote_tasks: dict[int, list[HttpRemoteTask]] = {}
         session_json = {
@@ -564,6 +609,9 @@ class ClusterScheduler:
             for frag in order:
                 if frag.id == sub.fragment.id:
                     continue
+                if unit_root_of.get(frag.id, frag.id) != frag.id:
+                    continue  # fused-unit interior: rides its unit's task
+                members = units_members.get(frag.id)
                 if rc is not None:
                     # lineage heal: a producer whose node left the cluster
                     # since its barrier is recovered (spool re-point or
@@ -573,7 +621,15 @@ class ClusterScheduler:
                 obs["stage_start"][frag.id] = time.monotonic()
                 stage_span = tracer.start_span(
                     "stage",
-                    attrs={"stage": frag.id, "tasks": task_counts[frag.id]},
+                    attrs={
+                        "stage": frag.id,
+                        "tasks": task_counts[frag.id],
+                        **(
+                            {"fusedFragments": len(members)}
+                            if members is not None
+                            else {}
+                        ),
+                    },
                 )
                 obs["stage_spans"][frag.id] = stage_span
                 remote_tasks[frag.id] = self._schedule_fragment(
@@ -589,6 +645,7 @@ class ClusterScheduler:
                     http=http,
                     stage_span=stage_span,
                     spool=spool_payload,
+                    members=members,
                 )
                 if policy == RetryPolicy.TASK:
                     # stage barrier: producers must FINISH (with retained
@@ -662,9 +719,12 @@ class ClusterScheduler:
         partition: int,
         remote_tasks: dict[int, list[HttpRemoteTask]],
         fragments: dict[int, PlanFragment],
+        exclude: frozenset = frozenset(),
     ) -> dict:
         sources = {}
         for fid in frag.source_fragment_ids:
+            if fid in exclude:
+                continue  # in-unit producer: handed off inside the program
             tasks = remote_tasks[fid]
             producer = fragments.get(fid)
             entry = {
@@ -695,6 +755,7 @@ class ClusterScheduler:
         http: Optional[dict] = None,
         stage_span=None,
         spool: Optional[dict] = None,
+        members: Optional[list[PlanFragment]] = None,
     ) -> list[HttpRemoteTask]:
         from trino_tpu.ft.retry import RetryPolicy, is_retryable
         from trino_tpu.planner.serde import fragment_to_json
@@ -706,33 +767,51 @@ class ClusterScheduler:
         output_partitions = max(
             1, task_counts.get(consumer, 1) if consumer is not None else 1
         )
+        # a fused unit's task evaluates every member fragment, so its
+        # splits and remote sources span the whole member list
+        member_ids = frozenset(m.id for m in members) if members else frozenset()
+        scan_frags = members if members else [frag]
         # split assignment for SOURCE fragments (enumerated on the
         # coordinator during scheduling, reference SplitManager timing)
         split_assignment: list[dict[str, list[dict]]] = [
             {} for _ in range(max(n_tasks, 1))
         ]
-        if frag.partitioning.kind == SOURCE:
-            for node in P.walk_plan(frag.root):
+        scans: dict[str, tuple[P.TableScan, Any]] = {}
+        for sf in scan_frags:
+            if sf.partitioning.kind != SOURCE and not members:
+                continue
+            for node in P.walk_plan(sf.root):
                 if isinstance(node, P.TableScan):
-                    connector = self.engine.catalogs.get(node.catalog)
-                    splits = connector.get_splits(
-                        node.schema,
-                        node.table,
-                        target_splits=max(n_tasks, 1) * 4,
-                        constraint=node.constraint,
-                    )
                     key = f"{node.catalog}.{node.schema}.{node.table}"
-                    for i, s in enumerate(splits):
-                        split_assignment[i % max(n_tasks, 1)].setdefault(
-                            key, []
-                        ).append(
-                            {
-                                "table": s.table,
-                                "index": s.index,
-                                "total": s.total,
-                                "info": s.info,
-                            }
-                        )
+                    if key in scans:
+                        # two member scans of one table share the split
+                        # list on the wire: widen to unconstrained when
+                        # their pruning constraints disagree, so neither
+                        # scan misses splits (predicates still apply
+                        # in-program — the constraint is advisory)
+                        if scans[key][1] != node.constraint:
+                            scans[key] = (scans[key][0], None)
+                        continue
+                    scans[key] = (node, node.constraint)
+        for key, (node, constraint) in scans.items():
+            connector = self.engine.catalogs.get(node.catalog)
+            splits = connector.get_splits(
+                node.schema,
+                node.table,
+                target_splits=max(n_tasks, 1) * 4,
+                constraint=constraint,
+            )
+            for i, s in enumerate(splits):
+                split_assignment[i % max(n_tasks, 1)].setdefault(
+                    key, []
+                ).append(
+                    {
+                        "table": s.table,
+                        "index": s.index,
+                        "total": s.total,
+                        "info": s.info,
+                    }
+                )
         frag_json = fragment_to_json(frag)
         tasks: list[HttpRemoteTask] = []
         # membership can shrink between execute()'s snapshot and this
@@ -744,18 +823,29 @@ class ClusterScheduler:
         placements = self.node_scheduler.select(candidates, n_tasks)
         try:
             for p in range(n_tasks):
+                sources: dict = {}
+                for sf in scan_frags:
+                    sources.update(
+                        self._sources_payload(
+                            sf, p, remote_tasks, fragments, exclude=member_ids
+                        )
+                    )
                 payload = {
                     "session": session_json,
                     "fragment": frag_json,
                     "splits": split_assignment[p],
-                    "sources": self._sources_payload(
-                        frag, p, remote_tasks, fragments
-                    ),
+                    "sources": sources,
                     "output_partitions": output_partitions,
                     # materialized exchange: retained pages survive acks so
                     # a retried consumer attempt can re-pull them
                     "retain_output": policy == RetryPolicy.TASK,
                 }
+                if members is not None:
+                    # whole chain ships with the task: the worker compiles
+                    # the members into one program instead of N fragments
+                    payload["fused_fragments"] = [
+                        fragment_to_json(m) for m in members
+                    ]
                 if spool is not None:
                     # async durable copy: the worker spools finished pages
                     # to the coordinator so output survives its death
@@ -1576,6 +1666,26 @@ class ClusterScheduler:
                     )
                 )
         stats["stages"] = stages
+        # query-level exchange rollup for /v1/query parity with local
+        # mode: sum worker-shipped counters across stages, but take
+        # dispatchRoundTrips from the coordinator's own accounting — one
+        # per task POST attempt — since worker-side values also count
+        # retried attempts whose work was discarded
+        exchange_totals: dict = {}
+        for entry in stages:
+            for k, v in (entry.get("exchange") or {}).items():
+                if k != "padding_ratio":
+                    exchange_totals[k] = exchange_totals.get(k, 0) + v
+        round_trips = sum(e.get("attempts", 0) for e in stages)
+        if exchange_totals or round_trips:
+            exchange_totals["dispatchRoundTrips"] = round_trips
+            if exchange_totals.get("shuffle_rows"):
+                exchange_totals["padding_ratio"] = round(
+                    exchange_totals.get("padded_shuffle_rows", 0)
+                    / max(1, exchange_totals["shuffle_rows"]),
+                    4,
+                )
+            stats["exchangeStats"] = exchange_totals
         if query_programs:
             from trino_tpu.obs.profiler import rollup_device_stats
 

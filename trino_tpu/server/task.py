@@ -49,10 +49,15 @@ PAGE_ROWS = 1 << 16
 _WORKER_FRAGMENT_CACHE_MAX = 8
 
 
-def _shared_fragment_entry(engine, query_id, payload_fragment, validate):
-    """Return a locked {fragment, programs, lock} entry for this payload, or
-    None when another live task of the same fragment holds it (concurrent
-    partitions must not share a FragmentedExecutor's mutable state)."""
+def _shared_fragment_entry(
+    engine, query_id, payload_fragment, validate, payload_members=None
+):
+    """Return a locked {fragment, members, programs, lock} entry for this
+    payload, or None when another live task of the same fragment holds it
+    (concurrent partitions must not share a FragmentedExecutor's mutable
+    state). ``payload_members`` is the serialized fused-unit chain when
+    the task ships one — it joins the digest so a fused and an unfused
+    payload of the same root fragment never share programs."""
     import hashlib
 
     from trino_tpu.planner.serde import fragment_from_json
@@ -64,14 +69,31 @@ def _shared_fragment_entry(engine, query_id, payload_fragment, validate):
         cache = engine._task_fragment_cache = OrderedDict()
         engine._task_fragment_cache_lock = threading.Lock()
     digest = hashlib.sha256(
-        json.dumps(payload_fragment, sort_keys=True, default=str).encode()
+        json.dumps(
+            [payload_fragment, payload_members], sort_keys=True, default=str
+        ).encode()
     ).hexdigest()
     key = (query_id, payload_fragment.get("id"), digest)
     with engine._task_fragment_cache_lock:
         entry = cache.get(key)
         if entry is None:
+            members = (
+                [
+                    fragment_from_json(m, validate=validate)
+                    for m in payload_members
+                ]
+                if payload_members
+                else None
+            )
             entry = {
-                "fragment": fragment_from_json(payload_fragment, validate=validate),
+                # the chain's last member IS the unit root — reuse the
+                # deserialized object so plan-node identities agree
+                "fragment": (
+                    members[-1]
+                    if members
+                    else fragment_from_json(payload_fragment, validate=validate)
+                ),
+                "members": members,
                 "programs": {},
                 "lock": threading.Lock(),
             }
@@ -485,22 +507,88 @@ class FusedWorkerRunner:
         source_meta: dict[int, dict],
         stats_sink: Optional[dict] = None,
     ) -> Result:
-        from trino_tpu.connectors.api import Split
-        from trino_tpu.exec.fragments import FusedUnsupported
-
-        spill_threshold = (
-            int(self.executor.session.get("spill_threshold_rows"))
-            if self.executor.session.get("spill_enabled")
-            else None
+        self.fragment = self._df_rewrite(self.fragment, source_batches)
+        inputs: dict[str, Batch] = {}
+        layouts: dict[str, dict[str, int]] = {}
+        self._gather_inputs(
+            self.fragment.root,
+            splits,
+            source_batches,
+            source_meta,
+            inputs,
+            layouts,
         )
-        # dynamic filtering: prefetched build pages prune this task's
-        # probe splits and rows (sound under hash partitioning — probe
-        # rows are co-partitioned with their build rows)
+        return self.executor.run_fragment_program(
+            self.fragment,
+            inputs,
+            layouts,
+            apply_exchange=False,
+            stats_sink=stats_sink,
+        )
+
+    def run_chain(
+        self,
+        members: list[PlanFragment],
+        splits: dict[str, list[dict]],
+        source_batches: dict[int, list[Batch]],
+        source_meta: dict[int, dict],
+        stats_sink: Optional[dict] = None,
+    ) -> Result:
+        """Fused-unit chain (bottom-up, unit root LAST) as ONE program on
+        the worker-local mesh: interior hash/'single' exchanges lower to
+        in-program collectives, so every member rides this task's single
+        dispatch round-trip. Only external feeds — member table scans and
+        out-of-unit remote sources — are placed host-side; in-unit links
+        never leave the device."""
+        member_ids = frozenset(m.id for m in members)
+        frags = [self._df_rewrite(m, source_batches) for m in members]
+        self.fragment = frags[-1]
+        # in-unit skew pairs detect/salt in-trace; _skew_roles normally
+        # derives roles from the query SubPlan, which never ships to
+        # workers — seed the memo from the member list instead
+        if self.executor.programs.get("__skewroles__") is None:
+            from trino_tpu.planner.fragmenter import partitioned_join_pairs
+
+            roles: dict[int, dict] = {}
+            if bool(self.executor.session.get("skew_handling")):
+                for probe, build in partitioned_join_pairs(frags):
+                    roles[probe] = {"role": "probe"}
+                    roles[build] = {"role": "build", "peer": probe}
+            self.executor.programs["__skewroles__"] = roles
+        inputs: dict[str, Batch] = {}
+        layouts: dict[str, dict[str, int]] = {}
+        for f in frags:
+            self._gather_inputs(
+                f.root,
+                splits,
+                source_batches,
+                source_meta,
+                inputs,
+                layouts,
+                skip_fids=member_ids,
+            )
+        return self.executor.run_fused_program(
+            frags,
+            inputs,
+            layouts,
+            apply_exchange=False,
+            stats_sink=stats_sink,
+        )
+
+    def _df_rewrite(
+        self, fragment: PlanFragment, source_batches: dict[int, list[Batch]]
+    ) -> PlanFragment:
+        """Dynamic filtering: prefetched build pages prune this task's
+        probe splits and rows (sound under hash partitioning — probe rows
+        are co-partitioned with their build rows). In-unit build sides
+        are traced values, not host pages, so they simply don't prune."""
+        import dataclasses as _dc
+
         from trino_tpu.dynfilter import fragment_dynamic_filters
 
         by_fid = {
             n.fragment_id: n
-            for n in P.walk_plan(self.fragment.root)
+            for n in P.walk_plan(fragment.root)
             if isinstance(n, P.RemoteSource)
         }
 
@@ -531,19 +619,32 @@ class FusedWorkerRunner:
             return get_column, merged.num_rows
 
         root = fragment_dynamic_filters(
-            self.fragment.root,
+            fragment.root,
             build_lookup,
             self.executor.session,
             self.executor.dynamic_filters,
         )
-        import dataclasses as _dc
+        return _dc.replace(fragment, root=root)
 
-        fragment = _dc.replace(self.fragment, root=root)
-        self.fragment = fragment
+    def _gather_inputs(
+        self,
+        root: P.PlanNode,
+        splits: dict[str, list[dict]],
+        source_batches: dict[int, list[Batch]],
+        source_meta: dict[int, dict],
+        inputs: dict[str, Batch],
+        layouts: dict[str, dict[str, int]],
+        skip_fids: frozenset = frozenset(),
+    ) -> None:
+        from trino_tpu.connectors.api import Split
+        from trino_tpu.exec.fragments import FusedUnsupported
 
-        inputs: dict[str, Batch] = {}
-        layouts: dict[str, dict[str, int]] = {}
-        for node in P.walk_plan(self.fragment.root):
+        spill_threshold = (
+            int(self.executor.session.get("spill_threshold_rows"))
+            if self.executor.session.get("spill_enabled")
+            else None
+        )
+        for node in P.walk_plan(root):
             if isinstance(node, P.TableScan):
                 key = f"{node.catalog}.{node.schema}.{node.table}"
                 assigned = splits.get(key, [])
@@ -585,6 +686,8 @@ class FusedWorkerRunner:
                 inputs[f"scan{id(node)}"] = batch
                 layouts[f"scan{id(node)}"] = layout
             elif isinstance(node, P.RemoteSource):
+                if node.fragment_id in skip_fids:
+                    continue  # in-unit link: fed as a traced value
                 batches = source_batches[node.fragment_id]
                 meta = source_meta.get(node.fragment_id, {})
                 batch = self._place(node, batches, meta)
@@ -592,13 +695,6 @@ class FusedWorkerRunner:
                 layouts[f"remote{node.fragment_id}"] = {
                     s.name: i for i, s in enumerate(node.symbols)
                 }
-        return self.executor.run_fragment_program(
-            self.fragment,
-            inputs,
-            layouts,
-            apply_exchange=False,
-            stats_sink=stats_sink,
-        )
 
     # --- input placement --------------------------------------------------
 
@@ -726,6 +822,9 @@ class SqlTask:
         from trino_tpu.planner.sanity import validation_enabled
         from trino_tpu.planner.serde import fragment_from_json
 
+        # whole-pipeline fusion: the payload may ship a fused-unit chain
+        # (bottom-up, the task's fragment last) compiled as ONE program
+        fused_payload = payload.get("fused_fragments")
         # TASK retry: reuse the attempt-1 fragment object (stable plan-node
         # identities) and its compiled programs; lock released in _run()
         self._frag_entry = _shared_fragment_entry(
@@ -733,13 +832,31 @@ class SqlTask:
             task_id.rsplit(".", 2)[0],
             payload["fragment"],
             validation_enabled(self.session),
+            payload_members=fused_payload,
         )
         if self._frag_entry is not None:
             self.fragment: PlanFragment = self._frag_entry["fragment"]
+            self.fused_members = self._frag_entry.get("members")
         else:
-            self.fragment = fragment_from_json(
-                payload["fragment"], validate=validation_enabled(self.session)
+            members = (
+                [
+                    fragment_from_json(
+                        m, validate=validation_enabled(self.session)
+                    )
+                    for m in fused_payload
+                ]
+                if fused_payload
+                else None
             )
+            self.fragment = (
+                members[-1]
+                if members
+                else fragment_from_json(
+                    payload["fragment"],
+                    validate=validation_enabled(self.session),
+                )
+            )
+            self.fused_members = members
         self.splits: dict[str, list[dict]] = payload.get("splits", {})
         self.sources: dict[int, dict] = {
             int(k): v for k, v in payload.get("sources", {}).items()
@@ -961,12 +1078,14 @@ class SqlTask:
 
         from trino_tpu.exec.fragments import FusedUnsupported, fragment_fusable
 
-        if not fragment_fusable(self.fragment):
-            if strict:
-                raise FusedUnsupported(
-                    f"fused_strict: fragment {self.fragment.id} is not fusable"
-                )
-            return None
+        members = self.fused_members
+        for frag in members or [self.fragment]:
+            if not fragment_fusable(frag):
+                if strict:
+                    raise FusedUnsupported(
+                        f"fused_strict: fragment {frag.id} is not fusable"
+                    )
+                return None
         try:
             # a concurrent _run() completion may have popped the entry;
             # the fragment object itself stays valid either way
@@ -981,10 +1100,20 @@ class SqlTask:
                 fid: {"keys": src.get("keys"), "symbols": src.get("symbols")}
                 for fid, src in self.sources.items()
             }
-            result = runner.run(
-                self.splits, prefetched, source_meta, stats_sink=self.stats
-            )
-            self.execution_path = "fused"
+            if members:
+                result = runner.run_chain(
+                    members,
+                    self.splits,
+                    prefetched,
+                    source_meta,
+                    stats_sink=self.stats,
+                )
+                self.execution_path = "fused-pipeline"
+            else:
+                result = runner.run(
+                    self.splits, prefetched, source_meta, stats_sink=self.stats
+                )
+                self.execution_path = "fused"
             self.stats["dynamic_filters"] = len(
                 runner.executor.dynamic_filters
             )
@@ -1012,6 +1141,25 @@ class SqlTask:
             return None
 
     def _run_interpreted(self, prefetched) -> Result:
+        members = self.fused_members
+        if members:
+            # fused-unit fallback: interpret the chain bottom-up in this
+            # one task, feeding each interior result to its consumer as a
+            # prefetched source in wire (output_symbols) order — exactly
+            # the pages the member would have shipped as its own task
+            local = dict(prefetched)
+            result = None
+            for m in members:
+                result = self._interpret_one(m, local)
+                cols = [
+                    result.batch.columns[result.layout[s.name]]
+                    for s in m.root.output_symbols
+                ]
+                local[m.id] = [Batch(cols, result.batch.num_rows)]
+            return result
+        return self._interpret_one(self.fragment, prefetched)
+
+    def _interpret_one(self, fragment: PlanFragment, prefetched) -> Result:
         executor = WorkerExecutor(
             self.engine.catalogs,
             self.session,
@@ -1019,7 +1167,7 @@ class SqlTask:
             self.sources,
             prefetched=prefetched,
         )
-        root = self.fragment.root
+        root = fragment.root
         if isinstance(root, P.Output):
             res_batch, _names = executor.execute(root)
             return Result(
