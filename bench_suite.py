@@ -140,6 +140,70 @@ def columnar_scan_rates(sf: float = 0.1) -> dict:
     return out
 
 
+def parquet_table_cache(sf: float = 0.05) -> dict:
+    """Scan-from-Parquet with cold/warm splits: the cold run pays split
+    decode + coalesced H2D through the ingest tier (trino_tpu/ingest.py);
+    warm repeats hit the device-resident table cache and must report
+    h2d_bytes == 0. The warm/cold ratio is the table-cache win."""
+    import tempfile
+
+    from trino_tpu.testing import LocalQueryRunner
+
+    runner = LocalQueryRunner()
+    runner.session.set("execution_mode", "distributed")
+    # keep the scan on the fragment path, where the table cache lives
+    runner.session.set("stream_scan_threshold_rows", 1 << 26)
+    # the benchmark measures the arena path even at small sf
+    runner.session.set("coalesce_min_bytes", 0)
+    rows, _ = runner.execute(
+        "select l_orderkey, l_quantity, l_extendedprice, l_discount"
+        " from tpch.tiny.lineitem"
+    )
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+
+        table = pa.table(
+            {
+                "l_orderkey": np.asarray([r[0] for r in rows], np.int64),
+                "l_extendedprice": np.asarray(
+                    [float(r[2]) for r in rows], np.float32
+                ),
+            }
+        )
+        reps = max(1, int(sf * 6_000_000 / max(1, len(rows))))
+        table = pa.concat_tables([table] * reps)
+        os.makedirs(os.path.join(td, "default", "li"))
+        papq.write_table(
+            table, os.path.join(td, "default", "li", "part0.parquet")
+        )
+        from trino_tpu.connectors.parquet import ParquetConnector
+
+        runner.engine.catalogs.register("bpq", ParquetConnector(td))
+        sql = "select sum(l_extendedprice), count(*) from bpq.default.li"
+        t0 = time.time()
+        cold = runner.engine.execute_statement(sql, runner.session)
+        out["cold_s"] = round(time.time() - t0, 3)
+        ing = cold.ingest_stats or {}
+        out["cold_h2d_bytes"] = ing.get("h2d_bytes", 0)
+        out["cold_decode_ms"] = ing.get("decode_ms", 0.0)
+        times = []
+        warm = cold
+        for _ in range(3):
+            t0 = time.time()
+            warm = runner.engine.execute_statement(sql, runner.session)
+            times.append(time.time() - t0)
+        times.sort()
+        out["warm_s"] = round(times[len(times) // 2], 3)
+        wing = warm.ingest_stats or {}
+        out["warm_h2d_bytes"] = wing.get("h2d_bytes", 0)  # 0 on cache hit
+        out["warm_cache_hits"] = wing.get("table_cache_hits", 0)
+        out["rows"] = table.num_rows
+    return out
+
+
 def _subprocess_entry(call: str, timeout_s: int) -> dict:
     """Run ``bench_suite.<call>`` in a fresh python, hard-killed on
     timeout (a cancelled XLA compile holds the chip: the child must DIE,
@@ -186,6 +250,9 @@ def run_suite() -> dict:
             ds.update(r)
     suite["tpcds"] = ds
     suite["columnar"] = _subprocess_entry("columnar_scan_rates()", 420)
+    suite["parquet_table_cache"] = _subprocess_entry(
+        "parquet_table_cache()", 420
+    )
     suite["suite_wall_s"] = round(time.time() - t0, 1)
     return suite
 

@@ -1006,4 +1006,28 @@ int64_t tt_orc_varint_encode(const uint64_t* vals, int64_t n, uint8_t* out) {
     return pos;
 }
 
+// ===== H2D staging arena ====================================================
+// Coalesced host->device ingest: every column buffer of one split/shard
+// (data, validity lanes, selection) is copied into ONE contiguous
+// uint32-word arena, so the engine issues a single DMA per device instead
+// of one per column (amortizing the per-transfer latency floor). Each
+// source lands at a 4-byte-aligned offset; tail pad bytes are zeroed so
+// arenas are bit-deterministic (the parity test compares raw words).
+// Returns total words written, or -1 if a source would overrun capacity.
+
+int64_t tt_pack_arena(const uint8_t** srcs, const int64_t* nbytes,
+                      int64_t n_srcs, uint8_t* dst, int64_t dst_words) {
+    int64_t pos = 0;  // byte offset, always word-aligned
+    int64_t cap = dst_words * 4;
+    for (int64_t i = 0; i < n_srcs; i++) {
+        int64_t nb = nbytes[i];
+        int64_t padded = (nb + 3) & ~int64_t(3);
+        if (pos + padded > cap) return -1;
+        std::memcpy(dst + pos, srcs[i], nb);
+        for (int64_t k = nb; k < padded; k++) dst[pos + k] = 0;
+        pos += padded;
+    }
+    return pos / 4;
+}
+
 }  // extern "C"

@@ -193,6 +193,23 @@ def pytest_collection_modifyitems(config, items):
         config.hook.pytest_deselected(items=drop)
         items[:] = keep
 
+def pytest_report_header(config):
+    # Build the native columnar library ONCE per session (the import
+    # compiles it into a sha-keyed cache) and make its absence VISIBLE:
+    # a toolchain-less environment silently running every numpy fallback
+    # would otherwise look like full native coverage.
+    try:
+        from trino_tpu import native
+
+        status = (
+            "built" if native.NATIVE_AVAILABLE
+            else "UNAVAILABLE (numpy fallbacks active)"
+        )
+    except Exception as e:  # noqa: BLE001 — header must never kill collection
+        status = f"import failed: {type(e).__name__}"
+    return [f"native columnar library: {status}"]
+
+
 # Generated-table cache shared across Engine instances. Every
 # LocalQueryRunner builds a fresh Engine (fresh connectors), so without
 # this each test module re-runs dbgen for the same tiny-schema tables —

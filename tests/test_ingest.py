@@ -1,0 +1,473 @@
+"""Columnar ingest tier (trino_tpu/ingest.py): coalesced H2D staging
+arenas, double-buffered split decode, and the device-resident table
+cache — plus the native/fallback decode parity contract."""
+
+import numpy as np
+import pytest
+
+from trino_tpu import native
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column, Dictionary
+from trino_tpu.config import Session
+from trino_tpu.ingest import (
+    DeviceTableCache,
+    SplitPrefetcher,
+    shard_batch_coalesced,
+    splits_fingerprint,
+)
+from trino_tpu.parallel.mesh import make_mesh, shard_batch
+
+
+# === fast native smoke test (gates the native-specific cases) ==========
+
+
+def test_native_smoke():
+    """The one-liner that proves the shared library round-trips: if this
+    fails, every native-path test below is suspect; if the library is
+    absent, the suite still runs (fallbacks are the contract), but the
+    conftest report header makes the degraded mode visible."""
+    arrays = [np.arange(5, dtype=np.int64), np.ones(3, dtype=np.float32)]
+    out = native.pack_arena(arrays, use_native=native.NATIVE_AVAILABLE)
+    assert out.dtype == np.uint32
+    assert out.size == native.arena_words([a.nbytes for a in arrays])
+
+
+needs_native = pytest.mark.skipif(
+    not native.NATIVE_AVAILABLE, reason="native columnar library not built"
+)
+
+
+# === arena pack parity ==================================================
+
+
+@needs_native
+def test_pack_arena_native_python_parity():
+    rng = np.random.default_rng(0)
+    arrays = [
+        rng.integers(-(2**62), 2**62, 17, dtype=np.int64),
+        rng.integers(0, 2**32, 33, dtype=np.uint32),
+        rng.random(9).astype(np.float32),
+        rng.integers(0, 2, 13).astype(np.bool_),
+        rng.integers(-128, 127, 7, dtype=np.int8),
+        rng.integers(-(2**15), 2**15, 5, dtype=np.int16),
+        np.zeros(0, dtype=np.int32),  # empty buffer mid-arena
+    ]
+    a_native = native.pack_arena(arrays, use_native=True)
+    a_python = native.pack_arena(arrays, use_native=False)
+    assert np.array_equal(a_native, a_python)
+
+
+def test_pack_arena_empty():
+    assert native.pack_arena([]).size == 0
+    assert native.pack_arena([np.zeros(0, dtype=np.int64)]).size == 0
+
+
+# === coalesced shard placement is bit-identical to per-column ==========
+
+
+def _parts_with_everything(mesh, rng):
+    """Per-device parts covering every segment kind: int64, nullable
+    int32, float64 (arena fallback), float32, bool, dictionary varchar,
+    wide-decimal (N, 2) lanes — with ragged row counts so selection
+    masks and padding engage."""
+    n = mesh.devices.size
+    parts = []
+    for i in range(n):
+        rows = 5 + i
+        d = Dictionary([f"s{i}a", f"s{i}b"])
+        cols = [
+            Column(T.BIGINT, rng.integers(-(2**60), 2**60, rows, dtype=np.int64)),
+            Column(
+                T.INTEGER,
+                rng.integers(-100, 100, rows).astype(np.int32),
+                np.asarray([k % 3 != 0 for k in range(rows)], dtype=np.bool_),
+            ),
+            Column(T.DOUBLE, rng.random(rows)),
+            Column(T.REAL, rng.random(rows).astype(np.float32)),
+            Column(T.BOOLEAN, rng.integers(0, 2, rows).astype(np.bool_)),
+            Column(
+                T.VARCHAR, rng.integers(0, 2, rows).astype(np.int32), None, d
+            ),
+            Column(
+                T.DecimalType(38, 2),
+                rng.integers(0, 1 << 40, (rows, 2), dtype=np.int64),
+            ),
+        ]
+        parts.append(Batch(cols, rows))
+    return parts
+
+
+def _assert_batches_equal(b1: Batch, b2: Batch):
+    assert b1.capacity == b2.capacity
+    s1 = None if b1.sel is None else np.asarray(b1.sel)
+    s2 = None if b2.sel is None else np.asarray(b2.sel)
+    assert (s1 is None) == (s2 is None)
+    if s1 is not None:
+        assert np.array_equal(s1, s2)
+    for c1, c2 in zip(b1.columns, b2.columns):
+        assert c1.data.dtype == c2.data.dtype
+        assert np.array_equal(np.asarray(c1.data), np.asarray(c2.data))
+        v1 = None if c1.valid is None else np.asarray(c1.valid)
+        v2 = None if c2.valid is None else np.asarray(c2.valid)
+        assert (v1 is None) == (v2 is None)
+        if v1 is not None:
+            assert np.array_equal(v1, v2)
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_shard_batch_coalesced_bit_identical(use_native):
+    mesh = make_mesh()
+    rng = np.random.default_rng(3)
+    parts = _parts_with_everything(mesh, rng)
+    stats: dict = {}
+    plain = shard_batch(mesh, parts)
+    coalesced = shard_batch_coalesced(
+        mesh, parts, use_native=use_native, stats=stats, min_bytes=0
+    )
+    _assert_batches_equal(plain, coalesced)
+    assert stats["h2d_bytes"] > 0
+    # one arena transfer per device, plus the float64 per-column fallback
+    n = mesh.devices.size
+    assert stats["h2d_transfers"] == n + n
+    assert stats["fallback_columns"] == 1  # the DOUBLE column
+
+
+def test_shard_batch_coalesced_full_parts_no_sel():
+    """Equal-capacity all-valid parts skip the selection mask in both
+    paths (the no-mask fast path must survive coalescing)."""
+    mesh = make_mesh()
+    n = mesh.devices.size
+    parts = [
+        Batch([Column(T.BIGINT, np.arange(8, dtype=np.int64) + i)], 8)
+        for i in range(n)
+    ]
+    plain = shard_batch(mesh, parts)
+    coalesced = shard_batch_coalesced(mesh, parts, min_bytes=0)
+    assert plain.sel is None and coalesced.sel is None
+    _assert_batches_equal(plain, coalesced)
+
+
+def test_shard_batch_coalesced_small_scan_delegates():
+    """Under the byte threshold the arena can't amortize its unpack
+    compile: the per-column path runs instead, with H2D still counted."""
+    mesh = make_mesh()
+    n = mesh.devices.size
+    parts = [
+        Batch([Column(T.BIGINT, np.arange(4, dtype=np.int64))], 4)
+        for _ in range(n)
+    ]
+    stats: dict = {}
+    plain = shard_batch(mesh, parts)
+    coalesced = shard_batch_coalesced(mesh, parts, stats=stats)
+    _assert_batches_equal(plain, coalesced)
+    assert stats["h2d_bytes"] == n * 4 * 8
+    assert "coalesced_columns" not in stats
+
+
+# === split prefetcher ===================================================
+
+
+def test_prefetcher_order_and_stats():
+    stats: dict = {}
+    out = list(
+        SplitPrefetcher(lambda x: x * 2, range(20), enabled=True, stats=stats)
+    )
+    assert out == [x * 2 for x in range(20)]
+    assert stats["splits_decoded"] == 20
+    assert out == list(SplitPrefetcher(lambda x: x * 2, range(20), enabled=False))
+
+
+def test_prefetcher_propagates_decode_error():
+    def boom(x):
+        if x == 3:
+            raise ValueError("bad split")
+        return x
+
+    with pytest.raises(ValueError, match="bad split"):
+        list(SplitPrefetcher(boom, range(6), enabled=True))
+
+
+def test_prefetcher_early_stop():
+    """Consumer break (connector limit hint) must not deadlock the
+    producer thread blocked on the full slot."""
+    seen = []
+
+    def decode(x):
+        seen.append(x)
+        return x
+
+    it = iter(SplitPrefetcher(decode, range(100), enabled=True))
+    assert next(it) == 0
+    it.close()  # generator close -> producer unblocked and joined
+    assert len(seen) < 100
+
+
+# === device table cache unit behavior ===================================
+
+
+def _dummy_batch():
+    return Batch([Column(T.BIGINT, np.arange(4, dtype=np.int64))], 4)
+
+
+def test_table_cache_lru_eviction_under_byte_budget():
+    tc = DeviceTableCache()
+    b = _dummy_batch()
+    assert tc.admit(("k1",), b, 100, max_bytes=250)
+    assert tc.admit(("k2",), b, 100, max_bytes=250)
+    assert tc.lookup(("k1",)) is not None  # touch: k2 becomes LRU
+    assert tc.admit(("k3",), b, 100, max_bytes=250)
+    assert tc.lookup(("k2",)) is None  # evicted
+    assert tc.lookup(("k1",)) is not None
+    assert tc.lookup(("k3",)) is not None
+    assert tc.evictions == 1
+    assert tc.total_bytes == 200
+
+
+def test_table_cache_rejects_over_budget_and_low_headroom(monkeypatch):
+    tc = DeviceTableCache()
+    b = _dummy_batch()
+    assert not tc.admit(("big",), b, 999, max_bytes=250)
+    assert tc.rejections == 1
+    # HBM admission: the profiler-informed headroom check says no
+    import trino_tpu.ingest as ingest_mod
+
+    monkeypatch.setattr(
+        ingest_mod, "hbm_headroom_ok", lambda *a, **k: False
+    )
+    assert not tc.admit(("k1",), b, 10, max_bytes=250)
+    assert tc.rejections == 2
+    assert tc.lookup(("k1",)) is None
+
+
+def test_table_cache_invalidate_by_catalog():
+    tc = DeviceTableCache()
+    b = _dummy_batch()
+    tc.admit(("cat_a", "t1"), b, 10, max_bytes=100)
+    tc.admit(("cat_b", "t2"), b, 10, max_bytes=100)
+    assert tc.invalidate("cat_a") == 1
+    assert tc.lookup(("cat_a", "t1")) is None
+    assert tc.lookup(("cat_b", "t2")) is not None
+    assert tc.invalidate() == 1
+    assert tc.total_bytes == 0
+
+
+def test_splits_fingerprint_changes_with_splits():
+    from trino_tpu.connectors.api import Split
+
+    a = [Split("t", 0, 2, info=("f1", 0)), Split("t", 1, 2, info=("f1", 1))]
+    b = a + [Split("t", 2, 3, info=("f2", 0))]
+    assert splits_fingerprint(a) != splits_fingerprint(b)
+    assert splits_fingerprint(a) == splits_fingerprint(list(a))
+
+
+# === engine-level behavior ==============================================
+
+
+@pytest.fixture()
+def drunner():
+    from trino_tpu.testing import DistributedQueryRunner
+
+    return DistributedQueryRunner(
+        Session(
+            user="test",
+            catalog="memory",
+            schema="default",
+            # tiny test tables must still exercise the arena path
+            properties={"coalesce_min_bytes": 0},
+        )
+    )
+
+
+def test_warm_repeat_scan_h2d_zero(drunner):
+    sql = (
+        "select l_returnflag, sum(l_quantity), count(*) from"
+        " tpch.tiny.lineitem group by l_returnflag order by l_returnflag"
+    )
+    cold = drunner.engine.execute_statement(sql, drunner.session)
+    assert cold.ingest_stats is not None
+    assert cold.ingest_stats["h2d_bytes"] > 0
+    warm = drunner.engine.execute_statement(sql, drunner.session)
+    assert warm.rows == cold.rows
+    assert warm.ingest_stats["h2d_bytes"] == 0
+    assert warm.ingest_stats.get("table_cache_hits", 0) >= 1
+    assert warm.ingest_stats["tableCache"]["entries"] >= 1
+
+
+def test_results_identical_across_ingest_modes(drunner):
+    sql = (
+        "select l_linestatus, l_returnflag, sum(l_extendedprice),"
+        " avg(l_discount), count(*) from tpch.tiny.lineitem"
+        " where l_quantity < 30 group by 1, 2 order by 1, 2"
+    )
+    base = drunner.engine.execute_statement(sql, drunner.session).rows
+    for props in (
+        {"native_decode": False},
+        {"table_cache": False},
+        {"coalesced_h2d": False},
+        {"ingest_prefetch": False},
+        {
+            "native_decode": False,
+            "table_cache": False,
+            "coalesced_h2d": False,
+            "ingest_prefetch": False,
+        },
+    ):
+        ses = Session(
+            user="test",
+            properties={
+                "execution_mode": "distributed",
+                "coalesce_min_bytes": 0,
+                **props,
+            },
+        )
+        got = drunner.engine.execute_statement(sql, ses).rows
+        assert got == base, f"rows diverged under {props}"
+
+
+def test_memory_insert_invalidates_cached_scan(drunner):
+    drunner.execute("create table memory.default.inv (k bigint)")
+    drunner.execute("insert into memory.default.inv values (1), (2)")
+    sql = "select count(*), sum(k) from memory.default.inv"
+    r1 = drunner.engine.execute_statement(sql, drunner.session)
+    assert r1.rows == [(2, 1 + 2)]
+    # warm: cache hit on the unchanged table
+    r2 = drunner.engine.execute_statement(sql, drunner.session)
+    assert r2.ingest_stats.get("table_cache_hits", 0) >= 1
+    # INSERT bumps the memory connector's _version: the key changes, the
+    # next scan MUST miss and see the new row
+    drunner.execute("insert into memory.default.inv values (10)")
+    r3 = drunner.engine.execute_statement(sql, drunner.session)
+    assert r3.rows == [(3, 13)]
+
+
+def test_parquet_append_invalidates_cached_scan(tmp_path, drunner):
+    from trino_tpu.connectors.api import ColumnSchema, TableSchema
+    from trino_tpu.connectors.parquet import ParquetConnector
+
+    pq = ParquetConnector(str(tmp_path))
+    drunner.engine.catalogs.register("pqc", pq)
+    pq.create_table(
+        "default",
+        "t",
+        TableSchema("t", (ColumnSchema("x", T.BIGINT),)),
+    )
+    pq.insert(
+        "default",
+        "t",
+        Batch([Column(T.BIGINT, np.arange(10, dtype=np.int64))], 10),
+    )
+    sql = "select count(*), sum(x) from pqc.default.t"
+    r1 = drunner.engine.execute_statement(sql, drunner.session)
+    assert r1.rows == [(10, 45)]
+    r2 = drunner.engine.execute_statement(sql, drunner.session)
+    assert r2.ingest_stats.get("table_cache_hits", 0) >= 1
+    # appending a part file changes the file-list data_version
+    pq.insert(
+        "default",
+        "t",
+        Batch([Column(T.BIGINT, np.asarray([100], dtype=np.int64))], 1),
+    )
+    r3 = drunner.engine.execute_statement(sql, drunner.session)
+    assert r3.rows == [(11, 145)]
+
+
+def test_parquet_decode_native_fallback_parity(tmp_path):
+    """read_split through the C hot loops vs the pure-Python fallback
+    must produce bit-identical host batches."""
+    from trino_tpu.connectors.api import ColumnSchema, TableSchema
+    from trino_tpu.connectors.parquet import ParquetConnector
+
+    rng = np.random.default_rng(11)
+    n = 500
+    valid = rng.integers(0, 4, n) > 0
+    d, codes = Dictionary.from_strings(
+        [f"name_{int(i) % 7}" for i in rng.integers(0, 100, n)]
+    )
+    batch = Batch(
+        [
+            Column(T.BIGINT, rng.integers(0, 1 << 40, n, dtype=np.int64)),
+            Column(
+                T.INTEGER,
+                rng.integers(-50, 50, n).astype(np.int32),
+                valid,
+            ),
+            Column(T.DOUBLE, rng.random(n)),
+            Column(T.VARCHAR, codes.astype(np.int32), None, d),
+        ],
+        n,
+    )
+    pq = ParquetConnector(str(tmp_path))
+    pq.create_table(
+        "default",
+        "p",
+        TableSchema(
+            "p",
+            (
+                ColumnSchema("a", T.BIGINT),
+                ColumnSchema("b", T.INTEGER),
+                ColumnSchema("c", T.DOUBLE),
+                ColumnSchema("s", T.VARCHAR),
+            ),
+        ),
+    )
+    pq.insert("default", "p", batch)
+    cols = ["a", "b", "c", "s"]
+    splits = pq.get_splits("default", "p", 4)
+    assert splits
+    for s in splits:
+        b_native = pq.read_split("default", "p", cols, s)
+        with native.python_fallback():
+            b_python = pq.read_split("default", "p", cols, s)
+        assert b_native.num_rows == b_python.num_rows
+        for c1, c2 in zip(b_native.columns, b_python.columns):
+            assert np.array_equal(np.asarray(c1.data), np.asarray(c2.data))
+            if c1.dictionary is not None:
+                assert list(c1.dictionary.values) == list(
+                    c2.dictionary.values
+                )
+
+
+def test_orc_decode_native_fallback_parity(tmp_path):
+    from trino_tpu.connectors.api import ColumnSchema, TableSchema
+    from trino_tpu.connectors.orc import OrcConnector
+
+    rng = np.random.default_rng(13)
+    n = 400
+    batch = Batch(
+        [
+            Column(T.BIGINT, rng.integers(0, 1 << 30, n, dtype=np.int64)),
+            Column(T.DOUBLE, rng.random(n)),
+        ],
+        n,
+    )
+    oc = OrcConnector(str(tmp_path))
+    oc.create_table(
+        "default",
+        "o",
+        TableSchema(
+            "o", (ColumnSchema("a", T.BIGINT), ColumnSchema("c", T.DOUBLE))
+        ),
+    )
+    oc.insert("default", "o", batch)
+    for s in oc.get_splits("default", "o", 4):
+        b_native = oc.read_split("default", "o", ["a", "c"], s)
+        with native.python_fallback():
+            b_python = oc.read_split("default", "o", ["a", "c"], s)
+        for c1, c2 in zip(b_native.columns, b_python.columns):
+            assert np.array_equal(np.asarray(c1.data), np.asarray(c2.data))
+
+
+def test_ingest_metrics_and_stats_surface(drunner):
+    from trino_tpu.obs.metrics import get_registry
+
+    drunner.execute("select count(*) from tpch.tiny.region")
+    snap = get_registry().snapshot()
+    flat = str(snap)
+    assert "trino_tpu_ingest_h2d_bytes_total" in flat
+    assert "trino_tpu_ingest_decode_ms" in flat
+    res = drunner.engine.execute_statement(
+        "select count(*), sum(n_nationkey) from tpch.tiny.nation",
+        drunner.session,
+    )
+    ing = res.ingest_stats
+    assert ing is not None and "h2d_bytes" in ing

@@ -188,6 +188,27 @@ class Session:
         # the canonical-plan fingerprint (planner/canonicalize.py) for the
         # same reason
         ("device_profiling", True),
+        # --- columnar ingest tier (trino_tpu/ingest.py) --------------------
+        # decode host columns with the native C hot loops when the shared
+        # library built; off -> pure-Python/numpy fallback (bit-identical)
+        ("native_decode", True),
+        # two-slot double-buffered split decode: a background thread
+        # decodes split k+1 while the device executes over split k
+        ("ingest_prefetch", True),
+        # pack every column of a shard into one contiguous uint32 staging
+        # arena and issue a single H2D transfer per device (sliced back
+        # into columns on-device), amortizing the per-transfer DMA floor;
+        # off -> per-column device_put (bit-identical)
+        ("coalesced_h2d", True),
+        # below this many raw bytes a scan stays per-column even with
+        # coalesced_h2d on: cold scans are unpack-program-cold too, and a
+        # few DMA floors cost less than the first-touch XLA compile
+        ("coalesce_min_bytes", 1 << 23),
+        # device-resident table cache: keep scanned tables HBM-resident
+        # across queries keyed by (catalog, table, version, projection,
+        # splits); warm repeat scans issue zero H2D bytes
+        ("table_cache", True),
+        ("table_cache_max_bytes", 1 << 30),
     )
 
     def get(self, name: str) -> Any:

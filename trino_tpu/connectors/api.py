@@ -168,6 +168,14 @@ class Connector:
     ) -> Batch:
         raise NotImplementedError
 
+    def data_version(self, schema: str, table: str) -> Any:
+        """Monotone token that changes whenever the table's data changes;
+        keys the device table cache (trino_tpu/ingest.py), so mutation
+        invalidates cached HBM columns by making their keys unreachable.
+        Mutable connectors bump ``_version``; file-backed connectors
+        override with a (file list, mtime) digest."""
+        return getattr(self, "_version", 0)
+
     # --- optional stats (drives join distribution / sizing) -------------
     def estimate_rows(self, schema: str, table: str) -> Optional[int]:
         return None

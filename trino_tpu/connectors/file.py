@@ -272,6 +272,18 @@ class FileConnector(Connector):
         ]
         return self.prune_splits(schema, table, splits, constraint)
 
+    def data_version(self, schema, table):
+        # part-file list + mtimes (device-table-cache key): appends and
+        # rewrites both change it
+        d = self._table_dir(schema, table)
+        out = []
+        for p in self._parts(schema, table):
+            try:
+                out.append((p, os.path.getmtime(os.path.join(d, p))))
+            except OSError:
+                out.append((p, 0.0))
+        return tuple(out)
+
     def split_stats(self, schema, table, split):
         entry = self._file_stats(schema, table).get(split.info)
         if entry is None:

@@ -144,6 +144,14 @@ class OrcConnector(Connector):
             return None
         return sum(self._file(p).num_rows for p in files)
 
+    def data_version(self, schema, table):
+        # file list + mtimes key the device table cache: INSERT appends a
+        # file, so warm cached scans miss instead of serving stale rows
+        return tuple(
+            (os.path.basename(p), os.path.getmtime(p))
+            for p in self._files(schema, table)
+        )
+
     # --- writes: one ORC file per insert ----------------------------------
 
     def create_table(self, schema, table, schema_def: TableSchema) -> None:

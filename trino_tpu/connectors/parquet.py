@@ -126,6 +126,14 @@ class ParquetConnector(Connector):
             return None
         return sum(self._meta(p).num_rows for p in files)
 
+    def data_version(self, schema, table):
+        # part-file list + mtimes: INSERT appends a file, overwrites bump
+        # mtime — either changes the device-table-cache key
+        return tuple(
+            (os.path.basename(p), os.path.getmtime(p))
+            for p in self._files(schema, table)
+        )
+
     # --- writes -----------------------------------------------------------
 
     def create_table(self, schema, table, schema_def: TableSchema) -> None:

@@ -57,6 +57,10 @@ class StatementResult:
     # /v1/query as ``deviceStats``; None when profiling is off or the
     # backend reports nothing
     device_stats: Optional[dict[str, Any]] = None
+    # columnar ingest tier (trino_tpu/ingest.py): split decode wall,
+    # coalesced H2D bytes/transfers, device-table-cache hits/misses —
+    # surfaced in /v1/query as ``ingestStats``; None when no scan ran
+    ingest_stats: Optional[dict[str, Any]] = None
 
 
 class Engine:
@@ -124,6 +128,13 @@ class Engine:
 
         self._query_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         self._query_cache_lock = threading.Lock()
+        # device-resident table cache (trino_tpu/ingest.py): scanned
+        # tables stay HBM-resident across queries, keyed by catalog data
+        # version + projection + splits, so a warm repeat scan issues
+        # zero H2D bytes; engine-owned so every executor shares it
+        from trino_tpu.ingest import DeviceTableCache
+
+        self.table_cache = DeviceTableCache()
 
     _QUERY_CACHE_MAX = 64
     # statements whose results depend on evaluation time/randomness must
@@ -552,6 +563,7 @@ class Engine:
                     cluster_stats=cluster_stats,
                     device_stats=cluster_stats.get("deviceStats"),
                     exchange_stats=cluster_stats.get("exchangeStats"),
+                    ingest_stats=cluster_stats.get("ingestStats"),
                 )
         ctx = QueryMemoryContext(
             self.memory_pool,
@@ -584,6 +596,7 @@ class Engine:
                 program_cache_hits=int(cs.get("program_cache_hits", 0)),
                 program_cache_misses=int(cs.get("program_cache_misses", 0)),
                 device_stats=dsnap() if callable(dsnap) else None,
+                ingest_stats=executor.ingest_stats_snapshot(),
             )
         finally:
             ctx.close()
@@ -600,15 +613,23 @@ class Engine:
             if session.get("fragment_execution"):
                 from trino_tpu.exec.fragments import FragmentedExecutor
 
-                return FragmentedExecutor(
+                ex = FragmentedExecutor(
                     self.catalogs, session, self.mesh, memory_ctx=ctx,
                     programs=programs, params=params,
                 )
-            from trino_tpu.parallel.distributed import DistributedExecutor
+            else:
+                from trino_tpu.parallel.distributed import (
+                    DistributedExecutor,
+                )
 
-            return DistributedExecutor(
-                self.catalogs, session, self.mesh, memory_ctx=ctx
-            )
+                ex = DistributedExecutor(
+                    self.catalogs, session, self.mesh, memory_ctx=ctx
+                )
+            # share the engine-wide device table cache (warm repeat scans
+            # skip H2D); the local interpreter keeps host batches, so
+            # only the device-mesh executors get it
+            ex.table_cache = self.table_cache
+            return ex
         return LocalExecutor(self.catalogs, session, memory_ctx=ctx)
 
     def _run_query_rows(self, query: t.Query, session: Session) -> tuple[Batch, list[str]]:

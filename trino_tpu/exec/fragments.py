@@ -675,6 +675,15 @@ class FragmentedExecutor(DistributedExecutor):
         st["capacities"] = caps
         return st
 
+    def ingest_stats_snapshot(self):
+        """Per-query ingest counters plus the engine-wide device table
+        cache state (entries/bytes/evictions), so /v1/query shows both
+        what this query paid and what is HBM-resident for the next one."""
+        snap = super().ingest_stats_snapshot()
+        if snap is not None and self.table_cache is not None:
+            snap["tableCache"] = self.table_cache.snapshot()
+        return snap
+
     # === fragment scheduling ============================================
 
     def _execute_fragments(self, sub: SubPlan) -> tuple[Batch, list[str]]:

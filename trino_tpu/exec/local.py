@@ -100,6 +100,36 @@ class LocalExecutor:
         self._reservations: dict[int, int] = {}
         # per-node execution stats for EXPLAIN ANALYZE (OperatorStats chain)
         self.stats_collector = None
+        # per-query ingest accounting (split decode, coalesced H2D, table
+        # cache; trino_tpu/ingest.py) — served via /v1/query as ingestStats
+        self.ingest_stats: dict = {}
+        # engine-owned DeviceTableCache (None outside the engine)
+        self.table_cache = None
+
+    def ingest_stats_snapshot(self) -> Optional[dict]:
+        return dict(self.ingest_stats) if self.ingest_stats else None
+
+    def _read_splits(self, connector, schema, table, columns, splits):
+        """Decode splits through the ingest tier: double-buffered (a
+        background thread decodes split k+1 while the caller consumes
+        split k), honoring the ``native_decode`` session prop."""
+        import contextlib
+
+        from trino_tpu import native
+        from trino_tpu.ingest import SplitPrefetcher
+
+        ctx = (
+            contextlib.nullcontext()
+            if self.session.get("native_decode")
+            else native.python_fallback()
+        )
+        with ctx:
+            yield from SplitPrefetcher(
+                lambda s: connector.read_split(schema, table, columns, s),
+                splits,
+                enabled=bool(self.session.get("ingest_prefetch")),
+                stats=self.ingest_stats,
+            )
 
     # === entry ==========================================================
     def execute(self, node: P.PlanNode) -> tuple[Batch, list[str]]:
@@ -164,16 +194,27 @@ class LocalExecutor:
         )
         if not splits:
             return Result(self._empty_batch(node), {s.name: i for i, s in enumerate(node.symbols)})
+        import time as _time
+
+        from trino_tpu.obs.trace import get_tracer
+
+        t0 = _time.perf_counter()
         batches = []
         rows_read = 0
-        for s in splits:
+        for b in self._read_splits(
+            connector, node.schema, node.table, node.column_names, splits
+        ):
+            batches.append(b)
+            rows_read += b.num_rows
             # connector applyLimit hint: stop pulling splits once the
             # pushed row budget is covered (the Limit node still enforces)
             if node.limit is not None and rows_read >= node.limit:
                 break
-            b = connector.read_split(node.schema, node.table, node.column_names, s)
-            batches.append(b)
-            rows_read += b.num_rows
+        get_tracer().record(
+            "ingest.decode",
+            (_time.perf_counter() - t0) * 1000.0,
+            attrs={"table": node.table, "splits": len(batches)},
+        )
         batch = concat_batches(batches) if len(batches) > 1 else batches[0]
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         return Result(batch, layout)

@@ -218,10 +218,16 @@ class StreamingAggregator:
         cap: Optional[int] = None
         proto: Optional[Batch] = None
         pending: list[Batch] = []
-        for s in splits:
-            b = connector.read_split(
-                self.scan.schema, self.scan.table, self.scan.column_names, s
-            )
+        # double-buffered decode (trino_tpu/ingest.py): the next split
+        # decodes on a background thread while the device steps over the
+        # current chunk — the streaming loop is where overlap pays most
+        for b in self.executor._read_splits(
+            connector,
+            self.scan.schema,
+            self.scan.table,
+            self.scan.column_names,
+            splits,
+        ):
             b = self._canonicalize_dicts(b)
             if cap is None:
                 cap = bucket_capacity(max(1, min(b.num_rows, chunk_rows)))

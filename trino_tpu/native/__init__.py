@@ -97,11 +97,33 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     lib.tt_parquet_rle_decode.argtypes = [u8p, i64, ctypes.c_int32, i64, i32p]
     lib.tt_parquet_rle_encode.restype = i64
     lib.tt_parquet_rle_encode.argtypes = [i32p, i64, ctypes.c_int32, u8p]
+    lib.tt_pack_arena.restype = i64
+    lib.tt_pack_arena.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), i64p, i64, u8p, i64,
+    ]
     return lib
 
 
 _LIB = _build_and_load()
 NATIVE_AVAILABLE = _LIB is not None
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def python_fallback():
+    """Force every wrapper through its pure-Python path for the duration
+    (session prop ``native_decode=false``; the decode parity tests).
+    Flips the module-level handle, so native calls on OTHER threads also
+    fall back while held — safe (fallbacks are bit-identical), just
+    slower."""
+    global _LIB
+    saved, _LIB = _LIB, None
+    try:
+        yield
+    finally:
+        _LIB = saved
 
 
 def _ptr(a: np.ndarray, ctype):
@@ -618,6 +640,57 @@ def orc_byte_rle_encode(b: np.ndarray) -> Optional[bytes]:
         _ptr(b, ctypes.c_uint8), n, _ptr(out, ctypes.c_uint8)
     )
     return out[:ln].tobytes()
+
+
+def arena_words(nbytes_list: Sequence[int]) -> int:
+    """uint32 words a staging arena needs for these source byte sizes
+    (each source lands word-aligned with zeroed tail padding)."""
+    return sum((nb + 3) // 4 for nb in nbytes_list)
+
+
+def pack_arena(
+    arrays: Sequence[np.ndarray], use_native: bool = True
+) -> np.ndarray:
+    """Copy column buffers into ONE contiguous uint32 staging arena.
+
+    The coalesced-H2D hot loop: every buffer of a split (data, validity,
+    selection) is packed word-aligned so the engine issues a single
+    host->device transfer per shard. Native and numpy paths are
+    bit-identical (tail padding is zeroed in both).
+    """
+    srcs = [np.ascontiguousarray(a) for a in arrays]
+    sizes = [s.nbytes for s in srcs]
+    total = arena_words(sizes)
+    out = np.empty(total, dtype=np.uint32)
+    if total == 0:
+        return out
+    if _LIB is not None and use_native:
+        n = len(srcs)
+        ptrs = (ctypes.c_void_p * n)(
+            *[s.ctypes.data_as(ctypes.c_void_p).value for s in srcs]
+        )
+        nbytes = np.asarray(sizes, dtype=np.int64)
+        rc = _LIB.tt_pack_arena(
+            ptrs,
+            _ptr(nbytes, ctypes.c_int64),
+            n,
+            out.view(np.uint8).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)
+            ),
+            total,
+        )
+        if rc != total:
+            raise ValueError("arena pack overrun")
+        return out
+    dst = out.view(np.uint8)
+    pos = 0
+    for s, nb in zip(srcs, sizes):
+        dst[pos : pos + nb] = s.reshape(-1).view(np.uint8)
+        padded = (nb + 3) & ~3
+        if padded != nb:
+            dst[pos + nb : pos + padded] = 0
+        pos += padded
+    return out
 
 
 def orc_varint_encode(u: np.ndarray) -> Optional[bytes]:

@@ -193,11 +193,50 @@ class DistributedExecutor(LocalExecutor):
                 shard_batch(self.mesh, parts),
                 {s.name: i for i, s in enumerate(node.symbols)},
             )
-        per_shard: list[list[Batch]] = [[] for _ in range(n)]
-        for i, s in enumerate(splits):
-            per_shard[i % n].append(
-                connector.read_split(node.schema, node.table, node.column_names, s)
+        layout = {s.name: i for i, s in enumerate(node.symbols)}
+        stats = self.ingest_stats
+        stats.setdefault("h2d_bytes", 0)
+
+        # device table cache: a warm repeat scan of an unchanged table
+        # returns the HBM-resident batch — zero decode, zero H2D
+        cache_key = None
+        if self.table_cache is not None and self.session.get("table_cache"):
+            from trino_tpu.ingest import table_cache_key
+
+            cache_key = table_cache_key(
+                node.catalog,
+                node.schema,
+                node.table,
+                connector.data_version(node.schema, node.table),
+                node.column_names,
+                splits,
+                self.mesh,
             )
+            cached = self.table_cache.lookup(cache_key)
+            if cached is not None:
+                stats["table_cache_hits"] = stats.get("table_cache_hits", 0) + 1
+                return Result(cached, layout)
+            stats["table_cache_misses"] = (
+                stats.get("table_cache_misses", 0) + 1
+            )
+
+        import time as _time
+
+        from trino_tpu.obs.trace import get_tracer
+
+        t0 = _time.perf_counter()
+        per_shard: list[list[Batch]] = [[] for _ in range(n)]
+        for i, b in enumerate(
+            self._read_splits(
+                connector, node.schema, node.table, node.column_names, splits
+            )
+        ):
+            per_shard[i % n].append(b)
+        get_tracer().record(
+            "ingest.decode",
+            (_time.perf_counter() - t0) * 1000.0,
+            attrs={"table": node.table, "splits": len(splits)},
+        )
         parts = []
         empty_proto = None
         for shard_batches in per_shard:
@@ -217,8 +256,37 @@ class DistributedExecutor(LocalExecutor):
                     for c in empty_proto.columns
                 ]
                 parts[i] = Batch(cols, 0)
-        batch = shard_batch(self.mesh, parts)
-        return Result(batch, {s.name: i for i, s in enumerate(node.symbols)})
+        if self.session.get("coalesced_h2d"):
+            from trino_tpu.ingest import shard_batch_coalesced
+
+            batch = shard_batch_coalesced(
+                self.mesh,
+                parts,
+                use_native=bool(self.session.get("native_decode")),
+                stats=stats,
+                min_bytes=int(self.session.get("coalesce_min_bytes")),
+            )
+        else:
+            batch = shard_batch(self.mesh, parts)
+
+        if cache_key is not None:
+            from trino_tpu.memory import batch_nbytes
+
+            peak_hint = max(
+                (
+                    v.get("peak_hbm_bytes", 0)
+                    for v in self.device_stats.values()
+                ),
+                default=0,
+            )
+            self.table_cache.admit(
+                cache_key,
+                batch,
+                batch_nbytes(batch),
+                max_bytes=int(self.session.get("table_cache_max_bytes")),
+                peak_hbm_hint=peak_hint,
+            )
+        return Result(batch, layout)
 
     # === partial/final aggregation ======================================
     def _exec_aggregate(self, node: P.Aggregate) -> Result:

@@ -54,22 +54,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
-def shard_batch(mesh: Mesh, parts: Sequence[Batch]) -> Batch:
-    """Assemble per-shard host batches into one globally-sharded Batch.
+def prepare_shards(mesh: Mesh, parts: Sequence[Batch]):
+    """Host-side shard assembly: pad per-device parts to one capacity,
+    build selection masks, unify dictionaries, remap codes.
 
-    ``parts`` has one Batch per mesh device (same schema). Rows are padded
-    to the max per-shard capacity; the result's ``sel`` masks padding.
+    Shared by :func:`shard_batch` (per-column device_put) and the
+    coalesced-arena ingest path (``trino_tpu/ingest.py``), so both
+    produce bit-identical device batches. Returns
+    ``(cap, sels, columns)`` where ``sels`` is None or per-device bool
+    arrays and ``columns`` is ``[(type, dictionary, datas, valids)]``
+    with ``valids`` None when every part is full-capacity all-valid.
     """
     n = mesh.devices.size
     assert len(parts) == n, f"need {n} parts, got {len(parts)}"
     cap = max(1, max(p.capacity for p in parts))
-    sharding = row_sharding(mesh)
     width = parts[0].width
-    cols: list[Column] = []
     # full parts with no selection need no mask — skipping it avoids the
     # host->device mask bytes entirely for full streaming chunks
     if all(p.sel is None and p.num_rows == cap == p.capacity for p in parts):
-        sel = None
+        sels = None
     else:
         sels = []
         for p in parts:
@@ -80,8 +83,8 @@ def shard_batch(mesh: Mesh, parts: Sequence[Batch]) -> Batch:
                 local[: p.capacity] = np.asarray(p.sel)
                 mask &= local
             sels.append(mask)
-        sel = _global(mesh, sharding, sels)
     dictionaries = _unify_part_dictionaries(parts)
+    columns = []
     for j in range(width):
         t = parts[0].columns[j].type  # same schema across parts
         datas, valids = [], []
@@ -113,9 +116,25 @@ def shard_batch(mesh: Mesh, parts: Sequence[Batch]) -> Batch:
                     valid[: v.shape[0]] = v
                     valid[v.shape[0]:] = False
                 valids.append(valid)
-        data_g = _global(mesh, sharding, datas)
-        valid_g = None if no_valid else _global(mesh, sharding, valids)
         d = dictionaries[j][0] if dictionaries[j] is not None else None
+        columns.append((t, d, datas, None if no_valid else valids))
+    return cap, sels, columns
+
+
+def shard_batch(mesh: Mesh, parts: Sequence[Batch]) -> Batch:
+    """Assemble per-shard host batches into one globally-sharded Batch.
+
+    ``parts`` has one Batch per mesh device (same schema). Rows are padded
+    to the max per-shard capacity; the result's ``sel`` masks padding.
+    """
+    n = mesh.devices.size
+    cap, sels, columns = prepare_shards(mesh, parts)
+    sharding = row_sharding(mesh)
+    sel = None if sels is None else _global(mesh, sharding, sels)
+    cols: list[Column] = []
+    for t, d, datas, valids in columns:
+        data_g = _global(mesh, sharding, datas)
+        valid_g = None if valids is None else _global(mesh, sharding, valids)
         cols.append(Column(t, data_g, valid_g, d))
     return Batch(cols, cap * n, sel)
 

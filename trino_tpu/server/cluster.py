@@ -1686,6 +1686,13 @@ class ClusterScheduler:
                     4,
                 )
             stats["exchangeStats"] = exchange_totals
+        # ingest rollup: decode/H2D/table-cache counters summed per stage
+        ingest_totals: dict = {}
+        for entry in stages:
+            for k, v in (entry.get("ingest") or {}).items():
+                ingest_totals[k] = round(ingest_totals.get(k, 0) + v, 3)
+        if ingest_totals:
+            stats["ingestStats"] = ingest_totals
         if query_programs:
             from trino_tpu.obs.profiler import rollup_device_stats
 
@@ -1713,6 +1720,7 @@ class ClusterScheduler:
         have_flops = have_peak = False
         peak = 0
         exchange: dict = {}
+        ingest: dict = {}
         for t in tasks:
             st = t.last_status or {}
             if st.get("state") != "FINISHED":
@@ -1736,6 +1744,9 @@ class ClusterScheduler:
                     v, (int, float)
                 ) and not isinstance(v, bool):
                     exchange[k] = exchange.get(k, 0) + v
+            for k, v in (ts.get("ingest") or {}).items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    ingest[k] = ingest.get(k, 0) + v
             ds = ts.get("deviceStats") or {}
             merge_device_stats(query_programs, ds.get("programs"))
             if ds.get("total_flops") is not None:
@@ -1761,6 +1772,8 @@ class ClusterScheduler:
                     4,
                 )
             entry["exchange"] = exchange
+        if ingest:
+            entry["ingest"] = ingest
         if have_flops:
             entry["flops"] = flops
         if have_peak:

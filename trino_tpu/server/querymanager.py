@@ -277,6 +277,10 @@ class ManagedQuery:
             # skew-aware exchange counters (shuffle rows/bytes, padding
             # ratio, overflow retries, hot/salted keys, capacity provenance)
             "exchangeStats": self.result.exchange_stats if self.result else None,
+            # columnar ingest tier (trino_tpu/ingest.py): split decode
+            # wall, coalesced H2D bytes, device-table-cache hits/misses —
+            # a warm repeat scan shows h2d_bytes == 0
+            "ingestStats": self.result.ingest_stats if self.result else None,
             # device profiler rollup (obs/profiler.py): per-program XLA
             # flops / peak HBM merged across workers, plus query totals
             "deviceStats": self.result.device_stats if self.result else None,

@@ -425,15 +425,20 @@ class WorkerExecutor(LocalExecutor):
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         if not assigned:
             return Result(self._empty_batch(node), layout)
-        batches = [
-            connector.read_split(
+        # assigned splits decode through the double-buffered ingest tier
+        # (decode of split k+1 overlaps device work on split k)
+        batches = list(
+            self._read_splits(
+                connector,
                 node.schema,
                 node.table,
                 node.column_names,
-                Split(d["table"], d["index"], d["total"], d.get("info")),
+                [
+                    Split(d["table"], d["index"], d["total"], d.get("info"))
+                    for d in assigned
+                ],
             )
-            for d in assigned
-        ]
+        )
         batch = concat_batches(batches) if len(batches) > 1 else batches[0]
         return Result(batch, layout)
 
@@ -1125,6 +1130,9 @@ class SqlTask:
             if dsnap:
                 self.stats["deviceStats"] = dsnap
             self.stats["exchange"] = runner.executor.exchange_stats_snapshot()
+            isnap = runner.executor.ingest_stats_snapshot()
+            if isnap:
+                self.stats["ingest"] = isnap
             return result
         except (FusedUnsupported, jax.errors.TracerArrayConversionError) as e:
             if strict:
