@@ -204,6 +204,190 @@ def parquet_table_cache(sf: float = 0.05) -> dict:
     return out
 
 
+def _percentile(samples_ms: list, p: float) -> float:
+    xs = sorted(samples_ms)
+    if not xs:
+        return 0.0
+    return round(xs[min(len(xs) - 1, int(p / 100.0 * len(xs)))], 1)
+
+
+def _batched_dispatch_delta(before: dict, after: dict) -> dict:
+    """Dispatch count + mean batch size from the
+    ``trino_tpu_batched_dispatches_total{size}`` counter family."""
+    import re
+
+    total = 0
+    weighted = 0
+    for key, val in after.get("counters", {}).items():
+        m = re.match(
+            r'trino_tpu_batched_dispatches_total\{size="(\d+)"\}', key
+        )
+        if not m:
+            continue
+        n = int(val - before.get("counters", {}).get(key, 0))
+        total += n
+        weighted += int(m.group(1)) * n
+    return {
+        "batched_dispatches": total,
+        "mean_batch_size": round(weighted / total, 2) if total else 0.0,
+    }
+
+
+def bench_concurrency(
+    clients: int = 16, per_client: int = 3, window_ms: int = 25
+) -> dict:
+    """High-concurrency serving: closed- and open-loop literal-variation
+    arrival over one TPC-H shape, batched (batch_window_ms>0) vs today's
+    behavior (window=0) at the same offered load.
+
+    Every concurrent result is checked bit-identical against its
+    sequential run — a drift flips ``identical`` to False.
+    """
+    import dataclasses
+    import threading
+
+    from trino_tpu.obs.metrics import get_registry
+    from trino_tpu.testing import LocalQueryRunner
+
+    runner = LocalQueryRunner()
+    runner.session.set("execution_mode", "distributed")
+    q = (
+        "select l_returnflag, sum(l_quantity), count(*)"
+        " from tpch.tiny.lineitem where l_quantity < {}"
+        " group by l_returnflag order by l_returnflag"
+    )
+    lits = [10 + 2 * (i % 12) for i in range(clients * per_client)]
+
+    def session(window: int, max_size: int = None):
+        s = dataclasses.replace(
+            runner.session, properties=dict(runner.session.properties)
+        )
+        s.properties["batch_window_ms"] = window
+        s.properties["batch_max_size"] = max_size or clients
+        return s
+
+    # sequential ground truth per literal (and program-cache warm-up)
+    seq_rows = {
+        lit: runner.engine.execute_statement(
+            q.format(lit), session(0)
+        ).rows
+        for lit in sorted(set(lits))
+    }
+    drift = [0]
+
+    def closed_loop(window: int, rounds: int, measure: bool = True) -> list:
+        """Every client issues one query per round behind a barrier, so
+        each round offers `clients` simultaneous arrivals."""
+        lat_ms: list = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(clients)
+
+        def worker(c: int) -> None:
+            s = session(window)
+            for r in range(rounds):
+                lit = lits[(r * clients + c) % len(lits)]
+                barrier.wait()
+                t0 = time.time()
+                res = runner.engine.execute_statement(q.format(lit), s)
+                dt = (time.time() - t0) * 1000.0
+                with lock:
+                    if measure:
+                        lat_ms.append(dt)
+                    if res.rows != seq_rows[lit]:
+                        drift[0] += 1
+
+        ts = [
+            threading.Thread(target=worker, args=(c,))
+            for c in range(clients)
+        ]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        wall = time.time() - t0
+        return lat_ms, wall
+
+    out: dict = {"clients": clients, "window_ms": window_ms}
+    base_lat, base_wall = closed_loop(0, per_client)
+    out["baseline_p50_ms"] = _percentile(base_lat, 50)
+    out["baseline_p99_ms"] = _percentile(base_lat, 99)
+    out["baseline_qps"] = round(len(base_lat) / base_wall, 1)
+    # warm round compiles the stacked K-program off the clock, exactly
+    # like the single-path warm run in _median_time
+    closed_loop(window_ms, 1, measure=False)
+    before = get_registry().snapshot()
+    bat_lat, bat_wall = closed_loop(window_ms, per_client)
+    out["batched_p50_ms"] = _percentile(bat_lat, 50)
+    out["batched_p99_ms"] = _percentile(bat_lat, 99)
+    out["batched_qps"] = round(len(bat_lat) / bat_wall, 1)
+    out.update(_batched_dispatch_delta(before, get_registry().snapshot()))
+
+    # open-loop groups land in the small stacked-K buckets (2, 4) that
+    # the closed-loop warm round never compiled — warm them off the
+    # clock too, or their first-touch compile dominates the tail
+    for g in (2, 4):
+        barrier = threading.Barrier(g)
+
+        def bucket_warm(c: int, _g=g, _b=barrier) -> None:
+            _b.wait()
+            runner.engine.execute_statement(
+                q.format(lits[c]), session(500, max_size=_g)
+            )
+
+        ts = [
+            threading.Thread(target=bucket_warm, args=(c,)) for c in range(g)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    # open loop: fixed-rate arrivals at the batched setting; rates chosen
+    # so the faster one's inter-arrival gap (20ms) fits inside the batch
+    # window and dispatches start sharing
+    open_out: dict = {}
+    for qps in (10, 50):
+        n = min(48, qps * 2)
+        lat_ms: list = []
+        lock = threading.Lock()
+        t_start = time.time() + 0.05
+
+        def arrival(i: int, _qps=qps) -> None:
+            wait = t_start + i / _qps - time.time()
+            if wait > 0:
+                time.sleep(wait)
+            lit = lits[i % len(lits)]
+            t0 = time.time()
+            res = runner.engine.execute_statement(
+                q.format(lit), session(window_ms)
+            )
+            dt = (time.time() - t0) * 1000.0
+            with lock:
+                lat_ms.append(dt)
+                if res.rows != seq_rows[lit]:
+                    drift[0] += 1
+
+        before = get_registry().snapshot()
+        ts = [threading.Thread(target=arrival, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        entry = {
+            "p50_ms": _percentile(lat_ms, 50),
+            "p99_ms": _percentile(lat_ms, 99),
+        }
+        entry.update(
+            _batched_dispatch_delta(before, get_registry().snapshot())
+        )
+        open_out[f"qps_{qps}"] = entry
+    out["open_loop"] = open_out
+    out["row_drift"] = drift[0]
+    out["identical"] = drift[0] == 0
+    return out
+
+
 def _subprocess_entry(call: str, timeout_s: int) -> dict:
     """Run ``bench_suite.<call>`` in a fresh python, hard-killed on
     timeout (a cancelled XLA compile holds the chip: the child must DIE,
@@ -253,6 +437,7 @@ def run_suite() -> dict:
     suite["parquet_table_cache"] = _subprocess_entry(
         "parquet_table_cache()", 420
     )
+    suite["concurrency"] = _subprocess_entry("bench_concurrency()", 420)
     suite["suite_wall_s"] = round(time.time() - t0, 1)
     return suite
 

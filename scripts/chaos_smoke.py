@@ -11,7 +11,13 @@ Boots a 2-worker cluster and runs three scenarios:
    ``speculation=true`` — the straggler detector must hedge at least
    one attempt onto the healthy worker, results stay bit-identical,
    and the speculative counters land in the summary line.
-4. ``node-death`` (runs last — a worker does not survive it): with
+4. ``concurrent-clients``: N threads fire literal-variant aggregations
+   with cross-query batching enabled (``batch_window_ms``>0,
+   ``execution_mode=distributed`` so the coordinator's own engine — the
+   tier that batches — executes them). Every concurrent result must be
+   bit-identical to its sequential run; batched-dispatch counters land
+   in the summary line.
+5. ``node-death`` (runs last — a worker does not survive it): with
    ``retry_policy=TASK`` + ``exchange_spooling=true``, the worker that
    ran Q1's scan fragment ``os._exit``s right after that task finishes
    (``fault_worker_exit_site=2.0``; every task stalls 1s pre-execute so
@@ -48,6 +54,14 @@ Q1 = """select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
 # fault injection are exercised together
 Q_SKEW = """select count(*) as c, sum(o.o_totalprice * c.c_custkey) as chk
        from orders o join customer c on least(o.o_custkey, 100) = c.c_custkey"""
+
+# literal-variant shape for the concurrent-clients scenario: the four
+# threads differ only in the hoisted comparison literal, so their plans
+# share one canonical fingerprint and are batchable; ORDER BY pins row
+# order (skew handling is off inside a batched dispatch)
+Q_BATCH = """select l_returnflag, count(*) as c, sum(l_quantity) as s
+       from lineitem where l_quantity < {} group by l_returnflag
+       order by l_returnflag"""
 
 
 def main() -> int:
@@ -109,6 +123,43 @@ def main() -> int:
                 Q_SKEW, session_properties={**chaos, **skew_props}
             )
             slow_spec, _ = runner.execute(Q1, session_properties=slow_props)
+            # concurrent-clients: sequential ground truth first, then N
+            # threads with batching on; coordinator-local execution
+            # (execution_mode=distributed) is where the collector lives
+            import threading
+
+            batch_lits = (10, 20, 30, 40)
+            batch_props = {
+                "execution_mode": "distributed",
+                "batch_window_ms": 300,
+                "batch_max_size": len(batch_lits),
+            }
+            seq_batch = {
+                lit: runner.execute(
+                    Q_BATCH.format(lit),
+                    session_properties={"execution_mode": "distributed"},
+                )[0]
+                for lit in batch_lits
+            }
+            conc_rows: dict = {}
+            conc_errs: dict = {}
+
+            def _client(lit: int) -> None:
+                try:
+                    conc_rows[lit] = runner.execute(
+                        Q_BATCH.format(lit), session_properties=batch_props
+                    )[0]
+                except Exception as e:  # noqa: BLE001
+                    conc_errs[lit] = str(e)
+
+            cthreads = [
+                threading.Thread(target=_client, args=(lit,))
+                for lit in batch_lits
+            ]
+            for t in cthreads:
+                t.start()
+            for t in cthreads:
+                t.join()
             # LAST scenario: one worker dies mid-query and stays dead
             death, _ = runner.execute(Q1, session_properties=death_props)
             from trino_tpu.server import auth
@@ -151,6 +202,16 @@ def main() -> int:
                 device["peak_hbm_bytes"], int(ds.get("peak_hbm_bytes") or 0)
             )
         summary["device"] = device
+        # cross-query batching counters (size-labelled dispatch family)
+        batched_counters = {
+            k: v
+            for k, v in summary.get("metrics", {})
+            .get("counters", {})
+            .items()
+            if k.startswith("trino_tpu_batched_dispatches_total")
+        }
+        summary["batched_dispatches"] = batched_counters
+        summary["concurrent_clients"] = len(batch_lits)
         summary.update(
             seed=seed,
             rows=len(chaotic),
@@ -181,6 +242,21 @@ def main() -> int:
             print("FAIL: slow-worker speculative result differs from fault-free")
             summary["ok"] = False
             return 1
+        if conc_errs:
+            print(f"FAIL: concurrent-clients errors: {conc_errs}")
+            summary["ok"] = False
+            return 1
+        for lit in batch_lits:
+            if sorted(conc_rows[lit]) != sorted(seq_batch[lit]):
+                print(
+                    "FAIL: concurrent-clients row drift at literal"
+                    f" {lit} (batched vs sequential)"
+                )
+                summary["ok"] = False
+                return 1
+        if not batched_counters:
+            print("WARN: no batched dispatches — the window never"
+                  " collected concurrent arrivals")
         if death != clean:
             print("FAIL: node-death result differs from fault-free")
             summary["ok"] = False
@@ -201,7 +277,8 @@ def main() -> int:
             print("WARN: no speculative attempts — straggler never flagged")
         print(
             "OK: bit-identical under 30% task-crash injection"
-            " (incl. skewed join, 10x slow worker, node death)"
+            " (incl. skewed join, 10x slow worker, concurrent batched"
+            " clients, node death)"
         )
         summary["ok"] = True
         return 0

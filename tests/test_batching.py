@@ -1,0 +1,392 @@
+"""Cross-query device batching (exec/batching.py + engine wiring).
+
+Covers the batching contract end to end: K concurrent literal-variant
+queries share ONE stacked dispatch and stay bit-identical to their
+sequential runs; a failing batched attempt falls back to sequential
+per-member execution where a guilty member fails ALONE;
+``batch_window_ms=0`` (the default) degrades to today's single-query
+path; and the event-driven resource-group admission that fronts it.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.config import Session
+from trino_tpu.connectors.api import ColumnSchema, TableSchema
+from trino_tpu.testing import DistributedQueryRunner
+
+
+def _add_table(runner, name: str, rows: int = 2048, seed: int = 7) -> None:
+    mem = runner.catalogs.get("memory")
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 32, rows).astype(np.int64)
+    v = rng.integers(0, 1000, rows).astype(np.int64)
+    mem.create_table(
+        "default", name,
+        TableSchema(name, (ColumnSchema("k", T.BIGINT),
+                           ColumnSchema("v", T.BIGINT))),
+    )
+    mem.insert("default", name,
+               Batch([Column(T.BIGINT, k), Column(T.BIGINT, v)], rows))
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = DistributedQueryRunner(
+        Session(user="t", catalog="memory", schema="default")
+    )
+    _add_table(r, "bt_facts")
+    return r
+
+
+# ORDER BY pins row order: skew handling is disabled inside a batched
+# dispatch, so unsorted output order is not part of the contract
+Q = ("select k, sum(v), count(*) from memory.default.bt_facts"
+     " where v < {} group by k order by k")
+
+
+def _batch_session(runner, window_ms: int = 5000, max_size: int = 4):
+    s = Session(user="t", catalog="memory", schema="default")
+    for k, v in runner.session.properties.items():
+        s.properties[k] = v
+    s.properties["batch_window_ms"] = window_ms
+    s.properties["batch_max_size"] = max_size
+    return s
+
+
+def _run_concurrent(runner, lits, session_fn):
+    """Issue one query per literal from its own thread; the size-
+    triggered flush (max_size == len(lits)) makes collection
+    deterministic — no timing dependence on the window."""
+    results: dict = {}
+    errors: dict = {}
+
+    def work(lit):
+        try:
+            results[lit] = runner.engine.execute_statement(
+                Q.format(lit), session_fn()
+            )
+        except Exception as e:  # noqa: BLE001
+            errors[lit] = e
+
+    ts = [threading.Thread(target=work, args=(lit,)) for lit in lits]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return results, errors
+
+
+# --- bit-identity -----------------------------------------------------------
+
+
+def test_batched_bit_identical_to_sequential(runner):
+    lits = (100, 250, 500, 750)
+    seq = {
+        lit: runner.engine.execute_statement(Q.format(lit), runner.session)
+        for lit in lits
+    }
+    results, errors = _run_concurrent(
+        runner, lits,
+        lambda: _batch_session(runner, max_size=len(lits)),
+    )
+    assert not errors, errors
+    for lit in lits:
+        assert results[lit].rows == seq[lit].rows
+        bs = results[lit].batch_stats
+        assert bs is not None
+        assert bs["batchSize"] == len(lits)
+        assert bs["batchedQueries"] == len(lits)
+        assert bs["batchWaitMs"] >= 0.0
+        # the shared dispatch reports itself in the exchange stats too
+        ex = results[lit].exchange_stats or {}
+        assert ex.get("batchedQueries") == len(lits)
+
+
+def test_batched_dispatch_counter_and_span(runner):
+    from trino_tpu.obs.metrics import get_registry
+
+    lits = (111, 222)
+    key = 'trino_tpu_batched_dispatches_total{size="2"}'
+    before = get_registry().snapshot()["counters"].get(key, 0)
+    results, errors = _run_concurrent(
+        runner, lits, lambda: _batch_session(runner, max_size=2)
+    )
+    assert not errors, errors
+    after = get_registry().snapshot()["counters"].get(key, 0)
+    assert after == before + 1
+
+
+# --- degradation ------------------------------------------------------------
+
+
+def test_window_zero_is_todays_behavior(runner):
+    """batch_window_ms=0 (the default) must not touch the collector."""
+    calls = []
+    orig = runner.engine.batch_collector.submit
+    runner.engine.batch_collector.submit = (
+        lambda *a, **k: calls.append(1) or orig(*a, **k)
+    )
+    try:
+        res = runner.engine.execute_statement(
+            Q.format(300), runner.session
+        )
+    finally:
+        runner.engine.batch_collector.submit = orig
+    assert calls == []
+    assert res.batch_stats is None
+
+
+def test_solo_query_in_window_runs_single(runner):
+    """A lone query inside an open window executes the normal single
+    path (K == 1): no batch stats, same rows."""
+    seq = runner.engine.execute_statement(Q.format(421), runner.session)
+    res = runner.engine.execute_statement(
+        Q.format(421), _batch_session(runner, window_ms=30, max_size=8)
+    )
+    assert res.rows == seq.rows
+    assert res.batch_stats is None
+
+
+# --- failure isolation ------------------------------------------------------
+
+
+def test_batched_failure_falls_back_sequentially(runner):
+    """A batched attempt that dies falls back to per-member sequential
+    execution — every member still gets its correct result."""
+    from trino_tpu.engine import Engine
+
+    lits = (120, 340, 560)
+    seq = {
+        lit: runner.engine.execute_statement(Q.format(lit), runner.session)
+        for lit in lits
+    }
+    orig = Engine._execute_query_plan_batched
+
+    def boom(self, *a, **k):
+        raise RuntimeError("injected batch failure")
+
+    Engine._execute_query_plan_batched = boom
+    try:
+        results, errors = _run_concurrent(
+            runner, lits,
+            lambda: _batch_session(runner, max_size=len(lits)),
+        )
+    finally:
+        Engine._execute_query_plan_batched = orig
+    assert not errors, errors
+    for lit in lits:
+        assert results[lit].rows == seq[lit].rows
+        assert results[lit].batch_stats is None  # sequential fallback
+
+
+def test_failing_member_fails_alone(runner):
+    """When the batch falls back to sequential execution, a member
+    whose own run raises fails ALONE — batchmates stay correct."""
+    from trino_tpu.engine import Engine
+
+    lits = (130, 350, 570)
+    seq = {
+        lit: runner.engine.execute_statement(Q.format(lit), runner.session)
+        for lit in lits
+    }
+    victim = [350]
+    orig_batched = Engine._execute_query_plan_batched
+    orig_single = Engine._execute_query_plan
+
+    def boom(self, *a, **k):
+        raise RuntimeError("injected batch failure")
+
+    def poisoned_single(self, plan, session, *a, **k):
+        params = k.get("params") or []
+        if any(v in victim for v, _ in params):
+            raise RuntimeError("injected member failure")
+        return orig_single(self, plan, session, *a, **k)
+
+    Engine._execute_query_plan_batched = boom
+    Engine._execute_query_plan = poisoned_single
+    try:
+        results, errors = _run_concurrent(
+            runner, lits,
+            lambda: _batch_session(runner, max_size=len(lits)),
+        )
+    finally:
+        Engine._execute_query_plan_batched = orig_batched
+        Engine._execute_query_plan = orig_single
+    assert set(errors) == {350}
+    assert "injected member failure" in str(errors[350])
+    for lit in (130, 570):
+        assert results[lit].rows == seq[lit].rows
+
+
+# --- collector unit behavior ------------------------------------------------
+
+
+def test_window_timeout_flushes_partial_batch(runner):
+    """A leader whose window expires dispatches whatever joined — here
+    just itself — rather than waiting for max_size forever."""
+    t0 = time.monotonic()
+    res = runner.engine.execute_statement(
+        Q.format(777), _batch_session(runner, window_ms=50, max_size=64)
+    )
+    assert res.rows  # executed, did not hang
+    assert time.monotonic() - t0 < 30.0
+
+
+def test_incompatible_sessions_do_not_share_a_batch(runner):
+    """Same fingerprint but a different session signature (a capacity
+    override) must land in a different group: programs traced under
+    different caps are different programs."""
+    def plain():
+        return _batch_session(runner, max_size=2)
+
+    def tweaked():
+        s = _batch_session(runner, max_size=2)
+        s.properties["batch_capacity"] = 1 << 15
+        return s
+
+    results: dict = {}
+    errors: dict = {}
+
+    def work(name, fn, lit):
+        try:
+            results[name] = runner.engine.execute_statement(
+                Q.format(lit), fn()
+            )
+        except Exception as e:  # noqa: BLE001
+            errors[name] = e
+
+    ts = [
+        threading.Thread(target=work, args=("a", plain, 140)),
+        threading.Thread(target=work, args=("b", tweaked, 160)),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors, errors
+    # neither saw a 2-batch: signatures differ, windows expired solo
+    assert results["a"].batch_stats is None
+    assert results["b"].batch_stats is None
+
+
+# --- event-driven admission (resourcegroups.submit) -------------------------
+
+
+def _make_manager(limit=1, queued=10, wait=5.0):
+    from trino_tpu.server.resourcegroups import (
+        GroupConfig,
+        ResourceGroupManager,
+        Selector,
+    )
+
+    mgr = ResourceGroupManager(max_wait_seconds=wait)
+    mgr.configure(
+        [GroupConfig("root", max_queued=queued, hard_concurrency_limit=limit)],
+        [Selector(group="root")],
+    )
+    return mgr
+
+
+def test_submit_admits_when_slot_free():
+    mgr = _make_manager(limit=2)
+    group, admitted = mgr.submit("alice", "", lambda g, e: None)
+    assert admitted and group.running == 1
+    mgr.finish(group)
+    assert group.running == 0
+
+
+def test_submit_queues_and_fires_callback_outside_lock():
+    mgr = _make_manager(limit=1)
+    g1, admitted = mgr.submit("alice", "", lambda g, e: None)
+    assert admitted
+    fired: list = []
+
+    def ready(group, err):
+        # proof the callback runs OUTSIDE the manager lock: re-entering
+        # the manager from the callback must not deadlock
+        fired.append((group.full_name, err, mgr.summary()))
+
+    g2, admitted2 = mgr.submit("alice", "", ready)
+    assert not admitted2
+    assert mgr.summary()["root"]["queuedQueries"] == 1
+    mgr.finish(g1)  # frees the slot -> fires ready on this thread
+    assert len(fired) == 1
+    assert fired[0][0] == "root" and fired[0][1] is None
+    assert g2.running == 1
+    mgr.finish(g2)
+
+
+def test_submit_queue_full_raises():
+    from trino_tpu.server.resourcegroups import QueryQueueFullError
+
+    mgr = _make_manager(limit=1, queued=1)
+    mgr.submit("alice", "", lambda g, e: None)
+    mgr.submit("alice", "", lambda g, e: None)  # queued
+    with pytest.raises(QueryQueueFullError, match="Too many queued"):
+        mgr.submit("alice", "", lambda g, e: None)
+
+
+def test_submit_expired_waiter_fires_timeout_error():
+    from trino_tpu.server.resourcegroups import QueryQueueFullError
+
+    mgr = _make_manager(limit=1, wait=0.05)
+    g1, _ = mgr.submit("alice", "", lambda g, e: None)
+    errs: list = []
+    mgr.submit("alice", "", lambda g, e: errs.append(e))
+    time.sleep(0.1)  # waiter expires; reaping is opportunistic
+    mgr.finish(g1)  # next activity reaps and fires the timeout
+    assert len(errs) == 1
+    assert isinstance(errs[0], QueryQueueFullError)
+    assert "maximum queue wait" in str(errs[0])
+    # the expired waiter must NOT have been admitted
+    assert mgr.summary()["root"]["runningQueries"] == 0
+
+
+def test_queue_wait_gauges_published():
+    from trino_tpu.obs.metrics import get_registry
+
+    mgr = _make_manager(limit=1)
+    g1, _ = mgr.submit("alice", "", lambda g, e: None)
+    mgr.submit("alice", "", lambda g, e: None)
+    snap = get_registry().snapshot()
+    assert snap["gauges"]['trino_tpu_resource_group_queued{group="root"}'] == 1
+    assert snap["gauges"]['trino_tpu_resource_group_running{group="root"}'] == 1
+    mgr.finish(g1)
+    snap = get_registry().snapshot()
+    assert snap["gauges"]['trino_tpu_resource_group_queued{group="root"}'] == 0
+    # the admitted waiter's wait landed in the histogram
+    assert any(
+        k.startswith("trino_tpu_resource_group_queue_wait_ms")
+        for k in snap["histograms"]
+    )
+    # the woken waiter's (tiny) wait accrued to the group's total
+    assert g1.total_queued_time > 0.0
+
+
+def test_queued_ms_uses_monotonic_interval():
+    """queuedMs must come from monotonic interval math — a wall-clock
+    step between create and start must not corrupt it."""
+    from trino_tpu.server.querymanager import ManagedQuery
+
+    class _Eng:
+        event_listeners = None
+
+        def execute_statement(self, sql, session):
+            from trino_tpu.engine import StatementResult
+
+            return StatementResult([], [], [])
+
+    q = ManagedQuery("select 1", Session(user="t"))
+    # simulate a wall clock stepped 1h backward during the queue wait:
+    # the old epoch-delta math would clamp to 0 or explode; monotonic
+    # interval math stays at the true (tiny) wait
+    q.create_time = time.time() + 3600.0
+    q.run(_Eng())
+    stats = q._query_stats(0.0, {})
+    assert 0 <= stats["queuedMs"] < 5000

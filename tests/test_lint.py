@@ -216,6 +216,22 @@ def test_host_pull_in_nested_scope_is_fine(tmp_path):
     assert "JIT007" not in _rules(vs)
 
 
+def test_batch_demux_pull_is_allowlisted(tmp_path):
+    # the batch demultiplexer interleaves a packed pull with further
+    # dispatches BY DESIGN (one D2H fans results out to K members) —
+    # the exact same shape under any other name is still a violation
+    src = """
+        def {name}(executor, frag_a, frag_b, inputs, layouts):
+            a = executor.run_fragment_program_batched(frag_a, inputs, layouts)
+            rows = a.batch.to_host()
+            return executor.run_fragment_program_batched(frag_b, {{"remote": rows}}, layouts)
+        """
+    flagged = _lint_source(tmp_path, src.format(name="drive_batch"))
+    assert "JIT007" in _rules(flagged)
+    allowed = _lint_source(tmp_path, src.format(name="_demux_batch_to_host"))
+    assert "JIT007" not in _rules(allowed)
+
+
 def test_inline_suppression_comment(tmp_path):
     vs = _lint_source(
         tmp_path,

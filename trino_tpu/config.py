@@ -209,6 +209,15 @@ class Session:
         # splits); warm repeat scans issue zero H2D bytes
         ("table_cache", True),
         ("table_cache_max_bytes", 1 << 30),
+        # --- cross-query device batching (exec/batching.py) ----------------
+        # hold compatible queries (same canonical-plan fingerprint,
+        # differing only in hoisted literals) for a short window and
+        # execute ONE stacked dispatch through the cached program,
+        # demultiplexing K result sets — bit-identical to K sequential
+        # runs. 0 disables collection entirely (today's behavior).
+        ("batch_window_ms", 0),
+        # flush a collecting batch early once this many members joined
+        ("batch_max_size", 16),
     )
 
     def get(self, name: str) -> Any:

@@ -61,6 +61,10 @@ class StatementResult:
     # coalesced H2D bytes/transfers, device-table-cache hits/misses —
     # surfaced in /v1/query as ``ingestStats``; None when no scan ran
     ingest_stats: Optional[dict[str, Any]] = None
+    # cross-query batching (exec/batching.py): batchedQueries/batchSize/
+    # batchWaitMs for queries that shared a stacked dispatch — surfaced
+    # in /v1/query queryStats; None when the query ran alone
+    batch_stats: Optional[dict[str, Any]] = None
 
 
 class Engine:
@@ -135,6 +139,13 @@ class Engine:
         from trino_tpu.ingest import DeviceTableCache
 
         self.table_cache = DeviceTableCache()
+        # cross-query batch collector (exec/batching.py): when
+        # batch_window_ms > 0, compatible queries (same canonical-plan
+        # fingerprint, differing only in hoisted literals) wait here for
+        # a short window and share ONE stacked device dispatch
+        from trino_tpu.exec.batching import BatchCollector
+
+        self.batch_collector = BatchCollector(self)
 
     _QUERY_CACHE_MAX = 64
     # statements whose results depend on evaluation time/randomness must
@@ -406,6 +417,11 @@ class Engine:
             res.program_cache_misses
         )
         for key, val in (res.exchange_stats or {}).items():
+            # batchedQueries is shared verbatim by every member of a
+            # batched dispatch — summing K copies of K is meaningless;
+            # trino_tpu_batched_dispatches_total{size} is the real counter
+            if key == "batchedQueries":
+                continue
             if isinstance(val, (int, float)) and not isinstance(val, bool):
                 reg.counter(f"trino_tpu_exchange_{key}_total").inc(val)
         ds = res.device_stats or {}
@@ -471,6 +487,24 @@ class Engine:
                     entry = self._query_cache_entry(fp)
                 else:
                     params = []  # unserializable shape: run baked, uncached
+            # cross-query batching: when the session opts in, compatible
+            # queries (same fingerprint + same session signature) wait in
+            # the collector for a short window and share ONE stacked
+            # device dispatch through the cached programs. Transactions
+            # are excluded (snapshot semantics are per-statement), and
+            # window=0 — the default — keeps the path below verbatim.
+            if (
+                entry is not None
+                and int(session.get("batch_window_ms")) > 0
+                and "__txn" not in session.properties
+            ):
+                return self.batch_collector.submit(
+                    entry,
+                    exec_plan,
+                    session,
+                    params,
+                    query_id or self._next_query_id(),
+                )
             # shared program stores and capacity objects are not safe for
             # concurrent executors: a second in-flight run of the same
             # fingerprint executes uncached instead of waiting
@@ -598,6 +632,74 @@ class Engine:
                 device_stats=dsnap() if callable(dsnap) else None,
                 ingest_stats=executor.ingest_stats_snapshot(),
             )
+        finally:
+            ctx.close()
+
+    def _execute_query_plan_batched(
+        self,
+        plan: P.PlanNode,
+        session: Session,
+        query_ids: list[str],
+        param_lists: list[list],
+        programs: Optional[dict] = None,
+    ) -> list[StatementResult]:
+        """Run K literal-variant queries of the SAME cached plan as one
+        stacked device dispatch, one StatementResult per member in
+        submission order.
+
+        One memory context and one FragmentedExecutor serve the whole
+        batch, so exchange/compile/device snapshots are shared across the
+        K results (each member reports the batch's dispatch, not a
+        pro-rated share). Raises BatchUnsupported — or any execution
+        error — for exec/batching.py to fall back to sequential runs.
+        """
+        from trino_tpu.exec.fragments import (
+            BatchUnsupported,
+            FragmentedExecutor,
+        )
+        from trino_tpu.memory import QueryMemoryContext
+
+        ctx = QueryMemoryContext(
+            self.memory_pool,
+            query_ids[0],
+            max_bytes=int(session.get("query_max_memory_bytes")),
+        )
+        try:
+            executor = self._executor(
+                session, ctx, programs=programs, params=param_lists[0]
+            )
+            if not isinstance(executor, FragmentedExecutor):
+                raise BatchUnsupported("fragment execution disabled")
+            param_sets = [[v for v, _ in pl] for pl in param_lists]
+            batches, names = executor.execute_batched(plan, param_sets)
+            snap = getattr(executor, "exchange_stats_snapshot", None)
+            exchange_stats = snap() if callable(snap) else (
+                dict(executor.exchange_stats)
+                if getattr(executor, "exchange_stats", None)
+                else None
+            )
+            cs = getattr(executor, "compile_stats", None) or {}
+            dsnap = getattr(executor, "device_stats_snapshot", None)
+            device_stats = dsnap() if callable(dsnap) else None
+            ingest_stats = executor.ingest_stats_snapshot()
+            return [
+                StatementResult(
+                    batch.to_pylist(),
+                    list(names),
+                    [c.type for c in batch.columns],
+                    peak_memory_bytes=ctx.peak_bytes,
+                    exchange_stats=exchange_stats,
+                    compile_ms=round(float(cs.get("compile_ms", 0.0)), 3),
+                    trace_count=int(cs.get("trace_count", 0)),
+                    program_cache_hits=int(cs.get("program_cache_hits", 0)),
+                    program_cache_misses=int(
+                        cs.get("program_cache_misses", 0)
+                    ),
+                    device_stats=device_stats,
+                    ingest_stats=ingest_stats,
+                )
+                for batch in batches
+            ]
         finally:
             ctx.close()
 

@@ -68,6 +68,13 @@ class FusedUnsupported(Exception):
     """Raised during tracing when a shape turns out not to be fusable."""
 
 
+class BatchUnsupported(Exception):
+    """Raised when a plan or input shape cannot ride the cross-query
+    batched (K-unrolled) dispatch path — streaming-sized scans, spill
+    inputs, multi-host meshes, non-fusable plans. Callers fall back to
+    sequential per-member execution bit-identically."""
+
+
 class CapacityRetryExceeded(ExecutionError):
     """Capacity-overflow retry budget exhausted.
 
@@ -340,6 +347,11 @@ class _Meta:
     # AOT path failed, or a later call saw different input shapes)
     device_stats: Optional[dict] = None
     aot: Any = None
+    # cross-query batching: K > 0 marks a batched program whose outputs
+    # are per-member tuples — _retry_traced demuxes them into K Results
+    # instead of assembling one (rides the cached (jf, meta) entry, so
+    # warm hits demux without retracing)
+    batch_size: Optional[int] = None
 
     def capture(self, res: Result, tracer) -> None:
         self.layout = dict(res.layout)
@@ -381,6 +393,44 @@ class _TracerSummary:
             self.exchange_static[k] = self.exchange_static.get(k, 0) + v
 
 
+class _BatchSummary:
+    """Combined view over the K per-member tracers of a cross-query
+    batched program, duck-typed like :class:`_TracerSummary`. The K
+    members are copies of ONE program over different parameter slices,
+    so their overflow/counter site lists are identical — flags merge
+    positionally by element-wise max (a site overflows when ANY member
+    overflows; the grown rerun re-executes all members) and counters
+    sum, keeping the host-side deferred-flag protocol at one scalar per
+    site whatever K. Static exchange stats sum; ``aux_out`` stays empty
+    (skew handling is disabled under batching)."""
+
+    def __init__(self):
+        self.overflows: list = []
+        self.counters: list = []
+        self.exchange_static: dict = {}
+        self.aux_out: tuple = ()
+        self._first = True
+
+    def absorb(self, tracer) -> None:
+        if self._first:
+            self.overflows = [
+                (nm, f.astype(jnp.int32)) for nm, f in tracer.overflows
+            ]
+            self.counters = list(tracer.counters)
+            self._first = False
+        else:
+            self.overflows = [
+                (nm, jnp.maximum(f, g.astype(jnp.int32)))
+                for (nm, f), (_, g) in zip(self.overflows, tracer.overflows)
+            ]
+            self.counters = [
+                (nm, c + d)
+                for (nm, c), (_, d) in zip(self.counters, tracer.counters)
+            ]
+        for k, v in tracer.exchange_static.items():
+            self.exchange_static[k] = self.exchange_static.get(k, 0) + v
+
+
 def program_label(program_key) -> str:
     """Stable display label for a program-cache key: fragment identity
     without the per-run root-object id (metrics labels and deviceStats
@@ -392,6 +442,14 @@ def program_label(program_key) -> str:
             return f"post:{program_key[1]}"
         if program_key[0] == "fused":
             return "fused:" + "+".join(str(i) for i in program_key[1])
+        if program_key[0] == "bfrag":
+            return f"bfrag:{program_key[1]}x{program_key[2]}"
+        if program_key[0] == "bfused":
+            return (
+                "bfused:"
+                + "+".join(str(i) for i in program_key[1])
+                + f"x{program_key[2]}"
+            )
     return repr(program_key)
 
 
@@ -1456,6 +1514,22 @@ class FragmentedExecutor(DistributedExecutor):
             for nm, f in zip(meta.overflow_names, flags_np):
                 if f:
                     grow_or_raise(nm, caps)
+        if meta.batch_size:
+            # batched program: data/sel are tuples over the K members —
+            # demux into one Result per member (all members share the
+            # column meta and layout captured at trace time, since they
+            # are copies of one program)
+            out = []
+            for mdata, msel in zip(data, sel):
+                cols = [
+                    Column(t, d, v, dictionary)
+                    for (d, v), (t, dictionary) in zip(
+                        mdata, meta.column_meta
+                    )
+                ]
+                cap = cols[0].data.shape[0] if cols else int(msel.shape[0])
+                out.append(Result(Batch(cols, cap, msel), meta.layout))
+            return out
         cols = [
             Column(t, d, v, dictionary)
             for (d, v), (t, dictionary) in zip(data, meta.column_meta)
@@ -1659,6 +1733,451 @@ class FragmentedExecutor(DistributedExecutor):
             ),
             defer=defer,
         )
+
+    # === cross-query batched dispatch ===================================
+
+    def execute_batched(
+        self, node: P.PlanNode, param_sets: Sequence[Sequence]
+    ) -> tuple[list[Batch], list[str]]:
+        """Execute K literal-variant queries as ONE stacked dispatch.
+
+        ``param_sets`` holds one hoisted-literal vector per query, all
+        canonicalizing to the plan this executor was built for. The K
+        member executions unroll inside a single ``jax.jit`` trace —
+        identical ops over different ``__params__`` slices — so every
+        member's result is bit-identical to its sequential run while the
+        whole batch pays one dispatch round-trip, one program-cache
+        lookup, and one device->host pull. Returns (batches, names):
+        one compacted host Batch per member, in submission order.
+
+        Dynamic filtering and skew salting are disabled on this path
+        (both rebuild per-execution state that would couple members or
+        churn program keys); the losses are pruning/padding only, never
+        results. Raises :class:`BatchUnsupported` for shapes the path
+        cannot carry — non-fusable plans, streaming/spill-sized scans,
+        multi-host meshes — and callers fall back to sequential
+        per-member execution.
+        """
+        if jax.process_count() > 1:
+            raise BatchUnsupported("multi-host mesh")
+        if self.stats_collector is not None:
+            raise BatchUnsupported("stats collector attached")
+        if not self._param_list:
+            raise BatchUnsupported("no hoisted parameters")
+        sub = self.programs.get("__subplan__")
+        if sub is None:
+            with get_tracer().span("fragment"):
+                sub = fragment_plan(node)
+            self.programs["__subplan__"] = sub
+        if not query_fusable(sub):
+            raise BatchUnsupported("plan not fusable")
+        if self._fusion_blocked(sub):
+            raise BatchUnsupported("streaming/spill-sized scan")
+        try:
+            return self._execute_fragments_batched(sub, list(param_sets))
+        except FusedUnsupported as e:
+            raise BatchUnsupported(str(e)) from e
+        except jax.errors.TracerArrayConversionError as e:
+            raise BatchUnsupported("host values needed mid-trace") from e
+
+    def _execute_fragments_batched(
+        self, sub: SubPlan, param_sets: list
+    ) -> tuple[list[Batch], list[str]]:
+        import time as _time
+
+        kreq = len(param_sets)
+        # bucket K to a power of two, padding with copies of member 0
+        # (only the first kreq results are returned): every distinct K is
+        # a separately traced program, so quantizing batch sizes keeps
+        # the program store small exactly like the capacity buckets do
+        K = 1
+        while K < kreq:
+            K *= 2
+        padded = list(param_sets) + [param_sets[0]] * (K - kreq)
+        pstack = tuple(
+            jnp.asarray([ps[i] for ps in padded], dtype=t.storage_dtype)
+            for i, (_, t) in enumerate(self._param_list)
+        )
+        results: dict[int, list[Result]] = {}
+        names_holder: dict[int, list[str]] = {}
+        units = self._fusion_units(sub)
+
+        def run_units():
+            for unit in units:
+                if isinstance(unit, FusedFragment):
+                    results[unit.id] = self._run_fused_unit_batched(
+                        unit, K, pstack, results, names_holder
+                    )
+                else:
+                    results[unit.id] = self._run_fragment_batched(
+                        unit, K, pstack, results, names_holder
+                    )
+
+        # same optimistic deferred-flag protocol as _execute_fragments:
+        # flags are already max-merged across members in-trace, so the
+        # host still checks one scalar per site in one transfer
+        attempts = 0
+        while True:
+            attempts += 1
+            if attempts > 12:
+                raise CapacityRetryExceeded(
+                    "batched-query",
+                    fragment_id=sub.fragment.id,
+                    capacities=self._all_capacities(),
+                    attempts=attempts - 1,
+                )
+            self.deferred_flags = []
+            self.deferred_counters = []
+            results.clear()
+            names_holder.clear()
+            run_units()
+            roots = results[sub.fragment.id]
+            deferred = self.deferred_flags
+            dcounters = self.deferred_counters
+            self.deferred_flags = None
+            self.deferred_counters = None
+            extras = [
+                jnp.ravel(f.astype(jnp.int32)) for _, _, f, _ in deferred
+            ] + [jnp.ravel(c) for _, c, _ in dcounters if c is not None]
+            t_pull = _time.perf_counter()
+            host_batches, extra_vals = self._demux_batch_to_host(
+                roots, extras
+            )
+            pull_ms = (_time.perf_counter() - t_pull) * 1000.0
+            get_tracer().record(
+                "device_pull", pull_ms,
+                attrs={
+                    "extras": len(extras),
+                    "attempt": attempts,
+                    "batch": K,
+                },
+            )
+            get_registry().histogram("trino_tpu_device_pull_ms").observe(
+                pull_ms
+            )
+            flag_vals = extra_vals[: len(deferred)]
+            counter_vals = list(extra_vals[len(deferred):])
+            overflowed = False
+            for (key, names, _, caps), seg in zip(deferred, flag_vals):
+                seg = np.atleast_1d(np.asarray(seg))
+                for nm, fl in zip(names, seg):
+                    if fl:
+                        overflowed = True
+                        grow_or_raise(nm, caps)
+            if not overflowed:
+                for names, stacked, static in dcounters:
+                    vals = (
+                        np.atleast_1d(np.asarray(counter_vals.pop(0)))
+                        if stacked is not None
+                        else ()
+                    )
+                    self._accumulate_exchange(names, vals, static)
+                break
+            self.exchange_stats["overflow_retries"] += 1
+        self.exchange_stats["batchedQueries"] = kreq
+        outs = [b.compact() for b in host_batches[:kreq]]
+        names = names_holder.get(sub.fragment.id) or [
+            s.name for s in sub.fragment.root.output_symbols
+        ]
+        return outs, names
+
+    def _demux_batch_to_host(self, roots: list, extras: list):
+        """ONE device->host pull for the whole batched dispatch: members
+        1..K-1's column arrays, validity lanes, and selection masks (plus
+        the deferred overflow/counter extras) ride member 0's packed
+        ``Batch.to_host`` transfer; host batches are reassembled per
+        member afterward. Returns (host_batches, extra_values)."""
+        packed: list = list(extras)
+        plan: list[list[bool]] = []  # per tail member: has-valid per column
+        for r in roots[1:]:
+            spec = []
+            for c in r.batch.columns:
+                packed.append(c.data)
+                if c.valid is not None:
+                    packed.append(c.valid)
+                spec.append(c.valid is not None)
+            packed.append(
+                r.batch.sel
+                if r.batch.sel is not None
+                else r.batch.selection_mask()
+            )
+            plan.append(spec)
+        host_head, vals = roots[0].batch.to_host(extras=packed)
+        extra_vals = vals[: len(extras)]
+        it = iter(vals[len(extras):])
+        out = [host_head]
+        for r, spec in zip(roots[1:], plan):
+            cols = []
+            for c, has_valid in zip(r.batch.columns, spec):
+                data = next(it)
+                valid = next(it) if has_valid else None
+                cols.append(Column(c.type, data, valid, c.dictionary))
+            sel = next(it)
+            out.append(Batch(cols, r.batch.num_rows, sel))
+        return out, extra_vals
+
+    def _run_fragment_batched(
+        self,
+        frag: PlanFragment,
+        K: int,
+        pstack: tuple,
+        results: dict[int, list[Result]],
+        names_holder: dict[int, list[str]],
+    ) -> list[Result]:
+        span = get_tracer().start_span(
+            "fragment_execute", attrs={"stage": frag.id, "batch": K}
+        )
+        with span:
+            inputs: dict[str, Any] = {}
+            input_layouts: dict[str, dict[str, int]] = {}
+            spill_threshold = (
+                int(self.session.get("spill_threshold_rows"))
+                if self.session.get("spill_enabled")
+                else None
+            )
+            for n in P.walk_plan(frag.root):
+                if isinstance(n, P.TableScan):
+                    res = self._exec_tablescan(n)
+                    if (
+                        spill_threshold is not None
+                        and res.batch.capacity > spill_threshold
+                    ):
+                        raise BatchUnsupported("spill-sized input")
+                    inputs[f"scan{id(n)}"] = res.batch
+                    input_layouts[f"scan{id(n)}"] = res.layout
+                elif isinstance(n, P.RemoteSource):
+                    rs = results[n.fragment_id]
+                    inputs[f"remote{n.fragment_id}"] = tuple(
+                        r.batch for r in rs
+                    )
+                    input_layouts[f"remote{n.fragment_id}"] = rs[0].layout
+                elif isinstance(n, P.Output):
+                    names_holder[frag.id] = list(n.column_names)
+            out = self.run_fragment_program_batched(
+                frag, K, pstack, inputs, input_layouts, defer=True
+            )
+            span.set("mode", "batched")
+            return out
+
+    def _run_fused_unit_batched(
+        self,
+        unit: FusedFragment,
+        K: int,
+        pstack: tuple,
+        results: dict[int, list[Result]],
+        names_holder: dict[int, list[str]],
+    ) -> list[Result]:
+        span = get_tracer().start_span(
+            "fused_execute",
+            attrs={
+                "stage": unit.id,
+                "fragments": len(unit.fragments),
+                "batch": K,
+            },
+        )
+        with span:
+            member_ids = set(unit.fragment_ids)
+            inputs: dict[str, Any] = {}
+            input_layouts: dict[str, dict[str, int]] = {}
+            spill_threshold = (
+                int(self.session.get("spill_threshold_rows"))
+                if self.session.get("spill_enabled")
+                else None
+            )
+            for frag in unit.fragments:
+                for n in P.walk_plan(frag.root):
+                    if isinstance(n, P.TableScan):
+                        res = self._exec_tablescan(n)
+                        if (
+                            spill_threshold is not None
+                            and res.batch.capacity > spill_threshold
+                        ):
+                            raise BatchUnsupported("spill-sized input")
+                        inputs[f"scan{id(n)}"] = res.batch
+                        input_layouts[f"scan{id(n)}"] = res.layout
+                    elif (
+                        isinstance(n, P.RemoteSource)
+                        and n.fragment_id not in member_ids
+                    ):
+                        rs = results[n.fragment_id]
+                        inputs[f"remote{n.fragment_id}"] = tuple(
+                            r.batch for r in rs
+                        )
+                        input_layouts[f"remote{n.fragment_id}"] = rs[0].layout
+                    elif isinstance(n, P.Output):
+                        names_holder[frag.id] = list(n.column_names)
+            out = self.run_fused_program_batched(
+                unit.fragments, K, pstack, inputs, input_layouts, defer=True
+            )
+            span.set("mode", "batched-fused")
+            get_registry().counter("trino_tpu_fused_programs_total").inc()
+            return out
+
+    def run_fragment_program_batched(
+        self,
+        frag: PlanFragment,
+        K: int,
+        pstack: tuple,
+        inputs: dict[str, Any],
+        input_layouts: dict[str, dict[str, int]],
+        apply_exchange: bool = True,
+        defer: bool = False,
+    ) -> list[Result]:
+        """K-unrolled variant of :meth:`run_fragment_program`: the build
+        closure constructs K copies of the member program inside ONE
+        ``jax.jit``, each over its own slice of the stacked parameter
+        vector — the same ops as K sequential dispatches (bit-identical
+        member results), one XLA program, one dispatch round-trip.
+        Capacities are SHARED with the single-query path, so a batch
+        benefits from (and feeds) the same overflow ladder."""
+        caps = self.programs.setdefault(("caps", frag.id), _Caps())
+        self._seed_caps(frag, caps)
+        inputs = dict(inputs)
+        inputs["__params__"] = pstack
+
+        def build(meta: _Meta):
+            def fn(inp: dict[str, Any]):
+                summary = _BatchSummary()
+                data, sels = [], []
+                res = None
+                for k in range(K):
+                    tracer = _FragmentTracer(
+                        self, _member_inputs(inp, k), input_layouts, caps
+                    )
+                    res = tracer._exec(frag.root)
+                    if apply_exchange:
+                        res = tracer.apply_output_exchange(frag, res)
+                    summary.absorb(tracer)
+                    data.append(
+                        tuple((c.data, c.valid) for c in res.batch.columns)
+                    )
+                    sels.append(res.batch.selection_mask())
+                summary.exchange_static["dispatchRoundTrips"] = 1
+                meta.capture(res, summary)
+                meta.batch_size = K
+                return (
+                    tuple(data),
+                    tuple(sels),
+                    tuple(f for _, f in summary.overflows),
+                    tuple(c for _, c in summary.counters),
+                    (),
+                )
+
+            return fn
+
+        return self._retry_traced(
+            caps,
+            build,
+            (inputs,),
+            input_rows=sum(
+                b.capacity for b in inputs.values() if isinstance(b, Batch)
+            ),
+            # 5-tuple keys bypass _store_program's stale-root eviction:
+            # batching disables dynamic filtering, so frag.root is the
+            # stable original and its id never churns
+            program_key=(
+                "bfrag", frag.id, K, apply_exchange, id(frag.root)
+            ),
+            defer=defer,
+        )
+
+    def run_fused_program_batched(
+        self,
+        frags: Sequence[PlanFragment],
+        K: int,
+        pstack: tuple,
+        inputs: dict[str, Any],
+        input_layouts: dict[str, dict[str, int]],
+        apply_exchange: bool = True,
+        defer: bool = False,
+    ) -> list[Result]:
+        """K-unrolled :meth:`run_fused_program`: each member's whole
+        fragment CHAIN (interior exchanges as in-jit collectives) unrolls
+        K times inside one program. Skew detection/salting is off under
+        batching — the hot-set handoff would couple members — so
+        exchanges run the plain two-tier (cold+spill) routing."""
+        frags = list(frags)
+        fids = tuple(f.id for f in frags)
+        caps = self.programs.setdefault(("caps", "fused", fids), _Caps())
+        for f in frags:
+            self._seed_caps(f, caps)
+        inputs = dict(inputs)
+        inputs["__params__"] = pstack
+
+        def build(meta: _Meta):
+            def fn(inp: dict[str, Any]):
+                summary = _BatchSummary()
+                data, sels = [], []
+                res = None
+                for k in range(K):
+                    avail = _member_inputs(inp, k)
+                    layouts = dict(input_layouts)
+                    member = _TracerSummary()
+                    for frag in frags:
+                        last = frag is frags[-1]
+                        tracer = _FragmentTracer(
+                            self, avail, layouts, caps
+                        )
+                        res = tracer._exec(frag.root)
+                        if not last or apply_exchange:
+                            res = tracer.apply_output_exchange(frag, res)
+                        member.absorb(tracer)
+                        if not last:
+                            avail = dict(avail)
+                            layouts = dict(layouts)
+                            avail[f"remote{frag.id}"] = res.batch
+                            layouts[f"remote{frag.id}"] = res.layout
+                    summary.absorb(member)
+                    data.append(
+                        tuple((c.data, c.valid) for c in res.batch.columns)
+                    )
+                    sels.append(res.batch.selection_mask())
+                summary.exchange_static["dispatchRoundTrips"] = 1
+                summary.exchange_static["fusedFragments"] = len(frags)
+                meta.capture(res, summary)
+                meta.batch_size = K
+                return (
+                    tuple(data),
+                    tuple(sels),
+                    tuple(f for _, f in summary.overflows),
+                    tuple(c for _, c in summary.counters),
+                    (),
+                )
+
+            return fn
+
+        return self._retry_traced(
+            caps,
+            build,
+            (inputs,),
+            input_rows=sum(
+                b.capacity for b in inputs.values() if isinstance(b, Batch)
+            ),
+            program_key=(
+                "bfused",
+                fids,
+                K,
+                apply_exchange,
+                tuple(id(f.root) for f in frags),
+            ),
+            defer=defer,
+        )
+
+
+def _member_inputs(inp: dict, k: int) -> dict:
+    """Member k's view of a batched program's inputs: shared scans pass
+    through, per-member tuples (remote feeds, the stacked ``__params__``
+    vector) slice at k — exactly the inputs dict a sequential run of
+    member k would see, as traced values."""
+    mi: dict = {}
+    for key, v in inp.items():
+        if key == "__params__":
+            mi[key] = tuple(a[k] for a in v)
+        elif isinstance(v, tuple):
+            mi[key] = v[k]
+        else:
+            mi[key] = v
+    return mi
 
 
 def _dup_key_rows(keys, sel):

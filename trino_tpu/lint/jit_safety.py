@@ -70,6 +70,22 @@ _FRAGMENT_DISPATCH = frozenset(
         "_run_fragment",
         "_run_fused_unit",
         "_run_fused_spanned",
+        "run_fragment_program_batched",
+        "run_fused_program_batched",
+        "_run_fragment_batched",
+        "_run_fused_unit_batched",
+    }
+)
+
+# functions where a host pull before a later dispatch is the POINT: the
+# cross-query batch demux pulls all K members' results in one packed
+# D2H after the stacked dispatch, and its retry loop re-dispatches on
+# capacity overflow — that pull/dispatch interleaving is the protocol,
+# not a dead sync
+_JIT007_DEMUX_ALLOWED = frozenset(
+    {
+        "_demux_batch_to_host",
+        "_execute_fragments_batched",
     }
 )
 
@@ -201,9 +217,11 @@ class _Visitor(ast.NodeVisitor):
         # dispatch follows (nested defs are their own scope, so the root
         # pull after run_units() in the driver loop stays clean)
         dispatches = self.fn_dispatches.pop()
-        for lineno, call, label in self.fn_pulls.pop():
-            if any(d > lineno for d in dispatches):
-                self._flag(call, "JIT007", label)
+        pulls = self.fn_pulls.pop()
+        if node.name not in _JIT007_DEMUX_ALLOWED:
+            for lineno, call, label in pulls:
+                if any(d > lineno for d in dispatches):
+                    self._flag(call, "JIT007", label)
         self.fn_uses_jnp.pop()
         self.stack.pop()
 

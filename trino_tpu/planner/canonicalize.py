@@ -60,7 +60,11 @@ _HOIST_FORMS = frozenset({"in", "between"})
 # different programs. ``device_profiling`` is deliberately absent: it
 # AOT-compiles the SAME jitted program (obs/profiler.py), so toggling it
 # must keep the fingerprint — and the cached program, with its captured
-# cost/memory stats riding the cache entry's _Meta — stable.
+# cost/memory stats riding the cache entry's _Meta — stable. Same for
+# ``batch_window_ms``/``batch_max_size``: they decide whether queries
+# WAIT to share a dispatch (exec/batching.py), not what any of them
+# traces — cross-query batching groups by cache entry, so the window
+# knobs must not split the fingerprint those groups key on.
 _CODEGEN_PROPS = (
     "batch_capacity",
     "broadcast_join_threshold_rows",

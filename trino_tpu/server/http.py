@@ -104,13 +104,12 @@ class TrinoTpuServer:
                 cluster_memory_limit_bytes or (64 << 30),
                 kill_fn=lambda qid, msg: self.query_manager.kill(qid, msg),
             )
+        # event-driven admission: queries queue as resource-group waiters
+        # (no parked thread per QUEUED query) and run on a bounded pool
         self.query_manager = QueryManager(
             self.engine,
             max_concurrent,
-            admit=lambda q: self.resource_groups.admit(
-                q.session.user, q.session.source
-            ),
-            complete=lambda q, group: self.resource_groups.finish(group),
+            resource_groups=self.resource_groups,
         )
         self.start_time = time.time()
         self.state = "ACTIVE"  # ACTIVE | SHUTTING_DOWN (NodeState)
@@ -525,6 +524,10 @@ def _make_handler(server: TrinoTpuServer):
                             "freeBytes": pool.free_bytes,
                         },
                         "queries": len(server.query_manager.queries()),
+                        # system.runtime.queries-style admission breakdown
+                        # (the knee is visible without running the bench)
+                        "queryCounts": server.query_manager.state_counts(),
+                        "resourceGroups": server.resource_groups.summary(),
                     }
                 )
             if path in ("/ui", "/ui/", "/"):

@@ -83,6 +83,7 @@ class ManagedQuery:
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
         self._create_mono = time.monotonic()
+        self._start_mono_ts: Optional[float] = None
         self._end_mono: Optional[float] = None
         self.last_access = time.monotonic()  # protocol touch; guards history GC
         self._cancelled = threading.Event()
@@ -109,6 +110,7 @@ class ManagedQuery:
         if self._cancelled.is_set():
             return
         self.start_time = time.time()
+        self._start_mono_ts = time.monotonic()  # queuedMs interval math
         self.state.set(QueryState.PLANNING)
         # retry_policy=QUERY: the whole statement re-runs on a fresh
         # attempt salt (fault_attempt_salt keys the injector's draws, so a
@@ -281,6 +283,12 @@ class ManagedQuery:
             # wall, coalesced H2D bytes, device-table-cache hits/misses —
             # a warm repeat scan shows h2d_bytes == 0
             "ingestStats": self.result.ingest_stats if self.result else None,
+            # cross-query batching (exec/batching.py): which dispatch this
+            # query shared and how long it waited; None when it ran alone
+            "batchStats": (
+                getattr(self.result, "batch_stats", None)
+                if self.result else None
+            ),
             # device profiler rollup (obs/profiler.py): per-program XLA
             # flops / peak HBM merged across workers, plus query totals
             "deviceStats": self.result.device_stats if self.result else None,
@@ -298,12 +306,18 @@ class ManagedQuery:
         }
 
     def _query_stats(self, elapsed_s: float, cluster_stats: dict) -> dict:
+        bs = (getattr(self.result, "batch_stats", None)
+              if self.result else None) or {}
         return {
             "elapsedMs": int(elapsed_s * 1000),
             "queuedMs": int(
                 ((self._start_mono() or time.monotonic()) - self._create_mono)
                 * 1000
             ),
+            # cross-query batching: 0/1/absent-wait when the query ran alone
+            "batchedQueries": bs.get("batchedQueries", 0),
+            "batchSize": bs.get("batchSize", 1),
+            "batchWaitMs": bs.get("batchWaitMs", 0.0),
             "speculativeAttempts": cluster_stats.get("speculative_attempts", 0),
             "speculativeWins": cluster_stats.get("speculative_wins", 0),
             "recoveredTasks": cluster_stats.get("recovered_tasks", 0),
@@ -312,19 +326,86 @@ class ManagedQuery:
         }
 
     def _start_mono(self) -> Optional[float]:
-        # start_time is epoch; approximate queued interval from epoch delta
-        # clamped non-negative (display-grade only — a wall-clock step
-        # during the queue wait can skew this, never the elapsed fields)
+        if self._start_mono_ts is not None:
+            return self._start_mono_ts
+        # legacy fallback (test doubles that set start_time directly):
+        # approximate from the epoch delta, clamped non-negative — a
+        # wall-clock step during the queue wait can skew this path only
         if self.start_time is None:
             return None
         return self._create_mono + max(0.0, self.start_time - self.create_time)
 
 
-class QueryManager:
-    """Registry + dispatch pool (DispatchManager + SqlQueryManager).
+class _DispatchPool:
+    """Bounded daemon-thread pool for ADMITTED queries.
 
-    ``admit`` is the resource-group hook: called before execution starts;
-    it may delay (queue) the query.
+    concurrent.futures.ThreadPoolExecutor keeps non-daemon workers that
+    pin interpreter exit, so: lazily-spawned daemon threads parked on a
+    queue, sentinel shutdown. Only admitted work lands here — admission
+    waits live in the resource-group waiter queue, so queued queries
+    cost a waiter object each, never a stack.
+    """
+
+    def __init__(self, max_workers: int, name: str = "dispatch"):
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._max = max(1, max_workers)
+        self._name = name
+        self._threads: list[threading.Thread] = []
+        self._idle = 0
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+    def submit(self, fn, *args) -> None:
+        with self._lock:
+            if self._shutdown:
+                raise RuntimeError("dispatch pool is shut down")
+            self._q.put((fn, args))
+            if self._idle == 0 and len(self._threads) < self._max:
+                t = threading.Thread(
+                    target=self._worker, daemon=True,
+                    name=f"{self._name}-{len(self._threads)}",
+                )
+                self._threads.append(t)
+                t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                self._idle += 1
+            item = self._q.get()
+            with self._lock:
+                self._idle -= 1
+            if item is None:
+                return
+            fn, args = item
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — work items own their errors
+                pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            threads = list(self._threads)
+        for _ in threads:
+            self._q.put(None)
+
+
+class QueryManager:
+    """Registry + dispatch (DispatchManager + SqlQueryManager).
+
+    Two admission styles:
+
+    - ``resource_groups=`` (the server's path): event-driven. create_query
+      submits to the resource-group waiter queue and returns; once a slot
+      frees, the query runs on a bounded daemon pool. No thread is parked
+      while a query is QUEUED, so queued depth is bounded by the groups'
+      ``max_queued`` — not by dispatch threads.
+    - ``admit=``/``complete=`` hooks (legacy; test doubles): dedicated
+      thread per query, because the hook may BLOCK in admit and must not
+      occupy pool workers.
     """
 
     def __init__(
@@ -333,17 +414,17 @@ class QueryManager:
         max_concurrent: int = 4,
         admit=None,
         complete=None,
+        resource_groups=None,
     ):
         self.engine = engine
         self._queries: dict[str, ManagedQuery] = {}
         self._lock = threading.Lock()
-        # dedicated thread per query: admission may BLOCK (queued state), so
-        # a bounded pool would let waiters exhaust dispatch slots and bypass
-        # the resource groups' own max_queued caps. Execution concurrency is
-        # bounded by resource-group admission (max_concurrent is advisory
-        # for the default permissive group installed by the server).
         self._admit = admit  # (query) -> token; may block (queue) or raise
         self._complete = complete  # (query, token) -> None
+        self.resource_groups = resource_groups
+        # pool at least as wide as a full batch: K batchmates each hold a
+        # worker while parked on the batch collector's per-member events
+        self._pool = _DispatchPool(max(max_concurrent, 16))
         self.max_history = 100
         self._shutdown = False
 
@@ -363,8 +444,53 @@ class QueryManager:
                     q.query_id, sql, session.user, q.create_time
                 )
             )
-        threading.Thread(target=self._dispatch, args=(q,), daemon=True).start()
+        if self.resource_groups is not None and self._admit is None:
+            self._submit_admission(q)
+        else:
+            threading.Thread(
+                target=self._dispatch, args=(q,), daemon=True
+            ).start()
         return q
+
+    # --- event-driven admission (resource_groups path) --------------------
+
+    def _submit_admission(self, q: ManagedQuery) -> None:
+        def ready(group, err) -> None:
+            # fires on whichever thread freed the slot (or reaped the
+            # timeout) — hand off immediately, never execute inline
+            if err is not None:
+                self._reject(q, err)
+                return
+            try:
+                self._pool.submit(self._run_admitted, q, group)
+            except RuntimeError:  # pool shut down: give the slot back
+                self.resource_groups.finish(group)
+
+        try:
+            group, admitted = self.resource_groups.submit(
+                q.session.user, q.session.source, ready
+            )
+        except Exception as e:  # noqa: BLE001 — queue full / no selector
+            self._reject(q, e)
+            return
+        if admitted:
+            self._pool.submit(self._run_admitted, q, group)
+
+    def _run_admitted(self, q: ManagedQuery, group) -> None:
+        try:
+            if q.state.get() == QueryState.QUEUED:
+                q.run(self.engine)
+        finally:
+            self.resource_groups.finish(group)
+
+    def _reject(self, q: ManagedQuery, e: Exception) -> None:
+        q.error = ErrorInfo(str(e), 3, "QUERY_REJECTED", "USER_ERROR")
+        q.state.set(QueryState.FAILED)
+        q.end_time = time.time()
+        q._end_mono = time.monotonic()
+        q._fire_completed(self.engine)
+
+    # --- legacy blocking admission (admit=/complete= hooks) ----------------
 
     def _dispatch(self, q: ManagedQuery) -> None:
         token = None
@@ -376,11 +502,7 @@ class QueryManager:
             if q.state.get() == QueryState.QUEUED:
                 q.run(self.engine)
         except Exception as e:  # noqa: BLE001
-            q.error = ErrorInfo(str(e), 3, "QUERY_REJECTED", "USER_ERROR")
-            q.state.set(QueryState.FAILED)
-            q.end_time = time.time()
-            q._end_mono = time.monotonic()
-            q._fire_completed(self.engine)
+            self._reject(q, e)
         finally:
             if admitted and self._complete is not None:
                 self._complete(q, token)
@@ -392,6 +514,16 @@ class QueryManager:
     def queries(self) -> list[ManagedQuery]:
         with self._lock:
             return list(self._queries.values())
+
+    def state_counts(self) -> dict[str, int]:
+        """``system.runtime.queries``-style breakdown: live query count
+        per state (QUEUED/RUNNING/FINISHED/…) for /v1/status."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for q in self._queries.values():
+                st = q.state.get().value
+                out[st] = out.get(st, 0) + 1
+        return out
 
     def cancel(self, query_id: str) -> bool:
         q = self.get(query_id)
@@ -424,3 +556,4 @@ class QueryManager:
     def shutdown(self, wait: bool = True) -> None:
         with self._lock:
             self._shutdown = True
+        self._pool.shutdown()

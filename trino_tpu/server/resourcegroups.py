@@ -7,9 +7,18 @@ selector-based group resolution and the file-based configuration format of
 ``plugin/trino-resource-group-managers``
 (``resource_groups.json``: rootGroups + selectors).
 
-Queries queue *before* execution (dispatcher tier, L7): ``admit()`` blocks
-the dispatch thread until a slot frees, mirroring DispatchManager →
-ResourceGroupManager.submit.
+Queries queue *before* execution (dispatcher tier, L7). Two admission
+styles share one waiter queue:
+
+- ``admit()`` — legacy blocking call: parks the calling thread on an
+  Event until a slot frees (DispatchManager → ResourceGroupManager.submit
+  with a thread per query).
+- ``submit(user, source, ready)`` — event-driven: returns immediately
+  with ``(group, admitted_now)``; when queued, the ``ready`` callback
+  fires later — outside the manager lock — once a slot frees (or with a
+  QueryQueueFullError when the queue wait expires). No thread is parked
+  while a query waits, so thousands of queued queries cost thousands of
+  waiter objects, not thousands of stacks.
 """
 
 from __future__ import annotations
@@ -17,12 +26,40 @@ from __future__ import annotations
 import dataclasses
 import re
 import threading
+import time
 from collections import deque
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 
 class QueryQueueFullError(Exception):
     """Reference error code QUERY_QUEUE_FULL."""
+
+
+class _Waiter:
+    """One queued admission. Either a blocking Event (``admit()``) or an
+    event-driven callback (``submit()``). Queue membership and the
+    ``admitted`` flag are guarded by the manager lock; callbacks are
+    always invoked OUTSIDE it."""
+
+    __slots__ = ("group", "enq_mono", "deadline", "event", "callback",
+                 "admitted")
+
+    def __init__(
+        self,
+        group: "ResourceGroup",
+        enq_mono: float,
+        deadline: float,
+        event: Optional[threading.Event] = None,
+        callback: Optional[
+            Callable[["ResourceGroup", Optional[Exception]], None]
+        ] = None,
+    ):
+        self.group = group
+        self.enq_mono = enq_mono  # monotonic: queue-wait SLO accounting
+        self.deadline = deadline  # monotonic absolute expiry
+        self.event = event
+        self.callback = callback
+        self.admitted = False
 
 
 @dataclasses.dataclass
@@ -69,7 +106,7 @@ class ResourceGroup:
         self._lock = lock
         self.dynamic = dynamic  # ${USER}-template subgroup: evicted when idle
         self.running = 0
-        self.queue: deque = deque()  # waiting admissions (threading.Event)
+        self.queue: deque = deque()  # waiting admissions (_Waiter)
         self.children: dict[str, ResourceGroup] = {}
         for sub in config.subgroups:
             self.children[sub.name] = ResourceGroup(sub, self, lock)
@@ -118,6 +155,8 @@ class ResourceGroup:
             "hardConcurrencyLimit": self.config.hard_concurrency_limit,
             "maxQueued": self.config.max_queued,
             "schedulingPolicy": self.config.scheduling_policy,
+            "totalAdmitted": self.total_admitted,
+            "totalQueuedTimeMs": int(self.total_queued_time * 1000),
             "subGroups": [c.info() for c in self.children.values()],
         }
 
@@ -205,34 +244,160 @@ class ResourceGroupManager:
 
     def admit(self, user: str, source: str = "") -> ResourceGroup:
         """Blocks until a slot is available. Raises when the queue is full
-        or the wait times out."""
+        or the wait times out. (Thread-parking path; ``submit()`` is the
+        event-driven equivalent.)"""
         group = self._resolve(user, source)
-        event: Optional[threading.Event] = None
+        now = time.monotonic()
         with self._lock:
             if group._can_run_locked() and not group.queue:
                 group._start_locked()
+                self._publish_locked()
                 return group
             if len(group.queue) >= group.config.max_queued:
                 raise QueryQueueFullError(
                     f"Too many queued queries for '{group.full_name}'"
                 )
-            event = threading.Event()
-            group.queue.append(event)
-        if not event.wait(self.max_wait_seconds):
+            waiter = _Waiter(
+                group, now, now + self.max_wait_seconds,
+                event=threading.Event(),
+            )
+            group.queue.append(waiter)
+            self._publish_locked()
+        if not waiter.event.wait(self.max_wait_seconds):
             with self._lock:
-                if event.is_set():
+                if waiter.admitted:
                     return group  # admitted concurrently with the timeout
-                group.queue.remove(event)
+                group.queue.remove(waiter)
+                self._publish_locked()
             raise QueryQueueFullError(
                 f"Query exceeded maximum queue wait for '{group.full_name}'"
             )
         return group
 
+    def submit(
+        self,
+        user: str,
+        source: str = "",
+        ready: Optional[
+            Callable[[ResourceGroup, Optional[Exception]], None]
+        ] = None,
+    ) -> tuple[ResourceGroup, bool]:
+        """Event-driven admission: never parks the calling thread.
+
+        Returns ``(group, True)`` when a slot was free, else enqueues a
+        callback waiter and returns ``(group, False)``;
+        ``ready(group, None)`` fires once a slot frees, or
+        ``ready(group, QueryQueueFullError)`` when the queue wait
+        expires. Callbacks run outside the manager lock (on whichever
+        thread released the slot). Raises immediately when the queue is
+        full or no selector matches.
+        """
+        group = self._resolve(user, source)
+        now = time.monotonic()
+        timed_out: list[_Waiter] = []
+        err: Optional[QueryQueueFullError] = None
+        admitted = False
+        with self._lock:
+            self._collect_expired_locked(timed_out)
+            if group._can_run_locked() and not group.queue:
+                group._start_locked()
+                admitted = True
+            elif len(group.queue) >= group.config.max_queued:
+                err = QueryQueueFullError(
+                    f"Too many queued queries for '{group.full_name}'"
+                )
+            else:
+                group.queue.append(_Waiter(
+                    group, now, now + self.max_wait_seconds, callback=ready,
+                ))
+            self._publish_locked()
+        self._fire_timeouts(timed_out)
+        if err is not None:
+            raise err
+        return group, admitted
+
     def finish(self, group: ResourceGroup) -> None:
+        fired: list[_Waiter] = []
+        timed_out: list[_Waiter] = []
         with self._lock:
             group._finish_locked()
-            self._wake_next_locked(group)
+            self._collect_expired_locked(timed_out)
+            self._wake_next_locked(group, fired)
             self._evict_idle_dynamic_locked(group)
+            self._publish_locked()
+        for w in fired:
+            try:
+                w.callback(w.group, None)
+            except Exception:  # noqa: BLE001 — a bad callback must not
+                pass  # strand other finishers
+        self._fire_timeouts(timed_out)
+
+    def _collect_expired_locked(self, out: list) -> None:
+        """Remove callback waiters whose deadline passed (opportunistic
+        reaping: there is no timer thread, so expiry fires on the next
+        submit/finish activity). Event waiters time themselves out —
+        their parked thread owns removal."""
+        now = time.monotonic()
+
+        def walk(g: ResourceGroup) -> None:
+            for w in [w for w in g.queue
+                      if w.callback is not None and now > w.deadline]:
+                g.queue.remove(w)
+                out.append(w)
+            for c in list(g.children.values()):
+                walk(c)
+
+        for root in self.roots.values():
+            walk(root)
+
+    def _fire_timeouts(self, waiters: list) -> None:
+        for w in waiters:
+            try:
+                w.callback(w.group, QueryQueueFullError(
+                    "Query exceeded maximum queue wait for "
+                    f"'{w.group.full_name}'"
+                ))
+            except Exception:  # noqa: BLE001
+                pass
+
+    # --- observability ----------------------------------------------------
+
+    def _publish_locked(self) -> None:
+        """Queue-depth and running gauges per group on /v1/metrics."""
+        from trino_tpu.obs.metrics import get_registry
+
+        reg = get_registry()
+
+        def walk(g: ResourceGroup) -> None:
+            reg.gauge(
+                "trino_tpu_resource_group_queued", group=g.full_name
+            ).set(len(g.queue))
+            reg.gauge(
+                "trino_tpu_resource_group_running", group=g.full_name
+            ).set(g.running)
+            for c in g.children.values():
+                walk(c)
+
+        for root in self.roots.values():
+            walk(root)
+
+    def summary(self) -> dict:
+        """Flat ``{group: {queuedQueries, runningQueries}}`` snapshot —
+        the ``system.runtime.queries``-style admission breakdown."""
+        out: dict[str, dict] = {}
+        with self._lock:
+
+            def walk(g: ResourceGroup) -> None:
+                out[g.full_name] = {
+                    "queuedQueries": len(g.queue),
+                    "runningQueries": g.running,
+                }
+                for c in g.children.values():
+                    walk(c)
+
+            for root in self.roots.values():
+                walk(root)
+        return out
 
     def _evict_idle_dynamic_locked(self, group: ResourceGroup) -> None:
         """Drop idle ${USER}-template subgroups so distinct users don't
@@ -243,25 +408,43 @@ class ResourceGroupManager:
                 g.parent.children.pop(g.config.name, None)
             g = g.parent
 
-    def _wake_next_locked(self, group: ResourceGroup) -> None:
+    def _wake_next_locked(
+        self, group: ResourceGroup, fired: list
+    ) -> None:
         """Wake queued queries anywhere in the hierarchy that can now run.
         fair/fifo: FIFO within a group; weighted_fair: highest
         weight/(running+1) subgroup first (WeightedFairQueue analog)."""
-        self._wake_in_subtree_locked(self._root_of(group))
+        self._wake_in_subtree_locked(self._root_of(group), fired)
 
     def _root_of(self, g: ResourceGroup) -> ResourceGroup:
         while g.parent is not None:
             g = g.parent
         return g
 
-    def _wake_in_subtree_locked(self, g: ResourceGroup) -> None:
+    def _wake_in_subtree_locked(
+        self, g: ResourceGroup, fired: list
+    ) -> None:
         while True:
             candidate = self._pick_candidate_locked(g)
             if candidate is None:
                 return
-            ev = candidate.queue.popleft()
+            w = candidate.queue.popleft()
             candidate._start_locked()
-            ev.set()
+            w.admitted = True
+            waited = time.monotonic() - w.enq_mono
+            candidate.total_queued_time += waited
+            self._observe_wait(candidate, waited)
+            if w.event is not None:
+                w.event.set()
+            else:
+                fired.append(w)  # callback: invoked by finish(), unlocked
+
+    def _observe_wait(self, group: ResourceGroup, waited_s: float) -> None:
+        from trino_tpu.obs.metrics import get_registry
+
+        get_registry().histogram(
+            "trino_tpu_resource_group_queue_wait_ms", group=group.full_name
+        ).observe(waited_s * 1000.0)
 
     def _pick_candidate_locked(self, g: ResourceGroup) -> Optional[ResourceGroup]:
         if not g._can_run_locked():
