@@ -17,18 +17,27 @@ Boots a 2-worker cluster and runs three scenarios:
    tier that batches — executes them). Every concurrent result must be
    bit-identical to its sequential run; batched-dispatch counters land
    in the summary line.
-5. ``node-death`` (runs last — a worker does not survive it): with
-   ``retry_policy=TASK`` + ``exchange_spooling=true``, the worker that
-   ran Q1's scan fragment ``os._exit``s right after that task finishes
-   (``fault_worker_exit_site=2.0``; every task stalls 1s pre-execute so
-   the partial-agg consumers provably pull AFTER the death). Spool
-   recovery must keep the result bit-identical with NO query-level
-   retry (queryAttempts == 1); spooled-bytes and recovered-task
-   counters land in the summary.
+5. ``node-death`` (the 2-worker cluster's last scenario — a worker does
+   not survive it): with ``retry_policy=TASK`` +
+   ``exchange_spooling=true`` (execution pinned per-fragment), the
+   worker that ran Q1's scan fragment ``os._exit``s right after that
+   task finishes (``fault_worker_exit_site=2.0``; every task stalls 1s
+   pre-execute so the partial-agg consumers provably pull AFTER the
+   death). Spool recovery must keep the result bit-identical with NO
+   query-level retry (queryAttempts == 1); spooled-bytes and
+   recovered-task counters land in the summary.
+6. ``fused-node-death`` (its own 3-worker cluster): fusion AND spooling
+   on together. A join of two grouped subqueries fuses into two units
+   feeding a worker-side join stage; the worker that ran the first
+   unit's task is SIGKILLed right after it finishes. The stalled join
+   consumers pull after the death, so recovery must engage at unit
+   granularity — FAIL on row drift, on queryAttempts > 1, or on
+   fusedFragments == 0 (the query silently not fusing would void the
+   scenario); recovered/spooled/fused counters land in the summary.
 
 Quick manual repro for the fault-tolerance stack (CI runs the same
 scenarios as ``tests/test_fault_tolerance.py -m faults`` /
-``tests/test_speculation.py``).
+``tests/test_speculation.py`` / ``tests/test_spool.py``).
 
 Usage: JAX_PLATFORMS=cpu python scripts/chaos_smoke.py [seed]
 """
@@ -62,6 +71,60 @@ Q_SKEW = """select count(*) as c, sum(o.o_totalprice * c.c_custkey) as chk
 Q_BATCH = """select l_returnflag, count(*) as c, sum(l_quantity) as s
        from lineitem where l_quantity < {} group by l_returnflag
        order by l_returnflag"""
+
+# fused-node-death: two grouped subqueries fuse into two pipeline units
+# feeding a worker-side join stage (PARTITIONED + fusion_max_fragments=2).
+# The join's tasks are stallable, so killing a unit's worker right after
+# the unit task finishes is provably observed — recovery must engage at
+# unit granularity (spool re-point of the unit's boundary output, or an
+# atomic whole-unit re-execution)
+Q_FUSED = """select a.k, a.c, b.s from
+       (select l_returnflag as k, count(*) as c from lineitem
+        group by l_returnflag) a
+       join (select l_returnflag as k, sum(l_quantity) as s from lineitem
+        group by l_returnflag) b on a.k = b.k order by a.k"""
+
+FUSED_PROPS = {
+    "join_distribution_type": "PARTITIONED",
+    "fusion_max_fragments": 2,
+}
+
+
+def _fused_unit_site(sql, **props):
+    """Fault site of the first fused unit's task ('{unit_root}.0'),
+    computed from the same fuse_groups decision the scheduler makes."""
+    from trino_tpu.exec.fragments import fragment_fusable
+    from trino_tpu.planner.fragmenter import (
+        FusedFragment,
+        fragment_plan,
+        fuse_groups,
+        partitioned_join_pairs,
+    )
+    from trino_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner()
+    r.session.set("execution_mode", "distributed")
+    for k, v in props.items():
+        r.session.set(k, v)
+    sub = fragment_plan(r.plan(sql))
+    units = [
+        u
+        for u in fuse_groups(
+            sub,
+            fusable=fragment_fusable,
+            max_fragments=max(1, int(r.session.get("fusion_max_fragments"))),
+            skew_pairs=(
+                partitioned_join_pairs(sub)
+                if bool(r.session.get("skew_handling"))
+                else ()
+            ),
+            include_root=False,
+        )
+        if isinstance(u, FusedFragment)
+    ]
+    if not units:
+        return None
+    return f"{units[0].id}.0"
 
 
 def main() -> int:
@@ -101,6 +164,11 @@ def main() -> int:
     death_props = {
         "retry_policy": "TASK",
         "exchange_spooling": True,
+        # pin per-fragment execution: the 2.0 exit site addresses the
+        # per-fragment task tree (under the fused default Q1's scan is
+        # interior to a unit and the site would never fire); the
+        # fused-node-death scenario below covers the fused ladder
+        "worker_execution": "per_fragment",
         "task_retry_attempts": 8,
         "retry_initial_delay_ms": 20,
         "retry_max_delay_ms": 200,
@@ -175,6 +243,54 @@ def main() -> int:
                 f"{runner.coordinator_uri}/v1/metrics?format=json", timeout=10
             ) as r:
                 summary["metrics"] = json.loads(r.read().decode())
+        # fused-node-death gets its OWN 3-worker cluster: the previous
+        # cluster is down a worker for good, and the fused ladder should
+        # be measured against a full quorum
+        from trino_tpu.server import auth
+
+        fused_site = _fused_unit_site(Q_FUSED, **FUSED_PROPS)
+        fused_death_props = {
+            **FUSED_PROPS,
+            "retry_policy": "TASK",
+            "exchange_spooling": True,
+            "task_retry_attempts": 8,
+            "retry_initial_delay_ms": 20,
+            "retry_max_delay_ms": 200,
+            "fault_worker_exit_site": fused_site or "2.0",
+            "fault_worker_exit_delay_ms": 300,
+            "fault_task_stall_ms": 1000,
+        }
+        with MultiProcessQueryRunner(n_workers=3) as runner3:
+            fused_clean, _ = runner3.execute(
+                Q_FUSED, session_properties=FUSED_PROPS
+            )
+            fused_death, _ = runner3.execute(
+                Q_FUSED, session_properties=fused_death_props
+            )
+            req = urllib.request.Request(
+                f"{runner3.coordinator_uri}/v1/query", headers=auth.headers()
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                fused_queries = json.loads(r.read().decode())
+        fused_info = next(
+            (
+                q
+                for q in reversed(fused_queries)
+                if q.get("retryPolicy") == "TASK"
+            ),
+            {},
+        )
+        summary["fused_node_death"] = {
+            "unit_site": fused_site,
+            "fused_fragments": (fused_info.get("exchangeStats") or {}).get(
+                "fusedFragments", 0
+            ),
+            "recovered_tasks": fused_info.get("recoveredTasks", 0),
+            "recovered_levels": fused_info.get("recoveredTaskLevels", {}),
+            "spooled_bytes": fused_info.get("spooledBytes", 0),
+            "query_attempts": fused_info.get("queryAttempts", 1),
+            "drift": fused_death != fused_clean,
+        }
         retries = max(q.get("taskRetries", 0) for q in queries)
         spec_attempts = max(q.get("speculativeAttempts", 0) for q in queries)
         spec_wins = max(q.get("speculativeWins", 0) for q in queries)
@@ -268,6 +384,26 @@ def main() -> int:
             )
             summary["ok"] = False
             return 1
+        fd = summary["fused_node_death"]
+        if fd["drift"]:
+            print("FAIL: fused-node-death result differs from fault-free")
+            summary["ok"] = False
+            return 1
+        if fd["query_attempts"] > 1:
+            print(
+                "FAIL: fused-node-death escalated to a query-level retry"
+                f" (queryAttempts={fd['query_attempts']})"
+            )
+            summary["ok"] = False
+            return 1
+        if fd["fused_fragments"] == 0:
+            print("FAIL: fused-node-death query never fused — the scenario"
+                  " silently exercised the per-fragment path")
+            summary["ok"] = False
+            return 1
+        if fd["recovered_tasks"] == 0:
+            print("WARN: fused-node-death recovered nothing — the unit"
+                  " death raced the consumer pull")
         if recovered == 0:
             print("WARN: no recovered tasks — the worker-exit fault"
                   " never bit a consumer")
@@ -278,7 +414,7 @@ def main() -> int:
         print(
             "OK: bit-identical under 30% task-crash injection"
             " (incl. skewed join, 10x slow worker, concurrent batched"
-            " clients, node death)"
+            " clients, node death, fused node death)"
         )
         summary["ok"] = True
         return 0

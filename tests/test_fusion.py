@@ -459,24 +459,40 @@ def _last_exchange_stats(runner, sql):
 
 @pytest.mark.faults
 class TestClusterFusion:
-    def test_spooling_boundary_keeps_per_fragment_path(self, cluster):
-        """Spooled exchange needs retained per-fragment page boundaries
-        for recovery, so the scheduler must NOT fuse under it — same rows,
-        no fused fragments, one dispatch per stage attempt."""
+    def test_spooling_coexists_with_fusion(self, cluster):
+        """Fusion and spooled exchange coexist: fused-unit output buffers
+        ARE the spool pages, so turning spooling on keeps the exact same
+        fused schedule (same fused fragments, no extra dispatch
+        round-trips) while the unit boundaries become durable
+        (spooledBytes > 0)."""
         base, _ = cluster.execute(JOIN_SQL, session_properties=FUSED_CLUSTER_PROPS)
         ex_fused = _last_exchange_stats(cluster, JOIN_SQL)
         assert ex_fused.get("fusedFragments", 0) >= 3, ex_fused
 
         spooled, _ = cluster.execute(
             JOIN_SQL,
-            session_properties={**FUSED_CLUSTER_PROPS, "exchange_spooling": True},
+            session_properties={
+                **FUSED_CLUSTER_PROPS,
+                "exchange_spooling": True,
+                "retry_policy": "TASK",
+            },
         )
         ex_spool = _last_exchange_stats(cluster, JOIN_SQL)
         assert spooled == base
-        assert ex_spool.get("fusedFragments", 0) == 0, ex_spool
-        assert ex_spool.get("dispatchRoundTrips", 0) > ex_fused.get(
+        assert ex_spool.get("fusedFragments", 0) == ex_fused.get(
+            "fusedFragments", 0
+        ), (ex_spool, ex_fused)
+        assert ex_spool.get("dispatchRoundTrips", 0) <= ex_fused.get(
             "dispatchRoundTrips", 0
         ), (ex_spool, ex_fused)
+        infos = [
+            q for q in _query_infos(cluster)
+            if q.get("query", "").strip() == JOIN_SQL.strip()
+            and q.get("retryPolicy") == "TASK"
+        ]
+        assert infos and infos[-1].get("spooledBytes", 0) > 0, (
+            "unit-boundary output buffers never reached the spool"
+        )
 
     def test_task_retry_chaos_with_fusion_on(self, cluster):
         """retry_policy=TASK with injected task crashes and fusion ON:

@@ -164,23 +164,70 @@ class TestDiskSpoolStore:
         s = DiskSpoolStore(str(tmp_path), max_bytes=1 << 20)
         s.put_page("q1", "q1.1.0", 0, 0, b"hello")
         s.put_page("q1", "q1.1.0", 0, 1, b"world")
-        files = list(tmp_path.glob("*.page"))
+        files = list(tmp_path.rglob("*.page"))
         assert len(files) == 2, "one file per page"
-        assert not list(tmp_path.glob("*.tmp")), "no partial files visible"
+        assert not list(tmp_path.rglob("*.tmp")), "no partial files visible"
         s.complete("q1.1.0", "q1", {0: 2})
         out = s.read("q1.1.0", 0, 0)
         assert [base64.b64decode(p) for p in out["pages"]] == [
             b"hello", b"world",
         ]
         s.delete_query("q1")
-        assert not list(tmp_path.glob("*.page")), "pages deleted with query"
+        assert not list(tmp_path.rglob("*.page")), "pages deleted with query"
 
     def test_eviction_removes_files(self, tmp_path):
         s = DiskSpoolStore(str(tmp_path), max_bytes=10)
         s.put_page("q1", "q1.t", 0, 0, b"x" * 8)
         s.finish_query("q1")
         assert s.put_page("q2", "q2.t", 0, 0, b"x" * 8)
-        assert len(list(tmp_path.glob("*.page"))) == 1
+        assert len(list(tmp_path.rglob("*.page"))) == 1
+
+    def test_startup_sweep_reaps_debris_and_rehydrates(self, tmp_path):
+        """satellite: crash-safety sweep. A first store leaves a complete
+        spool (manifest landed); a simulated kill -9 leaves a torn
+        ``.tmp``, a loose root file, and a manifest-less task directory.
+        A fresh store on the same dir reaps all three and re-registers
+        the complete spool — readable AND evictable."""
+        first = DiskSpoolStore(str(tmp_path), max_bytes=1 << 20)
+        first.put_page("q1", "q1.1.0", 0, 0, b"hello")
+        first.put_page("q1", "q1.1.0", 0, 1, b"world")
+        assert first.complete("q1.1.0", "q1", {0: 2})
+        (tmp_path / "q1.1.0" / "p0.9.page.tmp").write_bytes(b"torn")
+        (tmp_path / "stray.tmp").write_bytes(b"junk")
+        orphan = tmp_path / "q9.5.0"
+        orphan.mkdir()
+        (orphan / "p0.0.page").write_bytes(b"half-written, no manifest")
+
+        s = DiskSpoolStore(str(tmp_path), max_bytes=1 << 20)
+        assert s.reaped_entries == 3
+        assert s.stats()["reapedEntries"] == 3
+        assert not orphan.exists()
+        assert not (tmp_path / "stray.tmp").exists()
+        assert not (tmp_path / "q1.1.0" / "p0.9.page.tmp").exists()
+        # the manifest-complete spool survived the sweep, readable as-is
+        assert s.is_complete("q1.1.0")
+        out = s.read("q1.1.0", 0, 0)
+        assert [base64.b64decode(p) for p in out["pages"]] == [
+            b"hello", b"world",
+        ]
+        assert s.stats()["bytes"] == 10
+        # ...and arrives finish-marked: new demand can evict it
+        assert s.put_page("q2", "q2.t", 0, 0, b"x" * ((1 << 20) - 5))
+        assert s.read("q1.1.0", 0, 0) is None
+
+    def test_startup_sweep_reaps_manifest_page_mismatch(self, tmp_path):
+        """A directory whose manifest claims pages that are no longer on
+        disk is debris, not a readable spool."""
+        first = DiskSpoolStore(str(tmp_path), max_bytes=1 << 20)
+        first.put_page("q1", "q1.1.0", 0, 0, b"aa")
+        first.put_page("q1", "q1.1.0", 0, 1, b"bb")
+        assert first.complete("q1.1.0", "q1", {0: 2})
+        (tmp_path / "q1.1.0" / "p0.1.page").unlink()
+
+        s = DiskSpoolStore(str(tmp_path), max_bytes=1 << 20)
+        assert s.reaped_entries == 1
+        assert not s.is_complete("q1.1.0")
+        assert not (tmp_path / "q1.1.0").exists()
 
 
 def test_get_spool_store_pins_backend(tmp_path):
@@ -637,6 +684,101 @@ _FakeRemoteTask.uri = property(
 )
 
 
+# === unit: fused-unit heal paths =========================================
+
+
+def _make_unit(root_sources=()):
+    """A two-member fused unit: interior frag3 -> root frag2. The root's
+    plain sources are interior; the unit's external lineage is whatever
+    frag3 pulls from outside."""
+    from trino_tpu.planner.fragmenter import FusedFragment
+
+    f3 = SimpleNamespace(id=3, source_fragment_ids=list(root_sources),
+                         output_exchange="gather", output_keys=[])
+    f2 = SimpleNamespace(id=2, source_fragment_ids=[3],
+                         output_exchange="gather", output_keys=[])
+    return FusedFragment((f3, f2)), f2, f3
+
+
+class TestFusedUnitHeal:
+    """Recovery boundary = fused unit: the unit's output buffers are the
+    spool pages, its task is the recovery unit, and its lineage is the
+    members' EXTERNAL sources (interior links are in-jit collectives
+    with no tasks of their own)."""
+
+    def test_external_source_ids_skip_interior_links(self):
+        unit, _, _ = _make_unit(root_sources=(5,))
+        assert unit.member_ids == frozenset({2, 3})
+        assert unit.external_source_ids == (5,)
+
+    def test_unit_spool_repoint_level_task(self, heal_cluster):
+        """A dead fused-unit task whose unit-boundary output spooled
+        completely re-points as ONE SpoolHandle — zero re-execution."""
+        from trino_tpu.server.cluster import SpoolHandle
+
+        sched, _, dead = heal_cluster
+        unit, f2, _ = _make_unit()
+        prod = _FakeRemoteTask(dead, "cq7.2.0", {"fused_fragments": ["..."]})
+        store = MemorySpoolStore()
+        store.put_page("cq7", "cq7.2.0", 0, 0, b"unit-output")
+        store.complete("cq7.2.0", "cq7", {0: 1})
+        rc = _recovery_ctx(
+            sched, {2: [prod]}, {2: f2}, store=store, base_uri="http://coord"
+        )
+        rc["units"] = {2: unit}
+        consumer = SimpleNamespace(id=1, source_fragment_ids=[2])
+        assert sched._heal_sources(consumer, rc)
+        handle = rc["remote_tasks"][2][0]
+        assert isinstance(handle, SpoolHandle)
+        assert handle.uri == "http://coord/v1/spool/cq7.2.0"
+        assert rc["stats"]["recovered_levels"] == {"task": 1}
+
+    def test_unit_reexecutes_atomically_level_fused(self, heal_cluster):
+        """No complete spool: the whole unit re-runs as ONE task
+        (``l{k}`` id), its rebuilt sources spanning the members'
+        external producers only — counted at level=fused."""
+        sched, live, dead = heal_cluster
+        unit, f2, f3 = _make_unit(root_sources=(5,))
+        f5 = SimpleNamespace(id=5, source_fragment_ids=[],
+                             output_exchange="gather", output_keys=[])
+        lost = _FakeRemoteTask(dead, "cq7.2.0", {"fused_fragments": ["..."]})
+        ext = _FakeRemoteTask(live, "cq7.5.0", {})
+        rc = _recovery_ctx(sched, {2: [lost], 5: [ext]}, {2: f2, 3: f3, 5: f5})
+        rc["units"] = {2: unit}
+        consumer = SimpleNamespace(id=1, source_fragment_ids=[2])
+        assert sched._heal_sources(consumer, rc)
+        new = rc["remote_tasks"][2][0]
+        assert new is not lost
+        assert new.task_id == "cq7.2.0l1"
+        assert new.recovered and new.attempt == 2
+        assert rc["stats"]["recovered_levels"] == {"fused": 1}
+        # the atomic re-run still carries the whole member chain...
+        assert new.payload["fused_fragments"] == ["..."]
+        # ...and pulls ONLY the unit's external producers (interior
+        # member links are in-jit, never wire sources)
+        assert set(new.payload["sources"]) == {"5"}
+        assert new.payload["sources"]["5"]["locations"] == [ext.uri]
+
+    def test_unit_consumer_heals_external_not_interior(self, heal_cluster):
+        """When the CONSUMER is a fused unit, healing walks the unit's
+        external sources — a stale interior entry is never touched."""
+        sched, _, dead = heal_cluster
+        unit, f2, _ = _make_unit(root_sources=(5,))
+        f5 = SimpleNamespace(id=5, source_fragment_ids=[],
+                             output_exchange="gather", output_keys=[])
+        dead_ext = _FakeRemoteTask(dead, "cq7.5.0", {})
+        dead_interior = _FakeRemoteTask(dead, "cq7.3.0", {})
+        rc = _recovery_ctx(
+            sched, {5: [dead_ext], 3: [dead_interior]}, {5: f5}
+        )
+        rc["units"] = {2: unit}
+        assert sched._heal_sources(f2, rc)
+        assert rc["remote_tasks"][5][0].task_id == "cq7.5.0l1"
+        assert rc["stats"]["recovered_levels"] == {"lineage": 1}
+        # interior fragment 3 was not (and must not be) healed
+        assert rc["remote_tasks"][3][0] is dead_interior
+
+
 # === integration: worker death + drain over a real cluster ===============
 
 
@@ -646,6 +788,15 @@ SPOOL_PROPS = {
     "task_retry_attempts": 8,
     "retry_initial_delay_ms": 20,
     "retry_max_delay_ms": 200,
+    # pin the per-fragment path: these classes exercise the per-fragment
+    # recovery ladder (and _exit_site_for computes per-fragment sites);
+    # the fused-unit ladder has its own classes further down
+    "worker_execution": "per_fragment",
+}
+
+# the fused ladder: same retry/spool knobs, default (fused) execution
+FUSED_SPOOL_PROPS = {
+    k: v for k, v in SPOOL_PROPS.items() if k != "worker_execution"
 }
 
 
@@ -826,6 +977,47 @@ class TestWorkerDrain:
         )
         assert len(infos["nodes"]) == len(spool_cluster.worker_uris)
 
+    def test_rolling_restart_with_fusion_on(self, spool_cluster):
+        """Acceptance: the same rolling drain/restart with FUSED spooled
+        queries flowing. A draining worker's retained fused-unit buffer
+        IS the unit-boundary output — force-spooled on drain — so fusion
+        adds zero failures and zero drift."""
+        sql = _fused_chaos_queries()[3]
+        clean, _ = spool_cluster.execute(sql)
+        stop = threading.Event()
+        failures: list = []
+        runs = [0]
+
+        def churn():
+            while not stop.is_set():
+                try:
+                    rows, _ = spool_cluster.execute(
+                        sql, session_properties=FUSED_SPOOL_PROPS
+                    )
+                    runs[0] += 1
+                    if rows != clean:
+                        failures.append(f"row mismatch on run {runs[0]}")
+                except Exception as e:  # noqa: BLE001
+                    failures.append(repr(e))
+
+        t = threading.Thread(target=churn, daemon=True)
+        t.start()
+        try:
+            for i in range(len(spool_cluster.worker_uris)):
+                spool_cluster.drain_worker(i)
+                spool_cluster.restart_worker(i)
+        finally:
+            stop.set()
+            t.join(timeout=120)
+        assert not failures, (
+            f"fused queries failed during rolling restart: {failures[:3]}"
+        )
+        assert runs[0] >= 1, "no fused query completed during the restarts"
+        ex = _last_exchange_stats(spool_cluster, sql)
+        assert ex.get("fusedFragments", 0) >= 1, (
+            "the churn traffic never actually fused"
+        )
+
     def test_draining_worker_refuses_new_tasks(self, spool_cluster):
         """A SHUTTING_DOWN worker 503s task POSTs (the coordinator
         re-routes); its /v1/info/state reflects the drain."""
@@ -847,3 +1039,335 @@ class TestWorkerDrain:
                 urllib.request.urlopen(req, timeout=5)
         finally:
             spool_cluster.restart_worker(i)
+
+
+# === integration: fused execution × death / batching =====================
+
+
+def _fused_chaos_queries():
+    """The chaos suite with every member fusable: Q6's single worker
+    fragment never forms a unit, so it is swapped for a two-stage
+    aggregation (partial -> final) that does."""
+    from tests.test_fault_tolerance import TPCH_CHAOS_QUERIES
+
+    qs = list(TPCH_CHAOS_QUERIES)
+    qs[1] = (
+        "select l_shipmode, count(*) as c from lineitem "
+        "group by l_shipmode order by l_shipmode"
+    )
+    return qs
+
+
+def _last_exchange_stats(runner, sql):
+    infos = [
+        q for q in _query_infos(runner)
+        if q.get("query", "").strip() == sql.strip()
+    ]
+    assert infos, "query not found in coordinator query list"
+    return infos[-1].get("exchangeStats") or {}
+
+
+def _last_info(runner, sql):
+    infos = [
+        q for q in _query_infos(runner)
+        if q.get("query", "").strip() == sql.strip()
+    ]
+    assert infos, "query not found in coordinator query list"
+    return infos[-1]
+
+
+def _coordinator_metrics(runner) -> str:
+    from trino_tpu.server import auth
+
+    req = urllib.request.Request(
+        f"{runner.coordinator_uri}/v1/metrics", headers=auth.headers()
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.read().decode()
+
+
+def _fuse_units(sql, **props):
+    """The fused units the cluster scheduler would form for ``sql`` —
+    same fuse_groups invocation, computed plan-side so tests can pick
+    deterministic fault sites (unit root tasks, external producers)."""
+    from trino_tpu.exec.fragments import fragment_fusable
+    from trino_tpu.planner.fragmenter import (
+        FusedFragment,
+        fragment_plan,
+        fuse_groups,
+        partitioned_join_pairs,
+    )
+    from trino_tpu.testing import LocalQueryRunner
+
+    r = LocalQueryRunner()
+    r.session.set("execution_mode", "distributed")
+    for k, v in props.items():
+        r.session.set(k, v)
+    sub = fragment_plan(r.plan(sql))
+    units = fuse_groups(
+        sub,
+        fusable=fragment_fusable,
+        max_fragments=max(1, int(r.session.get("fusion_max_fragments"))),
+        skew_pairs=(
+            partitioned_join_pairs(sub)
+            if bool(r.session.get("skew_handling"))
+            else ()
+        ),
+        include_root=False,
+    )
+    return [u for u in units if isinstance(u, FusedFragment)]
+
+
+# two grouped subqueries fuse into two 2-member units feeding a
+# worker-side join fragment (PARTITIONED + max=2). The join's tasks are
+# stallable, so a unit's own death is provably observed — units feeding
+# the coordinator root race its unstallable pull instead
+FUSED_JOIN_SQL = (
+    "select a.k, a.c, b.s from "
+    "(select l_returnflag as k, count(*) as c from lineitem "
+    "group by l_returnflag) a "
+    "join (select l_returnflag as k, sum(l_quantity) as s from lineitem "
+    "group by l_returnflag) b on a.k = b.k "
+    "order by a.k"
+)
+FUSED_JOIN_PROPS = {
+    "join_distribution_type": "PARTITIONED",
+    "fusion_max_fragments": 2,
+}
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+class TestFusedWorkerDeathRecovery:
+    def test_fused_tpch_bit_identical_across_worker_death(
+        self, spool_cluster
+    ):
+        """Acceptance: all five chaos queries run FUSED with spooling on
+        (fusedFragments >= 1, no extra dispatch round-trips vs the
+        fused-only path) and one survives a mid-query worker SIGKILL
+        bit-identically with queryAttempts == 1."""
+        qs = _fused_chaos_queries() + [FUSED_JOIN_SQL]
+        extra = {FUSED_JOIN_SQL: FUSED_JOIN_PROPS}
+        # the death lands on the join-of-aggregations query: its SECOND
+        # unit stage (1s stall) runs between the dead unit's FINISH and
+        # the join stage's eager source pull, so the 300ms death window
+        # provably elapses before any consumer pulls. A linear chain has
+        # no such intervening stage — only barrier latency — and races.
+        death_idx = len(qs) - 1
+        death_units = _fuse_units(FUSED_JOIN_SQL, **FUSED_JOIN_PROPS)
+        assert death_units, "join-of-aggregations no longer fuses"
+        death_site = f"{death_units[0].id}.0"
+        try:
+            clean, fused_ex = {}, {}
+            for sql in qs:
+                # the session DEFAULTS are the fused path: this baseline
+                # is the pre-spooling fused schedule (PR-10 round-trip
+                # counts) the spooled runs must not regress
+                clean[sql] = spool_cluster.execute(
+                    sql, session_properties=extra.get(sql, {})
+                )[0]
+                fused_ex[sql] = _last_exchange_stats(spool_cluster, sql)
+                assert fused_ex[sql].get("fusedFragments", 0) >= 1, (
+                    f"baseline did not fuse: {sql[:60]}"
+                )
+            for k, sql in enumerate(qs):
+                props = dict(FUSED_SPOOL_PROPS, **extra.get(sql, {}))
+                if k == death_idx:
+                    props.update(
+                        DEATH_WINDOW,
+                        fault_worker_exit_site=death_site,
+                    )
+                chaotic, _ = spool_cluster.execute(
+                    sql, session_properties=props
+                )
+                assert chaotic == clean[sql], (
+                    f"diverged after death: {sql[:60]}"
+                )
+                ex = _last_exchange_stats(spool_cluster, sql)
+                if k == death_idx:
+                    # the SIGKILLed worker takes its tasks' reported
+                    # stats with it, so the death query can only prove
+                    # it still ran fused (one 2-member unit at minimum)
+                    assert ex.get("fusedFragments", 0) >= 2, (sql[:60], ex)
+                else:
+                    assert ex.get("fusedFragments", 0) == fused_ex[
+                        sql
+                    ].get("fusedFragments", 0), (sql[:60], ex, fused_ex[sql])
+                if k != death_idx:
+                    # recovery attempts legitimately add dispatches on
+                    # the death query; everywhere else spooling must
+                    # cost zero extra round-trips
+                    assert ex.get("dispatchRoundTrips", 0) <= fused_ex[
+                        sql
+                    ].get("dispatchRoundTrips", 0), (sql[:60], ex)
+                else:
+                    assert any(
+                        p.poll() is not None
+                        for p in spool_cluster._worker_procs
+                    ), "the injected worker-exit fault never fired"
+                    # bring the killed worker back so the remaining
+                    # queries' round-trip counts reflect spooling alone,
+                    # not placement retries against a dead node
+                    _restore_dead_workers(spool_cluster)
+            spooled = [
+                q for q in _query_infos(spool_cluster)
+                if q.get("retryPolicy") == "TASK"
+            ]
+            assert all(
+                q.get("queryAttempts") == 1 for q in spooled
+            ), "worker death must not escalate to a QUERY retry"
+            assert sum(q.get("recoveredTasks", 0) for q in spooled) >= 1, (
+                "recovery never engaged"
+            )
+            assert any(q.get("spooledBytes", 0) > 0 for q in spooled), (
+                "nothing was spooled"
+            )
+        finally:
+            _restore_dead_workers(spool_cluster)
+
+    def test_lost_unit_spool_repoints_without_reexecution(
+        self, spool_cluster
+    ):
+        """A killed worker that finished a whole fused unit: the unit's
+        unit-boundary output spooled completely, so its consumers
+        re-point at ONE SpoolHandle (level=task) — zero re-execution."""
+        try:
+            clean, _ = spool_cluster.execute(
+                FUSED_JOIN_SQL, session_properties=FUSED_JOIN_PROPS
+            )
+            units = _fuse_units(FUSED_JOIN_SQL, **FUSED_JOIN_PROPS)
+            assert units, "join-of-aggregations no longer fuses"
+            props = dict(
+                FUSED_SPOOL_PROPS,
+                **FUSED_JOIN_PROPS,
+                **DEATH_WINDOW,
+                fault_worker_exit_site=f"{units[0].id}.0",
+            )
+            chaotic, _ = spool_cluster.execute(
+                FUSED_JOIN_SQL, session_properties=props
+            )
+            assert chaotic == clean
+            info = _last_info(spool_cluster, FUSED_JOIN_SQL)
+            assert info.get("queryAttempts") == 1
+            assert info.get("recoveredTasks", 0) >= 1
+            assert info.get("recoveredTaskLevels", {}).get("task", 0) >= 1
+            assert (info.get("exchangeStats") or {}).get(
+                "fusedFragments", 0
+            ) >= 2
+        finally:
+            _restore_dead_workers(spool_cluster)
+
+    def test_fused_unit_reexecution_when_spool_rejected(self, spool_cluster):
+        """With every spool page cap-rejected the lost unit cannot
+        re-point — the whole unit re-executes atomically on a survivor
+        (recoveredTaskLevels.fused, counted in the fused recovery
+        metric) and the rows stay bit-identical, still queryAttempts==1."""
+        try:
+            clean, _ = spool_cluster.execute(
+                FUSED_JOIN_SQL, session_properties=FUSED_JOIN_PROPS
+            )
+            units = _fuse_units(FUSED_JOIN_SQL, **FUSED_JOIN_PROPS)
+            assert units, "join-of-aggregations no longer fuses"
+            props = dict(
+                FUSED_SPOOL_PROPS,
+                **FUSED_JOIN_PROPS,
+                **DEATH_WINDOW,
+                spool_max_bytes=1,  # every page rejected: no task tier
+                fault_worker_exit_site=f"{units[0].id}.0",
+            )
+            chaotic, _ = spool_cluster.execute(
+                FUSED_JOIN_SQL, session_properties=props
+            )
+            assert chaotic == clean
+            info = _last_info(spool_cluster, FUSED_JOIN_SQL)
+            assert info.get("queryAttempts") == 1
+            assert info.get("recoveredTaskLevels", {}).get("fused", 0) >= 1, (
+                info.get("recoveredTaskLevels")
+            )
+            # the observability satellite: the per-level recovery counter
+            # carries the new fused level on /v1/metrics
+            assert 'trino_tpu_recovered_tasks_total{level="fused"}' in (
+                _coordinator_metrics(spool_cluster)
+            )
+        finally:
+            _restore_dead_workers(spool_cluster)
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+class TestBatchedRecoveryUnderWorkerDeath:
+    def test_batch_members_bit_identical_across_worker_death(
+        self, spool_cluster
+    ):
+        """satellite: cross-query batching × recovery. Two literal-variant
+        queries join one batch window on the cluster coordinator; the
+        batched path falls back to sequential member execution there, a
+        worker SIGKILLed mid-run is absorbed by TASK retry/recovery —
+        every member bit-identical, queryAttempts == 1, and the batch
+        really formed (size=2 dispatch counted)."""
+        variants = [
+            "select sum(l_extendedprice * l_discount) as revenue "
+            "from lineitem where l_quantity < 24",
+            "select sum(l_extendedprice * l_discount) as revenue "
+            "from lineitem where l_quantity < 30",
+        ]
+        try:
+            clean = {
+                sql: spool_cluster.execute(sql)[0] for sql in variants
+            }
+            assert clean[variants[0]] != clean[variants[1]], (
+                "variants must differ so member isolation is provable"
+            )
+            before = _coordinator_metrics(spool_cluster).count(
+                'trino_tpu_batched_dispatches_total{size="2"}'
+            )
+            # identical props (the group key includes the session
+            # signature). The window only bounds the WAIT for a straggler
+            # member — max_size=2 flushes the instant the second member
+            # arrives — so a generous window costs nothing on success and
+            # absorbs scheduling lag between the two submit threads on a
+            # loaded machine
+            props = dict(
+                FUSED_SPOOL_PROPS,
+                batch_window_ms=10000,
+                batch_max_size=2,
+                **DEATH_WINDOW,
+                fault_worker_exit_site="1.0",  # the lineitem scan stage
+            )
+            results: dict = {}
+            errors: list = []
+
+            def run(sql):
+                try:
+                    results[sql] = spool_cluster.execute(
+                        sql, session_properties=props
+                    )[0]
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+            threads = [
+                threading.Thread(target=run, args=(sql,), daemon=True)
+                for sql in variants
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert not errors, f"batch members failed: {errors}"
+            for sql in variants:
+                assert results[sql] == clean[sql], (
+                    f"batch member diverged: {sql[:60]}"
+                )
+            assert any(
+                p.poll() is not None for p in spool_cluster._worker_procs
+            ), "the injected worker-exit fault never fired"
+            for sql in variants:
+                assert _last_info(spool_cluster, sql).get(
+                    "queryAttempts"
+                ) == 1, "death during a batched run escalated to QUERY retry"
+            metrics = _coordinator_metrics(spool_cluster)
+            assert metrics.count(
+                'trino_tpu_batched_dispatches_total{size="2"}'
+            ) >= max(before, 1), "the two members never shared a batch"
+        finally:
+            _restore_dead_workers(spool_cluster)

@@ -467,9 +467,21 @@ class Engine:
             # land on the same entry with different parameter vectors
             plan = self.plan(stmt, session)
             exec_plan, params, entry = plan, [], None
+            mode = session.get("execution_mode")
+            try:
+                wants_batch = int(session.get("batch_window_ms")) > 0
+            except KeyError:
+                wants_batch = False
             if (
                 sql_text is not None
-                and session.get("execution_mode") == "distributed"
+                # cluster queries canonicalize only to join the batch
+                # collector (grouping needs the fingerprint); each
+                # member binds its own literals back before the
+                # scheduler ships fragments (_execute_query_plan)
+                and (
+                    mode == "distributed"
+                    or (mode == "cluster" and wants_batch)
+                )
                 and session.get("fragment_execution")
                 and bool(session.get("program_cache"))
                 and self._sql_cacheable(sql_text)
@@ -495,7 +507,7 @@ class Engine:
             # window=0 — the default — keeps the path below verbatim.
             if (
                 entry is not None
-                and int(session.get("batch_window_ms")) > 0
+                and wants_batch
                 and "__txn" not in session.properties
             ):
                 return self.batch_collector.submit(
@@ -573,6 +585,13 @@ class Engine:
         if session.get("execution_mode") == "cluster" and (
             self.cluster_scheduler is not None or self.spmd is not None
         ):
+            if params:
+                # a canonical (hoisted) plan reached the cluster path — a
+                # batch member, or its sequential fallback. The wire serde
+                # drops hoisted values, so bake this query's literals back
+                from trino_tpu.planner.canonicalize import bind_params
+
+                plan = bind_params(plan, params)
             batch = None
             if self.spmd is not None and self.spmd_peers is not None:
                 from trino_tpu.parallel.spmd import SpmdUnsupported

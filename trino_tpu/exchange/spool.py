@@ -72,6 +72,7 @@ class SpoolStore:
         self._total_bytes = 0
         self._evicted_bytes = 0
         self._rejected_pages = 0
+        self.reaped_entries = 0  # startup debris sweep (disk backend)
         self._lock = threading.Lock()
 
     # --- backend hooks ----------------------------------------------------
@@ -85,6 +86,11 @@ class SpoolStore:
 
     def _delete_pages(self, task_id: str, handles: list) -> None:
         raise NotImplementedError
+
+    def _persist_manifest(self, task_id: str, query_id: str,
+                          partitions: dict[int, int]) -> None:
+        """Durable completion marker (disk backend): a spool directory
+        without one is half-written debris after a coordinator crash."""
 
     # --- write path (worker POSTs relayed by server/http.py) --------------
 
@@ -145,12 +151,16 @@ class SpoolStore:
                         task_id, query_id
                     )
                     entry.complete = True
+                    self._persist_manifest(task_id, query_id, {})
                     return True
                 return False
             for p, count in partitions.items():
                 if len(entry.pages.get(int(p), [])) != int(count):
                     return False
             entry.complete = True
+            self._persist_manifest(
+                task_id, query_id, {int(p): int(c) for p, c in partitions.items()}
+            )
             return True
 
     # --- read path (coordinator /v1/spool results route) ------------------
@@ -240,6 +250,7 @@ class SpoolStore:
                 "evictedBytes": self._evicted_bytes,
                 "rejectedPages": self._rejected_pages,
                 "finishedQueries": len(self._finished_queries),
+                "reapedEntries": self.reaped_entries,
             }
 
 
@@ -257,22 +268,35 @@ class MemorySpoolStore(SpoolStore):
 
 
 class DiskSpoolStore(SpoolStore):
-    """Local-disk backend: one file per page under ``dir`` (the registry
-    — counts, manifests, ordering — stays in memory; the coordinator
-    process owns the spool, so a coordinator restart discards it either
-    way)."""
+    """Local-disk backend: one directory per task under ``dir`` holding
+    ``p{partition}.{seq}.page`` files plus a ``manifest.json`` written
+    (tmp + rename) when the producer's completion manifest verifies. The
+    live registry stays in memory; the on-disk manifest exists so a
+    later process can tell a COMPLETE spool from half-written debris.
+
+    Crash safety: a coordinator ``kill -9`` leaves ``*.tmp`` files and
+    manifest-less task directories behind. ``_reap_debris`` sweeps both
+    on startup (counted in ``reaped_entries`` / stats ``reapedEntries``)
+    and re-registers manifest-complete directories as readable, already
+    finish-marked (evictable) spools."""
 
     def __init__(self, directory: str, max_bytes: int = 256 << 20):
         super().__init__(max_bytes)
         self.dir = directory
         os.makedirs(self.dir, exist_ok=True)
+        self._reap_debris()
+
+    def _task_dir(self, task_id: str) -> str:
+        return os.path.join(self.dir, task_id.replace("/", "_"))
 
     def _path(self, task_id: str, partition: int, seq: int) -> str:
-        safe = task_id.replace("/", "_")
-        return os.path.join(self.dir, f"{safe}.p{partition}.{seq}.page")
+        return os.path.join(
+            self._task_dir(task_id), f"p{partition}.{seq}.page"
+        )
 
     def _store_page(self, task_id, partition, seq, page):
         path = self._path(task_id, partition, seq)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(page)
@@ -289,6 +313,105 @@ class DiskSpoolStore(SpoolStore):
                 os.remove(path)
             except OSError:
                 pass
+        d = self._task_dir(task_id)
+        try:
+            os.remove(os.path.join(d, "manifest.json"))
+        except OSError:
+            pass
+        try:
+            os.rmdir(d)  # only if nothing is left in it
+        except OSError:
+            pass
+
+    def _persist_manifest(self, task_id, query_id, partitions):
+        d = self._task_dir(task_id)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, "manifest.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "taskId": task_id,
+                    "queryId": query_id,
+                    "partitions": {str(p): c for p, c in partitions.items()},
+                },
+                f,
+            )
+        os.replace(tmp, os.path.join(d, "manifest.json"))
+
+    def _reap_debris(self) -> None:
+        """Startup sweep. Orphaned ``*.tmp`` files (anywhere) and task
+        directories without a landed ``manifest.json`` are deleted;
+        directories with one are rehydrated into the registry so their
+        data stays readable — and reclaimable via normal eviction."""
+        import shutil
+
+        reaped = 0
+        for name in sorted(os.listdir(self.dir)):
+            path = os.path.join(self.dir, name)
+            if not os.path.isdir(path):
+                # loose file in the spool root: a torn tmp or a stray
+                # page from an older layout — debris either way
+                try:
+                    os.remove(path)
+                    reaped += 1
+                except OSError:
+                    pass
+                continue
+            manifest_path = os.path.join(path, "manifest.json")
+            if not os.path.isfile(manifest_path):
+                shutil.rmtree(path, ignore_errors=True)
+                reaped += 1
+                continue
+            for fn in sorted(os.listdir(path)):
+                if fn.endswith(".tmp"):
+                    try:
+                        os.remove(os.path.join(path, fn))
+                        reaped += 1
+                    except OSError:
+                        pass
+            if not self._rehydrate(path, manifest_path):
+                shutil.rmtree(path, ignore_errors=True)
+                reaped += 1
+        self.reaped_entries = reaped
+
+    def _rehydrate(self, task_dir: str, manifest_path: str) -> bool:
+        """Re-register one manifest-complete spool directory; False when
+        the stored pages don't match the manifest (treated as debris)."""
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            task_id = manifest["taskId"]
+            query_id = manifest["queryId"]
+            partitions = {
+                int(p): int(c)
+                for p, c in (manifest.get("partitions") or {}).items()
+            }
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+        entry = _TaskSpool(task_id, query_id)
+        for fn in os.listdir(task_dir):
+            if not fn.endswith(".page"):
+                continue
+            try:
+                stem = fn[:-len(".page")]
+                p_str, seq_str = stem.lstrip("p").split(".", 1)
+                partition, seq = int(p_str), int(seq_str)
+            except ValueError:
+                return False
+            path = os.path.join(task_dir, fn)
+            entry.pages.setdefault(partition, []).append((seq, path))
+            entry.seqs.add((partition, seq))
+            entry.bytes += os.path.getsize(path)
+        for p, count in partitions.items():
+            if len(entry.pages.get(p, [])) != count:
+                return False
+        entry.complete = True
+        with self._lock:
+            self._tasks[task_id] = entry
+            self._total_bytes += entry.bytes
+        # an inherited spool's query is long gone: evictable immediately
+        self.finish_query(query_id)
+        return True
 
 
 def get_spool_store(engine, spool_dir: str = "",

@@ -214,6 +214,72 @@ def _strip_scan_constraints(node: P.PlanNode) -> P.PlanNode:
     return node
 
 
+def _bind_expr(e: RowExpr, values: list) -> RowExpr:
+    if isinstance(e, HoistedConstant):
+        return Constant(type=e.type, value=values[e.index])
+    if isinstance(e, Call):
+        args = tuple(_bind_expr(a, values) for a in e.args)
+        return e if args == e.args else Call(type=e.type, name=e.name, args=args)
+    if isinstance(e, SpecialForm):
+        args = tuple(_bind_expr(a, values) for a in e.args)
+        return (
+            e if args == e.args
+            else SpecialForm(type=e.type, form=e.form, args=args)
+        )
+    return e
+
+
+def _bind_node(node: P.PlanNode, values: list) -> P.PlanNode:
+    """Mirror of ``_rewrite_node``'s positions, replacing each
+    ``HoistedConstant`` with a plain ``Constant`` carrying this query's
+    literal."""
+    changes: dict[str, Any] = {}
+    if isinstance(node, P.Filter):
+        p2 = _bind_expr(node.predicate, values)
+        if p2 is not node.predicate:
+            changes["predicate"] = p2
+    elif isinstance(node, P.Project):
+        new = [(s, _bind_expr(e, values)) for s, e in node.assignments]
+        if any(e2 is not e for (_, e2), (_, e) in zip(new, node.assignments)):
+            changes["assignments"] = new
+    elif isinstance(node, P.Join) and node.filter is not None:
+        f2 = _bind_expr(node.filter, values)
+        if f2 is not node.filter:
+            changes["filter"] = f2
+
+    if isinstance(node, P.Join):
+        left = _bind_node(node.left, values)
+        right = _bind_node(node.right, values)
+        if left is not node.left:
+            changes["left"] = left
+        if right is not node.right:
+            changes["right"] = right
+    elif isinstance(node, P.SetOp):
+        new_inputs = [_bind_node(s, values) for s in node.inputs]
+        if any(a is not b for a, b in zip(new_inputs, node.inputs)):
+            changes["inputs"] = new_inputs
+    elif getattr(node, "source", None) is not None:
+        src = _bind_node(node.source, values)
+        if src is not node.source:
+            changes["source"] = src
+    return dataclasses.replace(node, **changes) if changes else node
+
+
+def bind_params(plan: P.PlanNode, params: list) -> P.PlanNode:
+    """Re-bake a canonical plan's hoisted literals as plain Constants.
+
+    The inverse of hoisting, for executors that cannot carry a parameter
+    vector: the cluster scheduler ships fragments over the wire and the
+    canonical serde intentionally drops ``HoistedConstant`` values, so a
+    cluster (or batched-then-sequential-fallback) run of a cached plan
+    must bind THIS query's ``params`` back in before fragmentation.
+    ``params`` is the ordered ``(value, type)`` list ``canonicalize_plan``
+    returned — for a batch member, its own vector, not the leader's."""
+    if not params:
+        return plan
+    return _bind_node(plan, [v for v, _ in params])
+
+
 def _alpha_rename(obj: Any, names: dict) -> Any:
     """Positionally rename symbols in the serialized plan (``count_16`` →
     ``s3``). The planner allocates symbol names off a process-global

@@ -380,6 +380,26 @@ class FusedFragment:
     def fragment_ids(self) -> tuple[int, ...]:
         return tuple(f.id for f in self.fragments)
 
+    @property
+    def member_ids(self) -> frozenset:
+        return frozenset(f.id for f in self.fragments)
+
+    @property
+    def external_source_ids(self) -> tuple[int, ...]:
+        """Source fragment ids the unit pulls from OUTSIDE itself — the
+        unit's recovery lineage. Interior links are in-jit collectives
+        with no retained pages, so healing a unit means healing exactly
+        these (each is itself a unit root or a plain fragment: a
+        fragment tree has a single consumer per exchange, so an external
+        producer can never be the interior of another unit)."""
+        inside = self.member_ids
+        out: list[int] = []
+        for f in self.fragments:
+            for fid in f.source_fragment_ids:
+                if fid not in inside and fid not in out:
+                    out.append(fid)
+        return tuple(out)
+
 
 def partitioned_join_pairs(sub) -> list[tuple[int, int]]:
     """(probe_fid, build_fid) producer pairs of every partitioned

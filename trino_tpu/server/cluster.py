@@ -488,14 +488,16 @@ class ClusterScheduler:
         # (in-jit collectives cannot cross worker process boundaries, so
         # a fused unit trades cross-worker fan-out for zero interior
         # dispatch round-trips). Speculation/retry operate on the unit
-        # task. Spooled exchange needs per-fragment retained boundaries,
-        # so it keeps the per-fragment path.
+        # task. Spooled exchange coexists: the unit's only materialized
+        # outputs are its unit-boundary buffers, so those are the spool
+        # pages — recovery then works at unit granularity (re-point a
+        # complete unit spool, or re-execute the lost unit atomically).
         units_members: dict[int, list[PlanFragment]] = {}
         unit_root_of: dict[int, int] = {}
+        units_fused: dict[int, FusedFragment] = {}
         if (
             bool(session.get("pipeline_fusion"))
             and str(session.get("worker_execution")).startswith("fused")
-            and not bool(session.get("exchange_spooling"))
         ):
             from trino_tpu.exec.fragments import fragment_fusable
 
@@ -515,6 +517,7 @@ class ClusterScheduler:
             for u in units:
                 if isinstance(u, FusedFragment):
                     units_members[u.id] = list(u.fragments)
+                    units_fused[u.id] = u
                     for m in u.fragments:
                         unit_root_of[m.id] = u.id
 
@@ -603,6 +606,7 @@ class ClusterScheduler:
                 "base_uri": spool_base,
                 "lineage_seq": itertools.count(1),
                 "obs": obs,
+                "units": units_fused,
             }
         ok = False
         try:
@@ -976,16 +980,50 @@ class ClusterScheduler:
             return False
         return st.get("state") == "FINISHED"
 
+    def _source_fids(self, frag, rc) -> tuple:
+        """The producer fragment ids ``frag`` actually pulls from. For a
+        fused-unit root that is the unit's *external* sources — every
+        member's out-of-unit producer — because interior links are in-jit
+        collectives with no tasks of their own. Everything else pulls its
+        plain ``source_fragment_ids``."""
+        unit = (rc.get("units") or {}).get(getattr(frag, "id", None))
+        if unit is not None:
+            return unit.external_source_ids
+        return tuple(getattr(frag, "source_fragment_ids", ()) or ())
+
+    def _rebuild_sources(self, frag, partition: int, rc: dict) -> dict:
+        """Source URIs for a (re)dispatched attempt of ``frag``, rebuilt
+        from the current remote_tasks — which may now hold spool handles
+        or recovered attempts. Unit-aware: a fused unit's sources span
+        all members, with in-unit links excluded."""
+        unit = (rc.get("units") or {}).get(getattr(frag, "id", None))
+        if unit is not None:
+            sources: dict = {}
+            for m in unit.fragments:
+                sources.update(
+                    self._sources_payload(
+                        m, partition, rc["remote_tasks"], rc["fragments"],
+                        exclude=unit.member_ids,
+                    )
+                )
+            return sources
+        return self._sources_payload(
+            frag, partition, rc["remote_tasks"], rc["fragments"]
+        )
+
     def _heal_sources(self, frag, rc, probe: bool = False) -> bool:
         """Recover every dead producer feeding ``frag``: spool re-point
         when the task's output spooled completely (level=task), else
         re-execute just that producer — recursively healing ITS sources
-        first (level=lineage). Returns whether anything was recovered
-        (callers then rebuild consumer source URIs from remote_tasks)."""
+        first (level=lineage, or level=fused when the producer is a
+        whole fused unit re-run atomically). Returns whether anything was
+        recovered (callers then rebuild consumer source URIs from
+        remote_tasks). Fused-unit consumers heal the unit's *external*
+        sources — interior members have no tasks to heal."""
         if rc is None:
             return False
         healed = False
-        for fid in getattr(frag, "source_fragment_ids", ()) or ():
+        for fid in self._source_fids(frag, rc):
             tasks = rc["remote_tasks"].get(fid)
             if not tasks:
                 continue
@@ -1000,46 +1038,21 @@ class ClusterScheduler:
                       probe: bool = False) -> None:
         """Recover one lost producer task. Tier 1 (level=task): its spool
         is complete — swap a :class:`SpoolHandle` into remote_tasks so
-        consumers read the durable copy; no re-execution at all. Tier 2
-        (level=lineage): re-run only this producer on a healthy node,
-        healing its own sources first."""
+        consumers read the durable copy; no re-execution at all (a fused
+        unit's spool holds its unit-boundary output buffers, so the
+        whole unit re-points as one handle). Tier 2: re-run only this
+        producer on a healthy node, healing its own sources first —
+        level=lineage for a plain fragment, level=fused when the lost
+        producer is a fused unit re-executed atomically."""
         tasks = rc["remote_tasks"][fid]
         old = tasks[idx]
         store = rc.get("store")
-        stats = rc["stats"]
-        stage_span = (rc.get("obs") or {}).get("stage_spans", {}).get(fid)
         if (
             store is not None
             and rc.get("base_uri")
             and store.is_complete(old.task_id)
         ):
-            handle = SpoolHandle(rc["base_uri"], old.task_id)
-            handle.payload = old.payload
-            handle.attempt = getattr(old, "attempt", 1)
-            tasks[idx] = handle
-            self.node_scheduler.release(old.node)
-            get_registry().counter(
-                "trino_tpu_recovered_tasks_total", level="task"
-            ).inc()
-            stats["recovered_tasks"] = stats.get("recovered_tasks", 0) + 1
-            levels = stats.setdefault("recovered_levels", {})
-            levels["task"] = levels.get("task", 0) + 1
-            # synthetic zero-length attempt span: the waterfall shows the
-            # recovery point without pretending work re-ran
-            span = get_tracer().start_span(
-                "task_attempt",
-                trace_id=getattr(stage_span, "trace_id", None),
-                parent_id=getattr(stage_span, "span_id", None),
-                attrs={
-                    "taskId": old.task_id,
-                    "stage": fid,
-                    "worker": "__spool__",
-                    "attempt": handle.attempt,
-                    "recovered": True,
-                    "spool": True,
-                },
-            )
-            span.finish(status="OK", state="FINISHED")
+            self._spool_repoint(fid, idx, rc)
             return
         frag = rc["fragments"].get(fid)
         if frag is not None:
@@ -1048,12 +1061,52 @@ class ClusterScheduler:
             self._heal_sources(frag, rc, probe=probe)
         self._run_recovery_task(fid, idx, rc)
 
+    def _spool_repoint(self, fid: int, idx: int, rc: dict) -> None:
+        """Swap a :class:`SpoolHandle` over a lost-but-fully-spooled
+        attempt in remote_tasks (level=task — zero re-execution). The
+        caller has already established ``store.is_complete(task_id)``."""
+        tasks = rc["remote_tasks"][fid]
+        old = tasks[idx]
+        stats = rc["stats"]
+        stage_span = (rc.get("obs") or {}).get("stage_spans", {}).get(fid)
+        handle = SpoolHandle(rc["base_uri"], old.task_id)
+        handle.payload = old.payload
+        handle.attempt = getattr(old, "attempt", 1)
+        tasks[idx] = handle
+        self.node_scheduler.release(old.node)
+        get_registry().counter(
+            "trino_tpu_recovered_tasks_total", level="task"
+        ).inc()
+        stats["recovered_tasks"] = stats.get("recovered_tasks", 0) + 1
+        levels = stats.setdefault("recovered_levels", {})
+        levels["task"] = levels.get("task", 0) + 1
+        # synthetic zero-length attempt span: the waterfall shows the
+        # recovery point without pretending work re-ran
+        span = get_tracer().start_span(
+            "task_attempt",
+            trace_id=getattr(stage_span, "trace_id", None),
+            parent_id=getattr(stage_span, "span_id", None),
+            attrs={
+                "taskId": old.task_id,
+                "stage": fid,
+                "worker": "__spool__",
+                "attempt": handle.attempt,
+                "recovered": True,
+                "spool": True,
+                "fused": (rc.get("units") or {}).get(fid) is not None,
+            },
+        )
+        span.finish(status="OK", state="FINISHED")
+
     def _run_recovery_task(self, fid: int, idx: int, rc: dict,
                            max_attempts: int = 3) -> None:
         """Re-execute one lost producer task to completion (lineage tier).
         Runs synchronously — recovery sits on a consumer's critical path
         anyway. Task ids take an ``l{k}`` suffix (fresh injection sites,
-        distinct from ``r``etries and ``s``peculation)."""
+        distinct from ``r``etries and ``s``peculation). A fused unit
+        re-executes atomically — its payload still carries the whole
+        member chain, the worker re-traces through the fused program
+        cache — and counts at level=fused (``{qid}.{unit}.{i}l{k}``)."""
         from trino_tpu.ft.retry import (
             TaskFailure,
             TaskRetriesExhausted,
@@ -1069,6 +1122,8 @@ class ClusterScheduler:
         except KeyError:
             budget_s = 300.0
         stage_span = (rc.get("obs") or {}).get("stage_spans", {}).get(fid)
+        is_unit = (rc.get("units") or {}).get(fid) is not None
+        level = "fused" if is_unit else "lineage"
         exclude = tasks[idx].node.node_id
         last_error: Optional[str] = None
         for _ in range(max_attempts):
@@ -1080,9 +1135,7 @@ class ClusterScheduler:
             if frag is not None:
                 # sources rebuilt NOW: they may point at spool handles or
                 # other just-recovered attempts
-                payload["sources"] = self._sources_payload(
-                    frag, idx, rc["remote_tasks"], rc["fragments"]
-                )
+                payload["sources"] = self._rebuild_sources(frag, idx, rc)
             task = HttpRemoteTask(node, new_id, payload, **rc["http"])
             task.attempt = getattr(old, "attempt", 1) + 1
             task.recovered = True
@@ -1097,6 +1150,7 @@ class ClusterScheduler:
                     "attempt": task.attempt,
                     "recovered": True,
                     "lineage": True,
+                    "fused": is_unit,
                 },
             )
             task.span = att
@@ -1117,13 +1171,13 @@ class ClusterScheduler:
                             rc["query_id"], fid, task, st, rc.get("obs")
                         )
                         get_registry().counter(
-                            "trino_tpu_recovered_tasks_total", level="lineage"
+                            "trino_tpu_recovered_tasks_total", level=level
                         ).inc()
                         stats["recovered_tasks"] = (
                             stats.get("recovered_tasks", 0) + 1
                         )
                         levels = stats.setdefault("recovered_levels", {})
-                        levels["lineage"] = levels.get("lineage", 0) + 1
+                        levels[level] = levels.get(level, 0) + 1
                         return
                     if state == "FAILED":
                         r = st.get("retryable")
@@ -1354,6 +1408,28 @@ class ClusterScheduler:
                         raise TaskFailure(
                             t.task_id, t.node.node_id, failure, retryable=False
                         )
+                    if (
+                        rc is not None
+                        and rc.get("store") is not None
+                        and rc.get("base_uri")
+                        and rc["store"].is_complete(t.task_id)
+                    ):
+                        # stage-barrier spool re-point: the attempt (e.g. a
+                        # single-task fused unit whose worker was killed
+                        # right after finishing) is lost but its output
+                        # spooled completely — the durable copy IS the
+                        # attempt's output, so swap in a SpoolHandle and
+                        # close the slot without re-running anything
+                        h = hedges.get(i)
+                        if h is not None:
+                            _drop_hedge(
+                                i, h, {"state": "CANCELED_SPECULATIVE"},
+                                outcome="cancelled",
+                            )
+                        t.cancel()
+                        self._spool_repoint(frag.id, i, rc)
+                        pending.discard(i)
+                        continue
                     h = hedges.pop(i, None)
                     if h is not None:
                         # the primary died while its hedge is in flight:
@@ -1390,9 +1466,7 @@ class ClusterScheduler:
                         # spool handles or recovered attempts
                         self._heal_sources(frag, rc, probe=True)
                         payload = dict(t.payload)
-                        payload["sources"] = self._sources_payload(
-                            frag, i, rc["remote_tasks"], rc["fragments"]
-                        )
+                        payload["sources"] = self._rebuild_sources(frag, i, rc)
                     node = self._retry_node(exclude=t.node.node_id)
                     attempts[i] += 1
                     base = f"{query_id}.{frag.id}.{i}"
@@ -1482,9 +1556,20 @@ class ClusterScheduler:
                     and obs is not None
                     and obs.get("spec_active", 0) < obs.get("spec_budget", 0)
                 ):
-                    threshold = spec.threshold_ms(
-                        obs["elapsed"].get(frag.id, [])
-                    )
+                    samples = obs["elapsed"].get(frag.id, [])
+                    if (
+                        len(tasks) == 1
+                        and len(samples) < getattr(spec, "min_completed", 1)
+                        and tasks[0].payload.get("fused_fragments")
+                    ):
+                        # a fused unit is a single-task stage — it has no
+                        # siblings to threshold against, so borrow the
+                        # query-wide completed-attempt samples (earlier
+                        # stages/units of this query) for the p99
+                        samples = [
+                            v for vs in obs["elapsed"].values() for v in vs
+                        ]
+                    threshold = spec.threshold_ms(samples)
                     if threshold is not None:
                         for i in sorted(pending):
                             if i in hedges:

@@ -216,7 +216,9 @@ class TrinoTpuServer:
         # force-spool retained buffers: a consumer stage that has not yet
         # pulled this worker's output reads it from the coordinator's
         # spool once we are gone (finish() is idempotent — tasks that
-        # already published on FINISHED return their cached result)
+        # already published on FINISHED return their cached result).
+        # A fused-unit task is no different: its single retained buffer
+        # IS the unit-boundary output, so the whole unit stays readable
         for t in self.task_manager.tasks():
             writer = getattr(t.buffer, "spool_writer", None)
             if writer is not None and t.state == "FINISHED":

@@ -269,7 +269,11 @@ class ManagedQuery:
             "speculativeWins": cluster_stats.get("speculative_wins", 0),
             # spooled-exchange recovery (trino_tpu/exchange/spool.py):
             # tasks healed after producer death, by tier (task = spool
-            # re-point, lineage = producer re-execution)
+            # re-point, lineage = producer re-execution, fused = a whole
+            # fused unit re-executed atomically). With worker_execution=
+            # fused these ride alongside exchangeStats.fusedFragments:
+            # spooledBytes counts unit-boundary pages, recoveredTasks
+            # counts healed units — fusion and recovery coexist
             "recoveredTasks": cluster_stats.get("recovered_tasks", 0),
             "recoveredTaskLevels": cluster_stats.get("recovered_levels", {}),
             "spooledBytes": cluster_stats.get("spooled_bytes", 0),

@@ -73,7 +73,9 @@ function bar(span, t0, total, cls) {
   const a = span.attrs || {};
   let c = cls;
   // speculative attempts render distinctly: amber for the hedge,
-  // muted for whichever attempt lost the race and was cancelled
+  // muted for whichever attempt lost the race and was cancelled.
+  // Recovery (teal) covers every tier: spool re-points, lineage
+  // re-execution, and whole fused-unit re-runs (attrs.fused) alike
   if (a.speculative) c += ' spec';
   if (a.recovered) c += ' rec';
   if (a.state === 'CANCELED_SPECULATIVE') c += ' spec cancelled';
