@@ -204,6 +204,88 @@ def parquet_table_cache(sf: float = 0.05) -> dict:
     return out
 
 
+def adaptive_history(n_rows: int = 1 << 16) -> dict:
+    """Cold vs history-warm on a Zipf-skewed partitioned join with skew
+    handling off: the cold engine overflow-retries its way to the right
+    capacities and records them into a persistent query-history store
+    (obs/history.py); a FRESH engine sharing the same ``history_dir``
+    then repeats the query seeded from observed truth. Reports the
+    retry/halving delta and the wall-time ratio — the history win is
+    the recompiles the warm run never pays."""
+    import tempfile
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.columnar import Batch, Column
+    from trino_tpu.config import Session
+    from trino_tpu.connectors.api import ColumnSchema, TableSchema
+    from trino_tpu.testing import LocalQueryRunner
+
+    sql = ("select sum(f.v * d.name) as chk, count(*) as c "
+           "from memory.default.facts f "
+           "join memory.default.dims d on f.k = d.k")
+
+    def _seed(catalogs):
+        mem = catalogs.get("memory")
+        rng = np.random.default_rng(7)
+        raw = rng.zipf(1.2, size=6 * n_rows)
+        keys = raw[raw <= 8][:n_rows].astype(np.int64)
+        vals = rng.integers(0, 1000, n_rows).astype(np.int64)
+        mem.create_table(
+            "default", "facts",
+            TableSchema("facts", (ColumnSchema("k", T.BIGINT),
+                                  ColumnSchema("v", T.BIGINT))))
+        mem.insert(
+            "default", "facts",
+            Batch([Column(T.BIGINT, keys), Column(T.BIGINT, vals)], n_rows))
+        dk = np.arange(1, 9, dtype=np.int64)
+        mem.create_table(
+            "default", "dims",
+            TableSchema("dims", (ColumnSchema("k", T.BIGINT),
+                                 ColumnSchema("name", T.BIGINT))))
+        mem.insert("default", "dims",
+                   Batch([Column(T.BIGINT, dk), Column(T.BIGINT, dk * 100)],
+                         8))
+
+    out: dict = {"rows": n_rows}
+    with tempfile.TemporaryDirectory() as hdir:
+        props = {
+            "execution_mode": "distributed",
+            "join_distribution_type": "PARTITIONED",
+            "skew_handling": False,  # capacity misses land on retries
+            "history_dir": hdir,
+        }
+
+        def _phase(label):
+            # fresh runner per phase: only the on-disk store carries over
+            runner = LocalQueryRunner()
+            _seed(runner.catalogs)
+            t0 = time.time()
+            res = runner.engine.execute_statement(
+                sql, Session(properties=props)
+            )
+            out[f"{label}_s"] = round(time.time() - t0, 3)
+            ex = res.exchange_stats or {}
+            out[f"{label}_overflow_retries"] = ex.get("overflow_retries", 0)
+            out[f"{label}_compile_halvings"] = ex.get("compile_halvings", 0)
+            out[f"{label}_history_seeds"] = ex.get("history_seeds", 0)
+            return res.rows
+
+        cold = _phase("cold")
+        warm = _phase("warm")
+    out["identical"] = warm == cold
+    out["retry_delta"] = (
+        out["cold_overflow_retries"] - out["warm_overflow_retries"]
+    )
+    out["halving_delta"] = (
+        out["cold_compile_halvings"] - out["warm_compile_halvings"]
+    )
+    if out["warm_s"] > 0:
+        out["speedup"] = round(out["cold_s"] / out["warm_s"], 2)
+    return out
+
+
 def _percentile(samples_ms: list, p: float) -> float:
     xs = sorted(samples_ms)
     if not xs:
@@ -438,6 +520,7 @@ def run_suite() -> dict:
         "parquet_table_cache()", 420
     )
     suite["concurrency"] = _subprocess_entry("bench_concurrency()", 420)
+    suite["adaptive_history"] = _subprocess_entry("adaptive_history()", 420)
     suite["suite_wall_s"] = round(time.time() - t0, 1)
     return suite
 

@@ -35,6 +35,14 @@ Boots a 2-worker cluster and runs three scenarios:
    fusedFragments == 0 (the query silently not fusing would void the
    scenario); recovered/spooled/fused counters land in the summary.
 
+7. ``adaptive-warmup`` (in-process, no cluster): a Zipf-skewed
+   partitioned join with skew handling OFF overflows its capacity
+   estimate cold, recording observed truth into a persistent
+   query-history store; a FRESH engine sharing the same ``history_dir``
+   then repeats the query. FAIL unless the warm run shows
+   ``overflow_retries == 0`` AND ``compile_halvings == 0`` AND at least
+   one capacity site with provenance ``history`` AND bit-identical rows.
+
 Quick manual repro for the fault-tolerance stack (CI runs the same
 scenarios as ``tests/test_fault_tolerance.py -m faults`` /
 ``tests/test_speculation.py`` / ``tests/test_spool.py``).
@@ -125,6 +133,88 @@ def _fused_unit_site(sql, **props):
     if not units:
         return None
     return f"{units[0].id}.0"
+
+
+def _adaptive_warmup(seed: int) -> dict:
+    """Cold overflowing skewed join, then the same query on a FRESH
+    engine sharing the persistent history store. The warm engine has no
+    in-process program cache or stats for the query — everything it
+    knows arrives through ``{history_dir}/query_history.json`` — so a
+    clean warm run proves the record → seed feedback loop end to end."""
+    import tempfile
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.columnar import Batch, Column
+    from trino_tpu.config import Session
+    from trino_tpu.connectors.api import ColumnSchema, TableSchema
+    from trino_tpu.testing import LocalQueryRunner
+
+    n = 1 << 16
+    sql = ("select sum(f.v * d.name) as chk, count(*) as c "
+           "from memory.default.facts f "
+           "join memory.default.dims d on f.k = d.k")
+
+    def _seed(catalogs):
+        mem = catalogs.get("memory")
+        rng = np.random.default_rng(seed)
+        raw = rng.zipf(1.2, size=6 * n)
+        keys = raw[raw <= 8][:n].astype(np.int64)  # ~43% on one key
+        vals = rng.integers(0, 1000, n).astype(np.int64)
+        mem.create_table(
+            "default", "facts",
+            TableSchema("facts", (ColumnSchema("k", T.BIGINT),
+                                  ColumnSchema("v", T.BIGINT))))
+        mem.insert("default", "facts",
+                   Batch([Column(T.BIGINT, keys), Column(T.BIGINT, vals)], n))
+        dk = np.arange(1, 9, dtype=np.int64)
+        mem.create_table(
+            "default", "dims",
+            TableSchema("dims", (ColumnSchema("k", T.BIGINT),
+                                 ColumnSchema("name", T.BIGINT))))
+        mem.insert("default", "dims",
+                   Batch([Column(T.BIGINT, dk), Column(T.BIGINT, dk * 100)],
+                         8))
+
+    with tempfile.TemporaryDirectory() as hdir:
+        props = {
+            "execution_mode": "distributed",
+            "join_distribution_type": "PARTITIONED",
+            "skew_handling": False,  # force the cold overflow
+            "history_dir": hdir,
+        }
+
+        def _run(runner):
+            return runner.engine.execute_statement(
+                sql, Session(properties=props)
+            )
+
+        cold_runner = LocalQueryRunner()
+        _seed(cold_runner.catalogs)
+        cold = _run(cold_runner)
+        # FRESH engine: no shared program cache, no in-process stats —
+        # only the on-disk history store carries the observed truth over
+        warm_runner = LocalQueryRunner()
+        _seed(warm_runner.catalogs)
+        warm = _run(warm_runner)
+
+    wex = warm.exchange_stats or {}
+    provs = sorted({
+        str(site.get("provenance", "")).split("+")[0]
+        for site in (wex.get("capacities") or {}).values()
+    })
+    return {
+        "cold_retries": (cold.exchange_stats or {}).get(
+            "overflow_retries", 0),
+        "cold_halvings": (cold.exchange_stats or {}).get(
+            "compile_halvings", 0),
+        "warm_retries": wex.get("overflow_retries", 0),
+        "warm_halvings": wex.get("compile_halvings", 0),
+        "warm_provenance": provs,
+        "history_seeds": wex.get("history_seeds", 0),
+        "drift": warm.rows != cold.rows,
+    }
 
 
 def main() -> int:
@@ -291,6 +381,9 @@ def main() -> int:
             "query_attempts": fused_info.get("queryAttempts", 1),
             "drift": fused_death != fused_clean,
         }
+        # adaptive-warmup runs in-process (fresh engines + a shared
+        # persistent history store), after the clusters are down
+        summary["adaptive_warmup"] = _adaptive_warmup(seed)
         retries = max(q.get("taskRetries", 0) for q in queries)
         spec_attempts = max(q.get("speculativeAttempts", 0) for q in queries)
         spec_wins = max(q.get("speculativeWins", 0) for q in queries)
@@ -404,6 +497,30 @@ def main() -> int:
         if fd["recovered_tasks"] == 0:
             print("WARN: fused-node-death recovered nothing — the unit"
                   " death raced the consumer pull")
+        aw = summary["adaptive_warmup"]
+        if aw["drift"]:
+            print("FAIL: adaptive-warmup warm result differs from cold")
+            summary["ok"] = False
+            return 1
+        if aw["warm_retries"] != 0 or aw["warm_halvings"] != 0:
+            print(
+                "FAIL: adaptive-warmup warm run still corrected itself"
+                f" (overflow_retries={aw['warm_retries']},"
+                f" compile_halvings={aw['warm_halvings']}) — history"
+                " seeding did not carry the observed capacities over"
+            )
+            summary["ok"] = False
+            return 1
+        if "history" not in aw["warm_provenance"]:
+            print(
+                "FAIL: adaptive-warmup warm run has no history-seeded"
+                f" capacity site (provenance={aw['warm_provenance']})"
+            )
+            summary["ok"] = False
+            return 1
+        if aw["cold_retries"] == 0:
+            print("WARN: adaptive-warmup cold run never overflowed — the"
+                  " warm zero-retry check proves nothing at this size")
         if recovered == 0:
             print("WARN: no recovered tasks — the worker-exit fault"
                   " never bit a consumer")
@@ -414,7 +531,7 @@ def main() -> int:
         print(
             "OK: bit-identical under 30% task-crash injection"
             " (incl. skewed join, 10x slow worker, concurrent batched"
-            " clients, node death, fused node death)"
+            " clients, node death, fused node death, adaptive warmup)"
         )
         summary["ok"] = True
         return 0

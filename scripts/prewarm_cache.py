@@ -7,6 +7,12 @@ skips those compiles. Run from the repo root:
 
     JAX_COMPILATION_CACHE_DIR=.jax_cache python scripts/prewarm_cache.py
 
+With ``--history-dir DIR`` (a query-history store written by a prior
+serving run, obs/history.py) the corpus is reordered by OBSERVED elapsed
+— the slowest fingerprints the store has seen warm first, ``--top N``
+bounds how many history-ranked entries run — and a fingerprint →
+observed-stats table prints what the history knew about each.
+
 The suite's conftest honors the same variable, so tests reuse the warmed
 entries. Idempotent: re-running only adds missing entries.
 """
@@ -28,6 +34,21 @@ if "xla_force_host_platform_device_count" not in flags:
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--history-dir", default="",
+        help="query-history store directory: rank the corpus by observed "
+             "elapsed (slowest first) instead of static order",
+    )
+    ap.add_argument(
+        "--top", type=int, default=0,
+        help="with --history-dir: only prewarm the N slowest "
+             "history-known fingerprints (0 = all, history-known first)",
+    )
+    args = ap.parse_args()
+
     import jax
 
     cache_dir = os.path.abspath(
@@ -102,6 +123,56 @@ def main() -> None:
         ("select l_returnflag, sum(l_quantity) from tpch.tiny.lineitem "
          "group by l_returnflag", {}),
     ]
+    # --history-dir: rank the corpus by what a prior serving run OBSERVED.
+    # The store keys on plan fingerprints, not SQL, so each corpus entry's
+    # fingerprint is matched against the store; known-slow shapes warm
+    # first (they are the compiles worth paying for), unknown shapes keep
+    # corpus order after them, and --top N keeps only the N slowest
+    # history-known entries plus the unknowns.
+    if args.history_dir:
+        from trino_tpu.obs.history import QueryHistoryStore
+
+        store = QueryHistoryStore(
+            path=os.path.join(args.history_dir, "query_history.json")
+        )
+        observed = dict(store.entries())
+        ranked, unknown = [], []
+        for sql, props in shapes:
+            try:
+                fp, _ = runner.engine.fingerprint(
+                    sql,
+                    Session(properties={"execution_mode": "distributed",
+                                        **props}),
+                )
+            except Exception:
+                fp = None
+            ent = observed.get(fp) if fp else None
+            (ranked if ent else unknown).append((sql, props, fp, ent))
+        ranked.sort(key=lambda r: -float(r[3].get("elapsed_ms") or 0.0))
+        if args.top > 0:
+            for sql, props, fp, _ent in ranked[args.top:]:
+                print(f"below-top skip {fp[:12] if fp else '?':<12} "
+                      f"{sql.split(chr(10))[0][:52]}")
+            ranked = ranked[: args.top]
+        if ranked:
+            print(f"history {store.path or '(memory)'}: "
+                  f"{len(observed)} fingerprints, "
+                  f"{len(ranked)} matched in corpus\n")
+            print("fingerprint   count  p50 ms  retries  halvings  "
+                  "peak HBM B  query")
+            for sql, _props, fp, ent in ranked:
+                print(f"{fp[:12]}  {ent.get('count', 0):>5}  "
+                      f"{float(ent.get('elapsed_p50_ms') or 0.0):>6.1f}  "
+                      f"{ent.get('overflow_retries', 0):>7}  "
+                      f"{ent.get('compile_halvings', 0):>8}  "
+                      f"{ent.get('peak_hbm_bytes', 0):>10}  "
+                      f"{sql.split(chr(10))[0][:40]}")
+            print()
+        else:
+            print(f"history {store.path or '(memory)'}: no corpus entry "
+                  "matches a stored fingerprint; static order\n")
+        shapes = [(sql, props) for sql, props, _fp, _e in ranked + unknown]
+
     # one representative per canonical plan shape: literal variants share
     # a fingerprint, so executing the first warms the program cache (and
     # the persistent XLA cache) for every other member of the family

@@ -1,0 +1,472 @@
+"""Persistent query history (obs/history.py): durability, capacity
+seeding, and admission gating.
+
+The store is the feedback spine of adaptive execution — per-fingerprint
+observed truth recorded at finalize, seeded back into ``_Caps`` ahead of
+the static planner estimates, and consulted at admission before any
+compile. The suites here assert:
+
+- durability: restart survival, corrupt-file fresh-start (counted),
+  concurrent tmp+rename writers never tear the file, LRU at BOTH the
+  entry bound and the byte bound;
+- seeding: a warm repeat on a FRESH engine sharing the ``history_dir``
+  runs with zero overflow retries / zero compile halvings, at least one
+  ``history``-provenance capacity site, and bit-identical rows vs
+  ``query_history=false``;
+- admission: an over-HBM fingerprint hard-rejects classified
+  EXCEEDED_MEMORY_LIMIT; a fitting hint rides the waiter queue;
+- the QueryManager retained-history knob and gauge.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from trino_tpu.config import Session
+from trino_tpu.obs.history import HistoryHbmRejected, QueryHistoryStore
+
+
+def _obs(**kw):
+    base = {"elapsed_ms": 10.0, "rows": 4}
+    base.update(kw)
+    return base
+
+
+class TestDurability:
+    def test_restart_survival(self, tmp_path):
+        path = str(tmp_path / "query_history.json")
+        s1 = QueryHistoryStore(path)
+        s1.record("fp-a", _obs(
+            overflow_retries=2,
+            capacities={"agg@1#0": {"value": 256,
+                                    "provenance": "seeded+grown"}},
+        ))
+        s1.record("fp-a", _obs(elapsed_ms=20.0))
+        # a brand-new store on the same path IS the restart
+        s2 = QueryHistoryStore(path)
+        ent = s2.get("fp-a")
+        assert ent is not None
+        assert ent["count"] == 2
+        assert ent["max_overflow_retries"] == 2
+        assert ent["capacities"]["agg@1#0"]["value"] == 256
+
+    def test_corrupt_file_starts_fresh_and_counts(self, tmp_path):
+        from trino_tpu.obs.metrics import get_registry
+
+        path = str(tmp_path / "query_history.json")
+        with open(path, "w") as f:
+            f.write('{"version": 1, "entries": {"fp": {truncated')
+        before = (
+            get_registry()
+            .snapshot()["counters"]
+            .get("trino_tpu_history_corrupt_recovered_total", 0)
+        )
+        store = QueryHistoryStore(path)
+        assert store.corrupt_recovered == 1
+        after = (
+            get_registry()
+            .snapshot()["counters"]
+            .get("trino_tpu_history_corrupt_recovered_total", 0)
+        )
+        assert after == before + 1
+        # the store must be fully usable after recovery
+        store.record("fp-new", _obs())
+        assert store.get("fp-new")["count"] == 1
+        with open(path) as f:
+            assert json.load(f)["entries"]["fp-new"]["count"] == 1
+
+    def test_foreign_schema_starts_fresh(self, tmp_path):
+        path = str(tmp_path / "query_history.json")
+        with open(path, "w") as f:
+            json.dump({"version": 999, "entries": {"fp": {}}}, f)
+        store = QueryHistoryStore(path)
+        assert store.get("fp") is None
+        assert store.corrupt_recovered == 1
+
+    def test_sequential_writers_merge(self, tmp_path):
+        """Two stores (processes) on one path: each flush adopts what the
+        other wrote, so interleaved disjoint workloads both survive."""
+        path = str(tmp_path / "query_history.json")
+        s1 = QueryHistoryStore(path)
+        s2 = QueryHistoryStore(path)
+        s1.record("fp-a", _obs())
+        s2.record("fp-b", _obs())  # adopts fp-a before overwriting
+        s1.record("fp-a", _obs())  # adopts fp-b, bumps fp-a to count 2
+        merged = QueryHistoryStore(path)
+        assert merged.get("fp-a")["count"] == 2
+        assert merged.get("fp-b")["count"] == 1
+
+    def test_concurrent_writers_never_tear(self, tmp_path):
+        """Threaded writer torture: every intermediate file state a
+        reader can observe parses as a valid schema document (tmp +
+        os.replace), and no writer raises."""
+        path = str(tmp_path / "query_history.json")
+        stores = [QueryHistoryStore(path) for _ in range(3)]
+        errs: list = []
+        tears: list = []
+        stop = threading.Event()
+
+        def writer(i):
+            try:
+                for r in range(20):
+                    stores[i].record(f"fp-{i}", _obs(elapsed_ms=float(r)))
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                    assert isinstance(doc.get("entries"), dict)
+                except FileNotFoundError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    tears.append(e)
+
+        ts = [threading.Thread(target=writer, args=(i,)) for i in range(3)]
+        rt = threading.Thread(target=reader)
+        rt.start()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        stop.set()
+        rt.join()
+        assert not errs and not tears
+        # each writer's own fingerprint is durably retrievable
+        final = QueryHistoryStore(path)
+        for i in range(3):
+            assert final.get(f"fp-{i}") is not None
+
+    def test_lru_entry_bound(self):
+        store = QueryHistoryStore(max_entries=4)  # in-memory
+        for i in range(7):
+            store.record(f"fp-{i}", _obs())
+        snap = store.snapshot()
+        assert snap["entries"] == 4
+        assert store.evictions == 3
+        assert store.get("fp-0") is None  # oldest gone
+        assert store.get("fp-6") is not None  # newest kept
+
+    def test_lru_byte_bound(self, tmp_path):
+        import os
+
+        path = str(tmp_path / "query_history.json")
+        store = QueryHistoryStore(path, max_entries=1000, max_bytes=4096)
+        caps = {
+            f"agg@{i}#0": {"value": 1 << 16, "provenance": "seeded+grown"}
+            for i in range(30)
+        }  # ~1.3 KB of capacities per entry
+        for i in range(12):
+            store.record(f"fp-{i}", _obs(capacities=caps))
+        assert store.evictions > 0
+        assert store.snapshot()["bytes"] <= 4096
+        assert os.path.getsize(path) <= 4096
+        assert store.get("fp-11") is not None  # most recent survives
+
+    def test_get_touch_protects_from_eviction(self):
+        store = QueryHistoryStore(max_entries=2)
+        store.record("fp-a", _obs())
+        store.record("fp-b", _obs())
+        store.get("fp-a")  # bump recency: fp-b becomes the LRU
+        store.record("fp-c", _obs())
+        assert store.get("fp-a") is not None
+        assert store.get("fp-b") is None
+        # admission peeks (touch=False) must NOT keep entries alive
+        store2 = QueryHistoryStore(max_entries=2)
+        store2.record("fp-a", _obs())
+        store2.record("fp-b", _obs())
+        store2.get("fp-a", touch=False)
+        store2.record("fp-c", _obs())
+        assert store2.get("fp-a") is None
+
+    def test_entries_percentiles_and_order(self):
+        store = QueryHistoryStore()
+        for el in (10.0, 20.0, 30.0, 40.0):
+            store.record("fp-a", _obs(elapsed_ms=el))
+        store.record("fp-b", _obs(elapsed_ms=5.0))
+        rows = store.entries()
+        assert rows[0][0] == "fp-b"  # MRU first
+        fp_a = dict(rows)["fp-a"]
+        assert fp_a["elapsed_p50_ms"] == 30.0
+        assert "elapsed_samples" not in fp_a
+
+    def test_halved_provenance_overwrites_capacity(self):
+        store = QueryHistoryStore()
+        store.record("fp", _obs(capacities={
+            "join@2#0": {"value": 4096, "provenance": "seeded+grown"}}))
+        # growth is monotone: a smaller later value without +halved loses
+        store.record("fp", _obs(capacities={
+            "join@2#0": {"value": 1024, "provenance": "seeded"}}))
+        assert store.get("fp")["capacities"]["join@2#0"]["value"] == 4096
+        # but +halved means the bigger shape FAILED — smaller is truth
+        store.record("fp", _obs(capacities={
+            "join@2#0": {"value": 512, "provenance": "seeded+halved"}}))
+        assert store.get("fp")["capacities"]["join@2#0"]["value"] == 512
+
+
+N_ROWS = 1 << 14
+
+
+def _seed_skewed(catalogs, seed=7):
+    from trino_tpu import types as T
+    from trino_tpu.columnar import Batch, Column
+    from trino_tpu.connectors.api import ColumnSchema, TableSchema
+
+    mem = catalogs.get("memory")
+    rng = np.random.default_rng(seed)
+    raw = rng.zipf(1.2, size=6 * N_ROWS)
+    keys = raw[raw <= 8][:N_ROWS].astype(np.int64)
+    vals = rng.integers(0, 1000, N_ROWS).astype(np.int64)
+    mem.create_table(
+        "default", "facts",
+        TableSchema("facts", (ColumnSchema("k", T.BIGINT),
+                              ColumnSchema("v", T.BIGINT))))
+    mem.insert("default", "facts",
+               Batch([Column(T.BIGINT, keys), Column(T.BIGINT, vals)],
+                     N_ROWS))
+    dk = np.arange(1, 9, dtype=np.int64)
+    mem.create_table(
+        "default", "dims",
+        TableSchema("dims", (ColumnSchema("k", T.BIGINT),
+                             ColumnSchema("name", T.BIGINT))))
+    mem.insert("default", "dims",
+               Batch([Column(T.BIGINT, dk), Column(T.BIGINT, dk * 100)], 8))
+
+
+JOIN_SQL = ("select sum(f.v * d.name) as chk, count(*) as c "
+            "from memory.default.facts f "
+            "join memory.default.dims d on f.k = d.k")
+
+
+def _props(hdir, **extra):
+    return {
+        "execution_mode": "distributed",
+        "join_distribution_type": "PARTITIONED",
+        "skew_handling": False,  # force the cold capacity overflow
+        "history_dir": str(hdir),
+        **extra,
+    }
+
+
+class TestSeeding:
+    def test_fresh_engine_warm_repeat(self, tmp_path):
+        """The acceptance loop: cold run overflows and records; a FRESH
+        engine (empty program cache, no in-process stats) sharing only
+        the history_dir repeats with zero retries, zero halvings, a
+        history-provenance site, and bit-identical rows — also identical
+        to a query_history=false run."""
+        from trino_tpu.testing import LocalQueryRunner
+
+        cold_runner = LocalQueryRunner()
+        _seed_skewed(cold_runner.catalogs)
+        cold = cold_runner.engine.execute_statement(
+            JOIN_SQL, Session(properties=_props(tmp_path)))
+        assert cold.exchange_stats["overflow_retries"] >= 1
+
+        warm_runner = LocalQueryRunner()
+        _seed_skewed(warm_runner.catalogs)
+        warm = warm_runner.engine.execute_statement(
+            JOIN_SQL, Session(properties=_props(tmp_path)))
+        assert warm.rows == cold.rows
+        assert warm.exchange_stats["overflow_retries"] == 0
+        assert warm.exchange_stats["compile_halvings"] == 0
+        assert warm.exchange_stats["history_seeds"] >= 1
+        assert warm.exchange_stats["history_hits"] == 1
+        provs = {
+            str(site.get("provenance", "")).split("+")[0]
+            for site in warm.exchange_stats["capacities"].values()
+        }
+        assert "history" in provs
+
+        # query_history=false: same rows, no history side effects
+        off_runner = LocalQueryRunner()
+        _seed_skewed(off_runner.catalogs)
+        off = off_runner.engine.execute_statement(
+            JOIN_SQL, Session(properties=_props(
+                tmp_path, query_history=False)))
+        assert off.rows == cold.rows
+        assert off.exchange_stats.get("history_hits", 0) == 0
+
+        # the store recorded both history-on runs, with restart-stable
+        # site names (never raw id(node) sitenames)
+        store = QueryHistoryStore(str(tmp_path / "query_history.json"))
+        rows = store.entries()
+        assert rows and rows[0][1]["count"] == 2
+        assert all("@" in s for s in rows[0][1]["capacities"])
+
+        # surfacing: /v1/history body + system.runtime.history rows
+        snap = cold_runner.engine.history_snapshot()
+        assert snap["stores"] and snap["stores"][0]["records"] == 1
+        sys_rows, names = warm_runner.execute(
+            "select * from system.runtime.history")
+        assert "fingerprint" in names
+        assert len(sys_rows) >= 1
+
+    def test_history_store_resolution(self, tmp_path):
+        """history_store(): off -> None; empty dir -> shared in-memory
+        store; explicit dir -> file-backed store, one per dir."""
+        from trino_tpu.testing import LocalQueryRunner
+
+        eng = LocalQueryRunner().engine
+        assert eng.history_store(
+            Session(properties={"query_history": False})) is None
+        mem1 = eng.history_store(Session())
+        mem2 = eng.history_store(Session())
+        assert mem1 is mem2 and mem1.path == ""
+        disk = eng.history_store(
+            Session(properties={"history_dir": str(tmp_path)}))
+        assert disk is not mem1
+        assert disk.path.endswith("query_history.json")
+
+
+class TestAdmission:
+    def test_rejection_classified_exceeded_memory(self):
+        from trino_tpu.errors import classify_error
+
+        code, name, typ = classify_error(
+            HistoryHbmRejected("fp", 10**12, 10**9))
+        assert (code, name, typ) == (
+            131075, "EXCEEDED_MEMORY_LIMIT", "INSUFFICIENT_RESOURCES")
+
+    def test_over_hbm_fingerprint_rejected_at_admission(
+        self, tmp_path, monkeypatch
+    ):
+        """A fingerprint whose OBSERVED peak HBM exceeds the device limit
+        fails at admission — before any planning/compile — classified
+        EXCEEDED_MEMORY_LIMIT and surfaced on the managed query."""
+        from trino_tpu.server.querymanager import QueryManager
+        from trino_tpu.server.resourcegroups import (
+            GroupConfig,
+            ResourceGroupManager,
+            Selector,
+        )
+        from trino_tpu.server.statemachine import QueryState
+        from trino_tpu.testing import LocalQueryRunner
+
+        runner = LocalQueryRunner()
+        session = Session(properties={
+            "execution_mode": "distributed",
+            "history_dir": str(tmp_path),
+        })
+        sql = "select count(*), sum(l_quantity) from tpch.tiny.lineitem"
+        fp, _ = runner.engine.fingerprint(sql, session)
+        assert fp is not None
+        runner.engine.history_store(session).record(
+            fp, _obs(peak_hbm_bytes=10**15))
+        monkeypatch.setattr(
+            "trino_tpu.ingest.device_hbm_limit", lambda: 10**9)
+        rgm = ResourceGroupManager(max_wait_seconds=5)
+        rgm.configure(
+            [GroupConfig("root", max_queued=4, hard_concurrency_limit=2)],
+            [Selector(group="root")])
+        qm = QueryManager(runner.engine, resource_groups=rgm)
+        q = qm.create_query(sql, session)
+        assert q.state.get() == QueryState.FAILED
+        assert q.error is not None
+        assert q.error.error_name == "EXCEEDED_MEMORY_LIMIT"
+        assert q.error.error_type == "INSUFFICIENT_RESOURCES"
+        # the slot was never consumed
+        assert rgm.info()[0]["runningQueries"] == 0
+
+    def test_fitting_hint_admits_and_runs(self, tmp_path, monkeypatch):
+        """An observed footprint BELOW the limit is a hint, not a
+        rejection: the query admits and completes normally."""
+        from trino_tpu.server.querymanager import QueryManager
+        from trino_tpu.server.resourcegroups import (
+            GroupConfig,
+            ResourceGroupManager,
+            Selector,
+        )
+        from trino_tpu.server.statemachine import QueryState
+        from trino_tpu.testing import LocalQueryRunner
+
+        runner = LocalQueryRunner()
+        session = Session(properties={"history_dir": str(tmp_path)})
+        sql = "select count(*) from tpch.tiny.nation"
+        fp, _ = runner.engine.fingerprint(sql, session)
+        assert fp is not None
+        runner.engine.history_store(session).record(
+            fp, _obs(peak_hbm_bytes=1024))
+        monkeypatch.setattr(
+            "trino_tpu.ingest.device_hbm_limit", lambda: 10**9)
+        rgm = ResourceGroupManager(max_wait_seconds=5)
+        rgm.configure(
+            [GroupConfig("root", max_queued=4, hard_concurrency_limit=2)],
+            [Selector(group="root")])
+        qm = QueryManager(runner.engine, resource_groups=rgm)
+        q = qm.create_query(sql, session)
+        deadline = 30.0
+        import time as _t
+        t0 = _t.time()
+        while (q.state.get() not in (QueryState.FINISHED, QueryState.FAILED)
+               and _t.time() - t0 < deadline):
+            _t.sleep(0.02)
+        assert q.state.get() == QueryState.FINISHED, (
+            q.error and q.error.message)
+
+    def test_waiter_queue_skips_unfitting_hint(self, monkeypatch):
+        """In the waiter queue a too-big hint is skipped over (not head-
+        of-line blocking): a later hint-free waiter takes the freed slot
+        first; the big one admits once headroom appears."""
+        from trino_tpu.server import resourcegroups as RG
+
+        mgr = RG.ResourceGroupManager(max_wait_seconds=10)
+        mgr.configure(
+            [RG.GroupConfig("root", max_queued=8,
+                            hard_concurrency_limit=1)],
+            [RG.Selector(group="root")])
+        headroom = {"free": 100}
+        monkeypatch.setattr(
+            RG.ResourceGroupManager, "_hbm_fits",
+            staticmethod(lambda hint: int(hint) <= headroom["free"]))
+        order: list = []
+        got: dict = {}
+        g0, admitted = mgr.submit(
+            "u", None, lambda g, e: None, peak_hbm_hint=0)
+        assert admitted
+        done_big = threading.Event()
+        done_small = threading.Event()
+        _, a_big = mgr.submit(
+            "u", None,
+            lambda g, e: (order.append("big"), done_big.set()),
+            peak_hbm_hint=500)  # does not fit current headroom
+        _, a_small = mgr.submit(
+            "u", None,
+            lambda g, e: (got.__setitem__("small", g),
+                          order.append("small"), done_small.set()),
+            peak_hbm_hint=50)
+        assert not a_big and not a_small
+        mgr.finish(g0)  # wakes the SMALL waiter, skipping the big one
+        assert done_small.wait(5.0)
+        assert order == ["small"]
+        assert not done_big.is_set()
+        headroom["free"] = 1000  # memory freed: big fits now
+        mgr.finish(got["small"])  # the next wake admits the big waiter
+        assert done_big.wait(5.0)
+        assert order == ["small", "big"]
+
+
+class TestManagerKnobs:
+    def test_max_history_session_settable_and_gauge(self):
+        from trino_tpu.obs.metrics import get_registry
+        from trino_tpu.server.querymanager import QueryManager
+        from trino_tpu.testing import LocalQueryRunner
+
+        qm = QueryManager(LocalQueryRunner().engine)
+        assert qm.max_history == 100  # config.Session default
+        q = qm.create_query(
+            "select 1",
+            Session(properties={"query_manager_max_history": 7}))
+        assert qm.max_history == 7
+        import time as _t
+        t0 = _t.time()
+        while q.state.get().name not in ("FINISHED", "FAILED") \
+                and _t.time() - t0 < 20:
+            _t.sleep(0.02)
+        g = get_registry().snapshot()["gauges"].get(
+            "trino_tpu_query_history_retained")
+        assert g is not None and g >= 1

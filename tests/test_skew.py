@@ -170,7 +170,9 @@ class TestSkewedJoin:
         caps = on.exchange_stats["capacities"]
         assert caps, "no capacity sites recorded"
         for site in caps.values():
-            assert site["provenance"].split("+")[0] in ("default", "seeded")
+            assert site["provenance"].split("+")[0] in (
+                "default", "seeded", "history",
+            )
             assert site["value"] > 0
 
     def test_interpreter_path_matches(self, runner):

@@ -218,6 +218,21 @@ class Session:
         ("batch_window_ms", 0),
         # flush a collecting batch early once this many members joined
         ("batch_max_size", 16),
+        # --- query history (obs/history.py) --------------------------------
+        # record per-fingerprint observed execution truth (final
+        # capacities, overflow retries, peak HBM, elapsed, ...) and seed
+        # warm repeats from it; bit-identical on/off
+        ("query_history", True),
+        # where the history JSON lives; "" keeps the store in-memory only
+        # (per-process) — set a directory to survive restarts and share
+        # across engines
+        ("history_dir", ""),
+        ("history_max_entries", 256),
+        ("history_max_bytes", 1 << 20),
+        # retained terminal queries in the coordinator QueryManager
+        # (satellite of the same observability story: coordinator memory
+        # under sustained traffic)
+        ("query_manager_max_history", 100),
     )
 
     def get(self, name: str) -> Any:

@@ -55,6 +55,21 @@ _SCHEMAS: dict[str, list[tuple[str, T.SqlType]]] = {
         ("peak_hbm_bytes", T.BIGINT),
         ("bytes_accessed", T.DOUBLE),
     ],
+    ("runtime", "history"): [
+        ("fingerprint", T.VARCHAR),
+        ("count", T.BIGINT),
+        ("elapsed_ewma_ms", T.DOUBLE),
+        ("elapsed_p50_ms", T.DOUBLE),
+        ("elapsed_p90_ms", T.DOUBLE),
+        ("rows", T.BIGINT),
+        ("overflow_retries", T.BIGINT),
+        ("compile_halvings", T.BIGINT),
+        ("padding_ratio", T.DOUBLE),
+        ("peak_hbm_bytes", T.BIGINT),
+        ("flops", T.DOUBLE),
+        ("capacity_sites", T.BIGINT),
+        ("path", T.VARCHAR),
+    ],
     ("metadata", "catalogs"): [
         ("catalog_name", T.VARCHAR),
         ("connector_name", T.VARCHAR),
@@ -128,6 +143,20 @@ class SystemConnector(Connector):
                     p.get("peak_hbm_bytes"), p.get("bytes_accessed"),
                 )
                 for p in eng.runtime_programs()
+            ]
+        if (schema, table) == ("runtime", "history"):
+            return [
+                (
+                    h["fingerprint"], h.get("count", 0),
+                    h.get("elapsed_ms"), h.get("elapsed_p50_ms"),
+                    h.get("elapsed_p90_ms"), h.get("rows"),
+                    h.get("overflow_retries", 0),
+                    h.get("compile_halvings", 0),
+                    h.get("padding_ratio"), h.get("peak_hbm_bytes"),
+                    h.get("flops"), len(h.get("capacities") or {}),
+                    h.get("path", ""),
+                )
+                for h in eng.runtime_history()
             ]
         if (schema, table) == ("metadata", "catalogs"):
             return [

@@ -146,6 +146,12 @@ class Engine:
         from trino_tpu.exec.batching import BatchCollector
 
         self.batch_collector = BatchCollector(self)
+        # query-history stores (obs/history.py): per-fingerprint observed
+        # execution truth, keyed by the session's history_dir ("" = the
+        # in-memory per-process store). Engine-owned so every query of a
+        # dir shares one store object (and its lock)
+        self._history_stores: dict[str, Any] = {}
+        self._history_lock = threading.Lock()
 
     _QUERY_CACHE_MAX = 64
     # statements whose results depend on evaluation time/randomness must
@@ -295,6 +301,105 @@ class Engine:
                     }
                 )
         return rows
+
+    # --- query history (obs/history.py) -----------------------------------
+
+    def history_store(self, session: Session):
+        """The :class:`QueryHistoryStore` this session resolves to, or
+        None when ``query_history`` is off. One store per ``history_dir``
+        ("" keeps it in-memory, the tier-1-safe default)."""
+        try:
+            if not bool(session.get("query_history")):
+                return None
+            hdir = str(session.get("history_dir") or "")
+            max_entries = int(session.get("history_max_entries"))
+            max_bytes = int(session.get("history_max_bytes"))
+        except KeyError:
+            return None
+        import os
+
+        from trino_tpu.obs.history import QueryHistoryStore
+
+        path = os.path.join(hdir, "query_history.json") if hdir else ""
+        with self._history_lock:
+            store = self._history_stores.get(hdir)
+            if store is None:
+                store = QueryHistoryStore(
+                    path=path, max_entries=max_entries, max_bytes=max_bytes
+                )
+                self._history_stores[hdir] = store
+            return store
+
+    def history_snapshot(self) -> dict:
+        """Every history store this engine has resolved, merged — the
+        ``GET /v1/history`` body."""
+        with self._history_lock:
+            stores = sorted(self._history_stores.items())
+        return {"stores": [s.snapshot() for _, s in stores]}
+
+    def runtime_history(self) -> list[dict]:
+        """Flat per-fingerprint rows for ``system.runtime.history``."""
+        with self._history_lock:
+            stores = [s for _, s in sorted(self._history_stores.items())]
+        rows: list[dict] = []
+        for store in stores:
+            for fp, ent in store.entries():
+                rec = dict(ent)
+                rec["fingerprint"] = fp
+                rec["path"] = store.path
+                rows.append(rec)
+        return rows
+
+    @staticmethod
+    def _history_record(hist, fp, res, elapsed_ms: float) -> None:
+        """Fold one finished query's observed stats into the history
+        store. Best-effort by contract: history must never fail (or slow
+        down observably) the query that feeds it."""
+        if hist is None or fp is None or res is None:
+            return
+        try:
+            ex = (
+                res.exchange_stats
+                if isinstance(res.exchange_stats, dict)
+                else {}
+            )
+            ds = (
+                res.device_stats if isinstance(res.device_stats, dict) else {}
+            )
+            bs = res.batch_stats if isinstance(res.batch_stats, dict) else {}
+            caps: dict[str, dict] = {}
+            for val in (ex.get("capacities") or {}).values():
+                if not isinstance(val, dict):
+                    continue
+                site = val.get("site")
+                # only restart-stable names persist — raw tracer names
+                # embed id(node) and mean nothing to the next process
+                if not isinstance(site, str) or "@" not in site:
+                    continue
+                caps[site] = {
+                    "value": val.get("value"),
+                    "provenance": val.get("provenance", ""),
+                }
+            observed: dict[str, Any] = {
+                "elapsed_ms": round(float(elapsed_ms), 3),
+                "rows": len(res.rows),
+                "overflow_retries": int(ex.get("overflow_retries", 0) or 0),
+                "compile_halvings": int(ex.get("compile_halvings", 0) or 0),
+                "padding_ratio": float(ex.get("padding_ratio", 0.0) or 0.0),
+                "shuffle_rows": int(ex.get("shuffle_rows", 0) or 0),
+                "capacities": caps,
+            }
+            flops = ds.get("total_flops")
+            if isinstance(flops, (int, float)):
+                observed["flops"] = float(flops)
+            peak = ds.get("peak_hbm_bytes")
+            if isinstance(peak, (int, float)) and peak > 0:
+                observed["peak_hbm_bytes"] = int(peak)
+            if bs.get("batchSize"):
+                observed["batch_size"] = int(bs["batchSize"])
+            hist.record(fp, observed)
+        except Exception:  # noqa: BLE001
+            pass
 
     # === entry ============================================================
 
@@ -466,12 +571,15 @@ class Engine:
             # text — keys the program cache, so `x < 24` and `x < 25`
             # land on the same entry with different parameter vectors
             plan = self.plan(stmt, session)
-            exec_plan, params, entry = plan, [], None
+            exec_plan, params, entry, fp = plan, [], None, None
             mode = session.get("execution_mode")
             try:
                 wants_batch = int(session.get("batch_window_ms")) > 0
             except KeyError:
                 wants_batch = False
+            mesh_n = (
+                int(self.mesh.devices.size) if self.mesh is not None else 1
+            )
             if (
                 sql_text is not None
                 # cluster queries canonicalize only to join the batch
@@ -488,9 +596,6 @@ class Engine:
             ):
                 from trino_tpu.planner.canonicalize import canonicalize_plan
 
-                mesh_n = (
-                    int(self.mesh.devices.size) if self.mesh is not None else 1
-                )
                 canonical, params, fp = canonicalize_plan(
                     plan, session, mesh_n
                 )
@@ -499,24 +604,54 @@ class Engine:
                     entry = self._query_cache_entry(fp)
                 else:
                     params = []  # unserializable shape: run baked, uncached
+            elif (
+                sql_text is not None
+                and mode == "cluster"
+                and self._sql_cacheable(sql_text)
+            ):
+                # record-only fingerprint: cluster queries execute the
+                # baked plan, but the history store still keys their
+                # observed truth (and the admission gate their peak HBM)
+                # by the same canonical fingerprint
+                try:
+                    from trino_tpu.planner.canonicalize import (
+                        canonicalize_plan,
+                    )
+
+                    _, _, fp = canonicalize_plan(plan, session, mesh_n)
+                except Exception:  # noqa: BLE001
+                    fp = None
+            hist = self.history_store(session) if fp is not None else None
+            hist_entry = hist.get(fp) if hist is not None else None
             # cross-query batching: when the session opts in, compatible
             # queries (same fingerprint + same session signature) wait in
             # the collector for a short window and share ONE stacked
             # device dispatch through the cached programs. Transactions
             # are excluded (snapshot semantics are per-statement), and
             # window=0 — the default — keeps the path below verbatim.
+            import time as _time
+
             if (
                 entry is not None
                 and wants_batch
                 and "__txn" not in session.properties
             ):
-                return self.batch_collector.submit(
+                t0 = _time.monotonic()
+                res = self.batch_collector.submit(
                     entry,
                     exec_plan,
                     session,
                     params,
                     query_id or self._next_query_id(),
                 )
+                self._history_record(
+                    hist, fp, res, (_time.monotonic() - t0) * 1000.0
+                )
+                if isinstance(res.exchange_stats, dict):
+                    res.exchange_stats["history_hits"] = (
+                        1 if hist_entry is not None else 0
+                    )
+                return res
             # shared program stores and capacity objects are not safe for
             # concurrent executors: a second in-flight run of the same
             # fingerprint executes uncached instead of waiting
@@ -534,10 +669,21 @@ class Engine:
                     # the parameter vector
                     exec_plan = entry["plan"]
                     programs = entry["programs"]
-                return self._execute_query_plan(
+                t0 = _time.monotonic()
+                res = self._execute_query_plan(
                     exec_plan, session, query_id=query_id,
-                    programs=programs, params=params,
+                    programs=programs, params=params, history=hist_entry,
                 )
+                self._history_record(
+                    hist, fp, res, (_time.monotonic() - t0) * 1000.0
+                )
+                if isinstance(res.exchange_stats, dict):
+                    # did a prior run of this fingerprint inform this one?
+                    # (surfaced as queryStats.historyHits on /v1/query)
+                    res.exchange_stats["history_hits"] = (
+                        1 if hist_entry is not None else 0
+                    )
+                return res
             finally:
                 if entry is not None:
                     entry["lock"].release()
@@ -579,6 +725,7 @@ class Engine:
         query_id: Optional[str] = None,
         programs: Optional[dict] = None,
         params: Optional[list] = None,
+        history: Optional[dict] = None,
     ) -> StatementResult:
         from trino_tpu.memory import QueryMemoryContext
 
@@ -625,7 +772,8 @@ class Engine:
         )
         try:
             executor = self._executor(
-                session, ctx, programs=programs, params=params
+                session, ctx, programs=programs, params=params,
+                history=history,
             )
             executor.stats_collector = collector
             batch, names = executor.execute(plan)
@@ -728,6 +876,7 @@ class Engine:
         ctx,
         programs: Optional[dict] = None,
         params: Optional[list] = None,
+        history: Optional[dict] = None,
     ) -> LocalExecutor:
         mode = session.get("execution_mode")
         if mode == "distributed":
@@ -736,7 +885,7 @@ class Engine:
 
                 ex = FragmentedExecutor(
                     self.catalogs, session, self.mesh, memory_ctx=ctx,
-                    programs=programs, params=params,
+                    programs=programs, params=params, history=history,
                 )
             else:
                 from trino_tpu.parallel.distributed import (
@@ -852,6 +1001,11 @@ class Engine:
                     from trino_tpu.stats import render_device_stats
 
                     text += "\n\n" + render_device_stats(res.device_stats)
+                ex_caps = (res.exchange_stats or {}).get("capacities")
+                if isinstance(ex_caps, dict) and ex_caps:
+                    from trino_tpu.stats import render_capacity_stats
+
+                    text += "\n\n" + render_capacity_stats(ex_caps)
                 wall_ms = collector.total_wall() * 1000
             text += (
                 f"\n\npeak memory: {res.peak_memory_bytes} bytes"

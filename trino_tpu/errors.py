@@ -25,9 +25,15 @@ def classify_error(e: BaseException) -> Tuple[int, str, str]:
     from trino_tpu.analyzer import SemanticError
     from trino_tpu.ft.retry import TaskFailure
     from trino_tpu.memory import ExceededMemoryLimitError
+    from trino_tpu.obs.history import HistoryHbmRejected
     from trino_tpu.planner.sanity import PlanValidationError
     from trino_tpu.sql.lexer import SqlSyntaxError
 
+    if isinstance(e, HistoryHbmRejected):
+        # the admission gate rejected the query because its fingerprint's
+        # OBSERVED peak HBM cannot fit the device — same class the
+        # compile-time failure it preempts would have carried
+        return (131075, "EXCEEDED_MEMORY_LIMIT", "INSUFFICIENT_RESOURCES")
     if isinstance(e, SqlSyntaxError):
         return (1, "SYNTAX_ERROR", "USER_ERROR")
     if isinstance(e, SemanticError):

@@ -260,38 +260,54 @@ class _Caps:
     """Capacity knobs, grown on overflow (shape-bucketed).
 
     ``provenance`` records where each value came from (``default`` /
-    ``seeded`` from planner stats / ``+grown`` suffix after an overflow
-    retry) — surfaced in the per-query exchange counters so capacity
-    decisions are auditable."""
+    ``seeded`` from planner stats / ``history`` from the observed-stats
+    store / ``+grown`` suffix after an overflow retry / ``+halved`` after
+    a RESOURCE_EXHAUSTED shrink) — surfaced in the per-query exchange
+    counters so capacity decisions are auditable.
+
+    ``sites`` maps the tracer's runtime capacity names (which embed
+    ``id(node)`` and change across processes and dynamic-filter rewrites)
+    to restart-stable names like ``agg@3#0`` (kind @ fragment id # plan
+    ordinal) — the keying the history store persists under."""
 
     def __init__(self):
         self.vals: dict[str, int] = {}
         self.provenance: dict[str, str] = {}
-        self._seed_floor: dict[str, int] = {}
+        self._seed_floor: dict[str, tuple[int, str]] = {}
+        self.sites: dict[str, str] = {}
 
     def get(self, name: str, default: int) -> int:
         if name not in self.vals:
             floor = self._seed_floor.pop(name, None)
-            if floor is not None and floor > default:
-                self.vals[name] = floor
-                self.provenance[name] = "seeded"
+            if floor is not None and floor[0] > default:
+                self.vals[name] = floor[0]
+                self.provenance[name] = floor[1]
             else:
                 self.vals[name] = default
                 self.provenance.setdefault(name, "default")
         return self.vals[name]
 
-    def seed(self, name: str, value: int, floor_only: bool = False) -> None:
-        """Install a stats-derived starting value. ``floor_only`` seeds
-        take effect only when above the site's built-in default (used for
-        join caps, where shrinking below the data-derived default trades a
-        recompile-retry for padding)."""
+    def seed(
+        self,
+        name: str,
+        value: int,
+        floor_only: bool = False,
+        provenance: str = "seeded",
+    ) -> None:
+        """Install a stats- or history-derived starting value.
+        ``floor_only`` seeds take effect only when above the site's
+        built-in default (used for join caps, where shrinking below the
+        data-derived default trades a recompile-retry for padding).
+        Floors are first-wins: history seeding runs before stats seeding
+        and observed truth must not be clobbered by a static estimate."""
         if name in self.vals:
             return
         if floor_only:
-            self._seed_floor[name] = value
+            if name not in self._seed_floor:
+                self._seed_floor[name] = (value, provenance)
         else:
             self.vals[name] = value
-            self.provenance[name] = "seeded"
+            self.provenance[name] = provenance
 
     def grow(self, name: str, factor: int = 2) -> None:
         # quantize growth to power-of-two buckets: stats-seeded odd-sized
@@ -474,10 +490,15 @@ class FragmentedExecutor(DistributedExecutor):
         *args,
         programs: Optional[dict] = None,
         params: Optional[Sequence] = None,
+        history: Optional[dict] = None,
         **kwargs,
     ):
         super().__init__(*args, **kwargs)
         self.programs: dict = {} if programs is None else programs
+        # this fingerprint's aggregate entry from the query-history store
+        # (obs/history.py), or None when history is off / the query is
+        # cold: observed final capacities floor the static stats seeds
+        self.history = history
         # ordered (value, type) literals hoisted out of a canonicalized
         # plan (planner/canonicalize.py): interpreter paths read the host
         # values via self._params; traced programs receive device scalars
@@ -618,6 +639,73 @@ class FragmentedExecutor(DistributedExecutor):
             self.programs["__skewroles__"] = roles
         return roles
 
+    def _history_sites(self, frag: PlanFragment) -> dict[str, str]:
+        """Runtime capacity-site names → restart-stable names. The tracer
+        mints sites as ``agg{id(node)}`` / ``join{id(node)}`` /
+        ``semi{id(node)}`` — node ids churn across processes AND across
+        dynamic-filter rewrites — so history keys them by kind, fragment
+        id, and walk ordinal instead (``agg@3#0``), which is stable for a
+        given fingerprint. ``semi`` sites are minted on Join nodes (the
+        semi/mark-join exec path), so each Join registers both."""
+        sites = {
+            f"exch{frag.id}": f"exch@{frag.id}",
+            f"spill{frag.id}": f"spill@{frag.id}",
+            f"hot{frag.id}": f"hot@{frag.id}",
+        }
+        agg_k = join_k = 0
+        for node in P.walk_plan(frag.root):
+            if isinstance(node, P.Aggregate):
+                sites[f"agg{id(node)}"] = f"agg@{frag.id}#{agg_k}"
+                agg_k += 1
+            elif isinstance(node, P.Join):
+                sites[f"join{id(node)}"] = f"join@{frag.id}#{join_k}"
+                sites[f"semi{id(node)}"] = f"semi@{frag.id}#{join_k}"
+                join_k += 1
+        return sites
+
+    def _seed_history(self, frag: PlanFragment, caps: "_Caps") -> None:
+        """History-seeded capacities: final observed shapes from earlier
+        runs of this fingerprint floor the static estimates. Runs BEFORE
+        ``_seed_caps`` — floors are first-wins, so observed truth beats a
+        static guess. Grown sites seed floor-only (same contract as stats
+        seeding: never shrink an engineered default); halved sites seed
+        exactly — the larger shape failed to compile or allocate, and
+        re-deriving that by retries is what history exists to avoid.
+        Always registers the runtime→stable site map so the snapshot can
+        persist capacities under restart-stable keys."""
+        try:
+            sites = self._history_sites(frag)
+            caps.sites.update(sites)
+            hcaps = (self.history or {}).get("capacities") or {}
+            if not hcaps:
+                return
+            seeded = 0
+            for runtime, stable in sites.items():
+                ent = hcaps.get(stable)
+                if not isinstance(ent, dict):
+                    continue
+                if runtime in caps.vals or runtime in caps._seed_floor:
+                    continue
+                val = bucket_capacity(int(ent.get("value", 0)), minimum=1)
+                if val <= 0:
+                    continue
+                prov = str(ent.get("provenance", ""))
+                caps.seed(
+                    runtime,
+                    val,
+                    floor_only="+halved" not in prov,
+                    provenance="history",
+                )
+                seeded += 1
+            if seeded:
+                from trino_tpu.obs.metrics import get_registry
+
+                get_registry().counter(
+                    "trino_tpu_history_seeds_total"
+                ).inc(seeded)
+        except Exception:  # noqa: BLE001 — seeding is best-effort
+            pass
+
     def _seed_caps(self, frag: PlanFragment, caps: "_Caps") -> None:
         """Stats-seeded capacity defaults: planner NDV/row-count estimates
         pick realistic starting buckets per agg/join/exchange site, so
@@ -717,6 +805,7 @@ class FragmentedExecutor(DistributedExecutor):
             4,
         )
         caps: dict[str, dict] = {}
+        history_seeds = 0
         for key, val in self.programs.items():
             if (
                 isinstance(key, tuple)
@@ -726,11 +815,20 @@ class FragmentedExecutor(DistributedExecutor):
             ):
                 scope = ".".join(str(k) for k in key[1:])
                 for nm, v in val.vals.items():
+                    prov = val.provenance.get(nm, "default")
                     caps[f"{scope}:{nm}"] = {
                         "value": v,
-                        "provenance": val.provenance.get(nm, "default"),
+                        "provenance": prov,
+                        # restart-stable name — what the history store
+                        # keys this site by across processes
+                        "site": val.sites.get(nm, nm),
                     }
+                    if prov.startswith("history"):
+                        history_seeds += 1
         st["capacities"] = caps
+        # capacity sites whose value came from the observed-history store
+        # (surfaced as queryStats.historySeeds on /v1/query)
+        st["history_seeds"] = history_seeds
         return st
 
     def ingest_stats_snapshot(self):
@@ -1248,6 +1346,7 @@ class FragmentedExecutor(DistributedExecutor):
                     build_inputs[f"remote{n.fragment_id}"] = upstream.batch
                     build_layouts[f"remote{n.fragment_id}"] = upstream.layout
         caps = self.programs.setdefault(("caps", "stream", frag.id), _Caps())
+        self._seed_history(frag, caps)
         attempts = 0
         while True:
             attempts += 1
@@ -1562,6 +1661,7 @@ class FragmentedExecutor(DistributedExecutor):
         never bake a stale hot set in as constants.
         """
         caps = self.programs.setdefault(("caps", frag.id), _Caps())
+        self._seed_history(frag, caps)
         self._seed_caps(frag, caps)
         pvec = self._param_arrays()
         if pvec is not None:
@@ -1634,6 +1734,7 @@ class FragmentedExecutor(DistributedExecutor):
         member_ids = set(fids)
         caps = self.programs.setdefault(("caps", "fused", fids), _Caps())
         for f in frags:
+            self._seed_history(f, caps)
             self._seed_caps(f, caps)
         pvec = self._param_arrays()
         if pvec is not None:
@@ -2031,6 +2132,7 @@ class FragmentedExecutor(DistributedExecutor):
         Capacities are SHARED with the single-query path, so a batch
         benefits from (and feeds) the same overflow ladder."""
         caps = self.programs.setdefault(("caps", frag.id), _Caps())
+        self._seed_history(frag, caps)
         self._seed_caps(frag, caps)
         inputs = dict(inputs)
         inputs["__params__"] = pstack
@@ -2100,6 +2202,7 @@ class FragmentedExecutor(DistributedExecutor):
         fids = tuple(f.id for f in frags)
         caps = self.programs.setdefault(("caps", "fused", fids), _Caps())
         for f in frags:
+            self._seed_history(f, caps)
             self._seed_caps(f, caps)
         inputs = dict(inputs)
         inputs["__params__"] = pstack

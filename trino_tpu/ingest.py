@@ -407,6 +407,16 @@ def hbm_headroom_ok(
     return True
 
 
+def device_hbm_limit() -> int:
+    """The device's reported HBM byte limit, or 0 when the backend has no
+    memory accounting (CPU meshes) — callers treat 0 as "gate inert"."""
+    try:
+        ms = jax.local_devices()[0].memory_stats() or {}
+        return int(ms.get("bytes_limit") or 0)
+    except Exception:  # noqa: BLE001
+        return 0
+
+
 def splits_fingerprint(splits: Sequence) -> str:
     """Stable identity of a split list. File-backed connectors encode
     (path, chunk) pairs in split info, so INSERT-appended part files

@@ -79,6 +79,12 @@ _CODEGEN_PROPS = (
     # grouping itself is cached per entry (__fusedunits__), so fused and
     # unfused runs of the same plan must not share a fingerprint
     "pipeline_fusion",
+    # history seeding changes starting capacities, and capacities live on
+    # the shared cache entry (_Caps per program key) — same reason
+    # stats_capacity_seeding is listed. history_dir/history_max_entries
+    # stay OUT: they pick where/how much truth is kept, not what a
+    # fragment traces into.
+    "query_history",
     "skew_handling",
     "skew_hot_k",
     "skew_hot_threshold_frac",

@@ -1757,10 +1757,17 @@ class ClusterScheduler:
         # per task POST attempt — since worker-side values also count
         # retried attempts whose work was discarded
         exchange_totals: dict = {}
+        total_caps: dict = {}
         for entry in stages:
             for k, v in (entry.get("exchange") or {}).items():
-                if k != "padding_ratio":
+                if k == "capacities" and isinstance(v, dict):
+                    total_caps.update(v)  # site names are per-stage unique
+                elif k != "padding_ratio" and isinstance(
+                    v, (int, float)
+                ) and not isinstance(v, bool):
                     exchange_totals[k] = exchange_totals.get(k, 0) + v
+        if total_caps:
+            exchange_totals["capacities"] = total_caps
         round_trips = sum(e.get("attempts", 0) for e in stages)
         if exchange_totals or round_trips:
             exchange_totals["dispatchRoundTrips"] = round_trips
@@ -1805,6 +1812,7 @@ class ClusterScheduler:
         have_flops = have_peak = False
         peak = 0
         exchange: dict = {}
+        exchange_caps: dict = {}
         ingest: dict = {}
         for t in tasks:
             st = t.last_status or {}
@@ -1824,11 +1832,25 @@ class ClusterScheduler:
                 in_bytes += int(ts["input_bytes"])
             compile_ms += float((ts.get("compile") or {}).get("compile_ms", 0.0))
             for k, v in (ts.get("exchange") or {}).items():
-                # ratios/capacity maps don't sum — recomputed/dropped below
+                # ratios/capacity maps don't sum — recomputed/unioned below
                 if k != "padding_ratio" and isinstance(
                     v, (int, float)
                 ) and not isinstance(v, bool):
                     exchange[k] = exchange.get(k, 0) + v
+            # capacity sites union across sibling tasks (same program,
+            # same sites): keep the largest observed value per site so
+            # the stage view and the web-UI provenance column reflect
+            # the worst-case (final) shape
+            for name, ent in ((ts.get("exchange") or {}).get(
+                "capacities"
+            ) or {}).items():
+                if not isinstance(ent, dict):
+                    continue
+                old = exchange_caps.get(name)
+                if old is None or int(ent.get("value", 0) or 0) >= int(
+                    old.get("value", 0) or 0
+                ):
+                    exchange_caps[name] = ent
             for k, v in (ts.get("ingest") or {}).items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     ingest[k] = ingest.get(k, 0) + v
@@ -1849,6 +1871,8 @@ class ClusterScheduler:
             entry["inputBytes"] = in_bytes
         if compile_ms:
             entry["compileMs"] = round(compile_ms, 3)
+        if exchange_caps:
+            exchange["capacities"] = exchange_caps
         if exchange:
             if exchange.get("shuffle_rows"):
                 exchange["padding_ratio"] = round(

@@ -627,6 +627,14 @@ def _make_handler(server: TrinoTpuServer):
                 self.end_headers()
                 self.wfile.write(body)
                 return None
+            if path == "/v1/history":
+                # per-fingerprint observed execution truth (obs/history.py):
+                # one entry per store the engine resolved, most-recently-
+                # used fingerprints first
+                snap_fn = getattr(server.engine, "history_snapshot", None)
+                return self._send_json(
+                    snap_fn() if callable(snap_fn) else {"stores": []}
+                )
             if path == "/v1/query":
                 return self._send_json(
                     [q.info() for q in server.query_manager.queries()]

@@ -312,6 +312,8 @@ class ManagedQuery:
     def _query_stats(self, elapsed_s: float, cluster_stats: dict) -> dict:
         bs = (getattr(self.result, "batch_stats", None)
               if self.result else None) or {}
+        ex = (getattr(self.result, "exchange_stats", None)
+              if self.result else None) or {}
         return {
             "elapsedMs": int(elapsed_s * 1000),
             "queuedMs": int(
@@ -322,6 +324,11 @@ class ManagedQuery:
             "batchedQueries": bs.get("batchedQueries", 0),
             "batchSize": bs.get("batchSize", 1),
             "batchWaitMs": bs.get("batchWaitMs", 0.0),
+            # query history (obs/history.py): capacity sites seeded from
+            # observed truth, and whether a prior run of this fingerprint
+            # informed this one
+            "historySeeds": ex.get("history_seeds", 0),
+            "historyHits": ex.get("history_hits", 0),
             "speculativeAttempts": cluster_stats.get("speculative_attempts", 0),
             "speculativeWins": cluster_stats.get("speculative_wins", 0),
             "recoveredTasks": cluster_stats.get("recovered_tasks", 0),
@@ -434,6 +441,13 @@ class QueryManager:
 
     def create_query(self, sql: str, session: Session) -> ManagedQuery:
         q = ManagedQuery(sql, session, engine=self.engine)
+        try:
+            # session-settable retained-history bound (coordinator memory
+            # under sustained traffic); the hardcoded-100 default lives
+            # in config.Session.DEFAULTS now
+            self.max_history = int(session.get("query_manager_max_history"))
+        except (KeyError, TypeError, ValueError):
+            pass
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("query manager is shut down")
@@ -471,14 +485,64 @@ class QueryManager:
                 self.resource_groups.finish(group)
 
         try:
+            # history HBM gate: a fingerprint whose OBSERVED peak HBM
+            # cannot fit the device at all hard-rejects here (classified
+            # EXCEEDED_MEMORY_LIMIT) instead of failing at compile; one
+            # that fits the device but not the CURRENT headroom rides the
+            # hint into the waiter queue and waits for memory to free
+            peak = self._history_hbm_gate(q)
             group, admitted = self.resource_groups.submit(
-                q.session.user, q.session.source, ready
+                q.session.user, q.session.source, ready,
+                peak_hbm_hint=peak,
             )
-        except Exception as e:  # noqa: BLE001 — queue full / no selector
+        except TypeError:
+            # resource-group doubles without the hint kwarg
+            try:
+                group, admitted = self.resource_groups.submit(
+                    q.session.user, q.session.source, ready
+                )
+            except Exception as e:  # noqa: BLE001
+                self._reject(q, e)
+                return
+        except Exception as e:  # noqa: BLE001 — queue full / no selector /
+            # over-HBM fingerprint
             self._reject(q, e)
             return
         if admitted:
             self._pool.submit(self._run_admitted, q, group)
+
+    def _history_hbm_gate(self, q: ManagedQuery) -> int:
+        """Observed peak-HBM for this query's fingerprint, as an admission
+        hint (bytes; 0 = unknown). Raises HistoryHbmRejected when the
+        observed footprint exceeds the device limit outright — waiting
+        cannot help a program that never fits. Best-effort: any gate
+        failure admits (history must never wedge admission)."""
+        try:
+            hist = self.engine.history_store(q.session)
+            if hist is None:
+                return 0
+            fp, _ = self.engine.fingerprint(q.sql, q.session)
+            if fp is None:
+                return 0
+            ent = hist.get(fp, touch=False)
+            if ent is None:
+                return 0
+            peak = int(ent.get("peak_hbm_bytes", 0) or 0)
+            if peak <= 0:
+                return 0
+            from trino_tpu.ingest import device_hbm_limit
+            from trino_tpu.obs.history import HistoryHbmRejected
+
+            limit = device_hbm_limit()
+            if limit and peak > 0.9 * limit:
+                raise HistoryHbmRejected(fp, peak, limit)
+            return peak
+        except Exception as e:  # noqa: BLE001
+            from trino_tpu.obs.history import HistoryHbmRejected
+
+            if isinstance(e, HistoryHbmRejected):
+                raise
+            return 0
 
     def _run_admitted(self, q: ManagedQuery, group) -> None:
         try:
@@ -488,7 +552,15 @@ class QueryManager:
             self.resource_groups.finish(group)
 
     def _reject(self, q: ManagedQuery, e: Exception) -> None:
-        q.error = ErrorInfo(str(e), 3, "QUERY_REJECTED", "USER_ERROR")
+        from trino_tpu.errors import classify_error
+
+        code, name, typ = classify_error(e)
+        if name == "GENERIC_INTERNAL_ERROR":
+            # legacy admission failures (queue full, no selector) keep
+            # their QUERY_REJECTED surface; classified errors — the
+            # history HBM gate's EXCEEDED_MEMORY_LIMIT — pass through
+            code, name, typ = 3, "QUERY_REJECTED", "USER_ERROR"
+        q.error = ErrorInfo(str(e), code, name, typ)
         q.state.set(QueryState.FAILED)
         q.end_time = time.time()
         q._end_mono = time.monotonic()
@@ -543,6 +615,14 @@ class QueryManager:
         return q.kill(message)
 
     def _gc_locked(self) -> None:
+        try:
+            from trino_tpu.obs.metrics import get_registry
+
+            get_registry().gauge("trino_tpu_query_history_retained").set(
+                len(self._queries)
+            )
+        except Exception:  # noqa: BLE001
+            pass
         if len(self._queries) <= self.max_history:
             return
         # evict least-recently-ACCESSED terminal queries only: a client may

@@ -42,7 +42,7 @@ class _Waiter:
     always invoked OUTSIDE it."""
 
     __slots__ = ("group", "enq_mono", "deadline", "event", "callback",
-                 "admitted")
+                 "admitted", "peak_hbm_hint")
 
     def __init__(
         self,
@@ -53,6 +53,7 @@ class _Waiter:
         callback: Optional[
             Callable[["ResourceGroup", Optional[Exception]], None]
         ] = None,
+        peak_hbm_hint: int = 0,
     ):
         self.group = group
         self.enq_mono = enq_mono  # monotonic: queue-wait SLO accounting
@@ -60,6 +61,11 @@ class _Waiter:
         self.event = event
         self.callback = callback
         self.admitted = False
+        # observed peak-HBM bytes from the query-history store (0 =
+        # unknown): a waiter whose programs won't fit CURRENT device
+        # headroom is skipped over — not head-of-line blocking — until
+        # memory frees or its queue wait expires
+        self.peak_hbm_hint = peak_hbm_hint
 
 
 @dataclasses.dataclass
@@ -281,6 +287,7 @@ class ResourceGroupManager:
         ready: Optional[
             Callable[[ResourceGroup, Optional[Exception]], None]
         ] = None,
+        peak_hbm_hint: int = 0,
     ) -> tuple[ResourceGroup, bool]:
         """Event-driven admission: never parks the calling thread.
 
@@ -290,7 +297,10 @@ class ResourceGroupManager:
         ``ready(group, QueryQueueFullError)`` when the queue wait
         expires. Callbacks run outside the manager lock (on whichever
         thread released the slot). Raises immediately when the queue is
-        full or no selector matches.
+        full or no selector matches. ``peak_hbm_hint`` (observed bytes
+        from the query-history store) additionally gates admission on
+        live device headroom: a query known to need more HBM than is
+        currently free queues instead of failing at compile.
         """
         group = self._resolve(user, source)
         now = time.monotonic()
@@ -299,7 +309,11 @@ class ResourceGroupManager:
         admitted = False
         with self._lock:
             self._collect_expired_locked(timed_out)
-            if group._can_run_locked() and not group.queue:
+            if (
+                group._can_run_locked()
+                and not group.queue
+                and self._hbm_fits(peak_hbm_hint)
+            ):
                 group._start_locked()
                 admitted = True
             elif len(group.queue) >= group.config.max_queued:
@@ -309,6 +323,7 @@ class ResourceGroupManager:
             else:
                 group.queue.append(_Waiter(
                     group, now, now + self.max_wait_seconds, callback=ready,
+                    peak_hbm_hint=peak_hbm_hint,
                 ))
             self._publish_locked()
         self._fire_timeouts(timed_out)
@@ -425,10 +440,11 @@ class ResourceGroupManager:
         self, g: ResourceGroup, fired: list
     ) -> None:
         while True:
-            candidate = self._pick_candidate_locked(g)
-            if candidate is None:
+            picked = self._pick_candidate_locked(g)
+            if picked is None:
                 return
-            w = candidate.queue.popleft()
+            candidate, w = picked
+            candidate.queue.remove(w)
             candidate._start_locked()
             w.admitted = True
             waited = time.monotonic() - w.enq_mono
@@ -446,11 +462,19 @@ class ResourceGroupManager:
             "trino_tpu_resource_group_queue_wait_ms", group=group.full_name
         ).observe(waited_s * 1000.0)
 
-    def _pick_candidate_locked(self, g: ResourceGroup) -> Optional[ResourceGroup]:
+    def _pick_candidate_locked(
+        self, g: ResourceGroup
+    ) -> Optional[tuple[ResourceGroup, _Waiter]]:
+        """(group, waiter) next in line, honoring HBM hints: within a
+        group, the first FIFO waiter whose observed peak-HBM fits current
+        device headroom wins — an over-headroom waiter is skipped over
+        (never head-of-line blocking) and retried on the next wake; if it
+        never fits, the queue-wait expiry reaps it."""
         if not g._can_run_locked():
             return None
-        if g.queue:
-            return g
+        for w in g.queue:
+            if self._hbm_fits(w.peak_hbm_hint):
+                return g, w
         kids = [c for c in g.children.values() if c._queued_count_locked() > 0]
         if not kids:
             return None
@@ -463,6 +487,20 @@ class ResourceGroupManager:
             if found is not None:
                 return found
         return None
+
+    @staticmethod
+    def _hbm_fits(peak_hbm_hint: int) -> bool:
+        """Does a program with this observed peak footprint fit the
+        device's CURRENT free HBM? Hint 0 (no history) and backends
+        without memory accounting always admit."""
+        if not peak_hbm_hint:
+            return True
+        try:
+            from trino_tpu.ingest import hbm_headroom_ok
+
+            return hbm_headroom_ok(0, peak_hbm_hint=int(peak_hbm_hint))
+        except Exception:  # noqa: BLE001 — accounting must never wedge
+            return True
 
     def info(self) -> list[dict]:
         with self._lock:

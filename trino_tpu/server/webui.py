@@ -127,6 +127,18 @@ function renderStages(q) {
       Number(v).toLocaleString();
   const flops = v => (v === null || v === undefined) ? '' :
       Number(v).toExponential(2);
+  // capacity provenance: which of default/seeded/history (+grown/+halved
+  // corrections) the stage's capacity sites ran on — 'history' means the
+  // query ran on observed truth, a '+' suffix means the estimate missed
+  const prov = ex => {
+    const caps = ex.capacities || {};
+    const seen = new Set();
+    for (const k of Object.keys(caps)) {
+      const p = (caps[k] || {}).provenance;
+      if (p) seen.add(p);
+    }
+    return Array.from(seen).sort().join(' ');
+  };
   const rows = stages.map(s => {
     const ex = s.exchange || {};
     return `<tr><td>${esc(s.stage)}</td>` +
@@ -135,11 +147,13 @@ function renderStages(q) {
       `<td class="num">${(s.elapsedMs || 0).toFixed(1)}</td>` +
       `<td class="num">${num(ex.shuffle_bytes)}</td>` +
       `<td class="num">${flops(s.flops)}</td>` +
-      `<td class="num">${num(s.peakHbmBytes)}</td></tr>`;
+      `<td class="num">${num(s.peakHbmBytes)}</td>` +
+      `<td>${esc(prov(ex))}</td></tr>`;
   });
   return '<table class="stages"><tr><th>stage</th><th>tasks</th>' +
     '<th>rows</th><th>wall ms</th><th>shuffle B</th>' +
-    '<th>flops</th><th>peak HBM B</th></tr>' + rows.join('') + '</table>';
+    '<th>flops</th><th>peak HBM B</th><th>capacity prov</th></tr>' +
+    rows.join('') + '</table>';
 }
 
 async function toggleTimeline(qid) {

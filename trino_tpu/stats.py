@@ -107,6 +107,33 @@ def render_device_stats(device_stats: dict) -> str:
     return "\n".join(lines)
 
 
+def render_capacity_stats(capacities: dict) -> str:
+    """EXPLAIN ANALYZE section for capacity sites: final value +
+    provenance per site, with the estimated-vs-observed drift summary.
+    A ``+grown``/``+halved`` suffix marks exactly where the estimate
+    (default or seeded) missed and the retry ladder had to correct it;
+    ``history``-provenance sites started from observed truth
+    (obs/history.py) and should show no suffix on warm repeats."""
+    lines = ["Capacity sites (final value, provenance):"]
+    grown = halved = history = 0
+    for name, ent in sorted(
+        capacities.items(), key=lambda kv: str(kv[1].get("site", kv[0]))
+    ):
+        prov = str(ent.get("provenance", "default"))
+        lines.append(f"  {ent.get('site', name)}: {ent.get('value')} ({prov})")
+        if "+grown" in prov:
+            grown += 1
+        if "+halved" in prov:
+            halved += 1
+        if prov.startswith("history"):
+            history += 1
+    lines.append(
+        f"  estimated vs observed: {grown} grown, {halved} halved, "
+        f"{history} history-seeded of {len(capacities)} sites"
+    )
+    return "\n".join(lines)
+
+
 def render_distributed_plan(
     node: P.PlanNode,
     cluster_stats: dict,
@@ -152,6 +179,20 @@ def render_distributed_plan(
         ]
         if exparts:
             lines.append("    exchange: " + " ".join(exparts))
+        stage_caps = ex.get("capacities")
+        if isinstance(stage_caps, dict) and stage_caps:
+            cparts = []
+            for name, ent in sorted(
+                stage_caps.items(),
+                key=lambda kv: str(kv[1].get("site", kv[0])),
+            ):
+                if isinstance(ent, dict):
+                    cparts.append(
+                        f"{ent.get('site', name)}="
+                        f"{ent.get('value')}({ent.get('provenance', '?')})"
+                    )
+            if cparts:
+                lines.append("    capacities: " + " ".join(cparts))
         dparts = []
         if st.get("flops") is not None:
             dparts.append(f"flops={st['flops']:.4g}")
