@@ -470,6 +470,149 @@ def bench_concurrency(
     return out
 
 
+def bench_open_loop(
+    clients: int = 1000,
+    qps: float = 2000.0,
+    duration_s: float = 5.0,
+    path: str = "/v1/info",
+) -> dict:
+    """Serving-tier open loop: ``clients`` keep-alive HTTP pollers at a
+    fixed aggregate arrival rate against the event-loop front door.
+
+    The load generator is itself a single-threaded ``selectors`` loop —
+    one thread drives every connection — so the measured thread count is
+    the SERVER's concurrency cost, not the harness's. Reports request
+    p50/p99, achieved qps, shed counts (from the metrics registry), and
+    peak process thread count (the headline: threads << clients)."""
+    import selectors
+    import socket
+    import threading
+
+    from trino_tpu.config import ServerConfig
+    from trino_tpu.obs.metrics import get_registry
+    from trino_tpu.server.http import TrinoTpuServer
+
+    server = TrinoTpuServer(
+        server_config=ServerConfig(max_connections=clients + 64)
+    ).start()
+    before = get_registry().snapshot()
+    sel = selectors.DefaultSelector()
+    request = (
+        f"GET {path} HTTP/1.1\r\nHost: bench\r\n\r\n"
+    ).encode()
+    interval = clients / qps  # per-client inter-arrival gap
+    t_start = time.time() + 0.5  # connect phase happens off the clock
+
+    class _Poller:
+        __slots__ = ("sock", "buf", "inflight", "next_at", "t0")
+
+        def __init__(self, i: int):
+            self.sock = socket.create_connection(
+                (server.host, server.port), timeout=10
+            )
+            self.sock.setblocking(False)
+            self.buf = b""
+            self.inflight = False
+            # stagger starts uniformly across one interval
+            self.next_at = t_start + (i / clients) * interval
+            self.t0 = 0.0
+
+    pollers = [_Poller(i) for i in range(clients)]
+    for p in pollers:
+        sel.register(p.sock, selectors.EVENT_READ, p)
+
+    lat_ms: list = []
+    shed_in_band = 0  # 503s observed by the pollers themselves
+    errors = 0
+    peak_threads = threading.active_count()
+    deadline = t_start + duration_s
+
+    def _response_complete(buf: bytes):
+        head_end = buf.find(b"\r\n\r\n")
+        if head_end < 0:
+            return None
+        head = buf[:head_end].decode("iso-8859-1", "replace")
+        clen = 0
+        for line in head.split("\r\n")[1:]:
+            if line.lower().startswith("content-length:"):
+                clen = int(line.split(":", 1)[1])
+        total = head_end + 4 + clen
+        if len(buf) < total:
+            return None
+        return head.split(" ", 2)[1], buf[total:]
+
+    now = time.time()
+    while now < deadline:
+        # fire every poller whose arrival time has come
+        nxt = deadline
+        for p in pollers:
+            if not p.inflight and p.next_at <= now:
+                try:
+                    p.sock.sendall(request)
+                except OSError:
+                    errors += 1
+                    p.next_at = now + interval
+                    continue
+                p.inflight = True
+                p.t0 = now
+            if not p.inflight:
+                nxt = min(nxt, p.next_at)
+        for key, _ in sel.select(timeout=max(0.0, min(nxt, deadline) - time.time())):
+            p = key.data
+            try:
+                chunk = p.sock.recv(65536)
+            except BlockingIOError:
+                continue
+            except OSError:
+                errors += 1
+                continue
+            if not chunk:
+                errors += 1
+                sel.unregister(p.sock)
+                continue
+            p.buf += chunk
+            done = _response_complete(p.buf)
+            if done is not None:
+                status, p.buf = done
+                now2 = time.time()
+                lat_ms.append((now2 - p.t0) * 1000.0)
+                if status == "503":
+                    shed_in_band += 1
+                p.inflight = False
+                # open loop: schedule from the timeline, not completion
+                p.next_at = max(p.next_at + interval, now2)
+        peak_threads = max(peak_threads, threading.active_count())
+        now = time.time()
+
+    for p in pollers:
+        try:
+            p.sock.close()
+        except OSError:
+            pass
+    after = get_registry().snapshot()
+    server.stop()
+    shed_total = 0
+    for k, v in after.get("counters", {}).items():
+        if k.startswith("trino_tpu_requests_shed_total"):
+            shed_total += int(
+                v - before.get("counters", {}).get(k, 0)
+            )
+    wall = max(1e-9, time.time() - t_start)
+    return {
+        "clients": clients,
+        "offered_qps": qps,
+        "achieved_qps": round(len(lat_ms) / wall, 1),
+        "p50_ms": _percentile(lat_ms, 50),
+        "p99_ms": _percentile(lat_ms, 99),
+        "requests": len(lat_ms),
+        "shed_503": shed_in_band,
+        "shed_counter_delta": shed_total,
+        "errors": errors,
+        "peak_threads": peak_threads,
+        "threads_much_less_than_clients": peak_threads * 10 <= clients,
+    }
+
+
 def _subprocess_entry(call: str, timeout_s: int) -> dict:
     """Run ``bench_suite.<call>`` in a fresh python, hard-killed on
     timeout (a cancelled XLA compile holds the chip: the child must DIE,
@@ -520,6 +663,9 @@ def run_suite() -> dict:
         "parquet_table_cache()", 420
     )
     suite["concurrency"] = _subprocess_entry("bench_concurrency()", 420)
+    suite["open_loop_http"] = _subprocess_entry(
+        "bench_open_loop(clients=200, qps=400.0, duration_s=4.0)", 120
+    )
     suite["adaptive_history"] = _subprocess_entry("adaptive_history()", 420)
     suite["suite_wall_s"] = round(time.time() - t0, 1)
     return suite

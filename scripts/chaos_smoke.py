@@ -47,7 +47,19 @@ Quick manual repro for the fault-tolerance stack (CI runs the same
 scenarios as ``tests/test_fault_tolerance.py -m faults`` /
 ``tests/test_speculation.py`` / ``tests/test_spool.py``).
 
-Usage: JAX_PLATFORMS=cpu python scripts/chaos_smoke.py [seed]
+8. ``overload`` (own entry point: ``chaos_smoke.py overload``): an
+   in-process coordinator with deliberately tiny admission capacity is
+   offered 4× that capacity from closed-loop retrying clients while a
+   burst tenant trips the token bucket. FAIL on row drift of any
+   ADMITTED query, on a 503 that does not carry Retry-After, or on
+   queue depth exceeding the closed-loop bound (unbounded growth means
+   abandoned waiters are leaking).
+
+Quick manual repro for the fault-tolerance stack (CI runs the same
+scenarios as ``tests/test_fault_tolerance.py -m faults`` /
+``tests/test_speculation.py`` / ``tests/test_spool.py``).
+
+Usage: JAX_PLATFORMS=cpu python scripts/chaos_smoke.py [seed|overload]
 """
 
 import json
@@ -215,6 +227,213 @@ def _adaptive_warmup(seed: int) -> dict:
         "history_seeds": wex.get("history_seeds", 0),
         "drift": warm.rows != cold.rows,
     }
+
+
+def overload() -> int:
+    """4× admission-capacity overload against the event-loop front door.
+
+    Capacity is 2 concurrent queries (hard_concurrency_limit=2); 8
+    closed-loop clients keep 4× that admitted-or-waiting at all times,
+    while per-tenant token buckets shed their statement bursts with
+    503 + Retry-After and the clients' jittered backoff retries carry
+    them through. Invariants: admitted queries stay bit-identical to
+    their sequential runs, every shed carries Retry-After, and queue
+    depth never exceeds the closed-loop bound of one outstanding query
+    per client."""
+    import threading
+    import time
+    import urllib.error
+
+    from trino_tpu.client import ClientSession, Connection
+    from trino_tpu.config import ServerConfig
+    from trino_tpu.engine import Engine
+    from trino_tpu.server.http import TrinoTpuServer
+    from trino_tpu.server.resourcegroups import (
+        GroupConfig,
+        ResourceGroupManager,
+        Selector,
+    )
+
+    clients = 8
+    capacity = 2  # offered load is 4x this
+    summary: dict = {"scenario": "overload", "partial": True}
+    try:
+        rgm = ResourceGroupManager(max_wait_seconds=30.0)
+        rgm.configure(
+            [
+                GroupConfig(
+                    "root",
+                    max_queued=100,
+                    hard_concurrency_limit=capacity,
+                )
+            ],
+            [Selector(group="root")],
+        )
+        engine = Engine()
+        server = TrinoTpuServer(
+            engine=engine,
+            resource_groups=rgm,
+            server_config=ServerConfig(
+                tenant_rate_limit_qps=20.0,
+                tenant_rate_limit_burst=4.0,
+                max_inflight_requests=64,
+            ),
+        ).start()
+        sql = (
+            "select l_returnflag, sum(l_quantity), count(*)"
+            " from tpch.tiny.lineitem where l_quantity < {}"
+            " group by l_returnflag order by l_returnflag"
+        )
+        lits = [10 + 2 * (i % 8) for i in range(clients * 4)]
+        from trino_tpu.config import Session
+
+        seq_rows = {
+            lit: engine.execute_statement(sql.format(lit), Session()).rows
+            for lit in sorted(set(lits))
+        }
+
+        # queue-depth monitor: closed-loop clients have at most one
+        # statement outstanding each and the burst tenant fires at most
+        # burst_posts fire-and-forget statements, so queuedQueries above
+        # clients + burst_posts means waiters are leaking (the
+        # "unbounded growth" failure mode)
+        burst_posts = 8
+        peak_queued = [0]
+        stop = threading.Event()
+
+        def monitor() -> None:
+            while not stop.is_set():
+                info = rgm.info()[0]
+                peak_queued[0] = max(peak_queued[0], info["queuedQueries"])
+                stop.wait(0.02)
+
+        mon = threading.Thread(target=monitor, daemon=True)
+        mon.start()
+
+        drift = [0]
+        completed = [0]
+        errors: list = []
+        lock = threading.Lock()
+
+        def client(c: int) -> None:
+            conn = Connection(
+                server.base_uri,
+                ClientSession(user=f"tenant-{c % 4}", shed_retry_attempts=8),
+            )
+            for r in range(4):
+                lit = lits[(r * clients + c) % len(lits)]
+                try:
+                    rows, _ = conn.execute(sql.format(lit))
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"client {c}: {e!r}")
+                    continue
+                with lock:
+                    completed[0] += 1
+                    if [list(t) for t in rows] != [
+                        list(t) for t in seq_rows[lit]
+                    ]:
+                        drift[0] += 1
+
+        ts = [
+            threading.Thread(target=client, args=(c,)) for c in range(clients)
+        ]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+
+        # while the fleet saturates admission, trip the token bucket
+        # directly and verify the shed contract: 503 AND Retry-After
+        sheds_seen = 0
+        bad_sheds = 0
+        for _ in range(burst_posts):
+            req = urllib.request.Request(
+                f"{server.base_uri}/v1/statement",
+                data=b"select 1",
+                method="POST",
+                headers={"X-Trino-User": "burster"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=10).read()
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    sheds_seen += 1
+                    if e.headers.get("Retry-After") is None:
+                        bad_sheds += 1
+                e.read()
+
+        for t in ts:
+            t.join(120)
+        stop.set()
+        mon.join(2)
+        wall = time.time() - t0
+
+        snap = {}
+        with urllib.request.urlopen(
+            f"{server.base_uri}/v1/metrics?format=json", timeout=10
+        ) as r:
+            snap = json.loads(r.read().decode())
+        shed_counters = {
+            k: v
+            for k, v in snap.get("counters", {}).items()
+            if k.startswith("trino_tpu_requests_shed_total")
+        }
+        server.stop()
+
+        summary.update(
+            {
+                "clients": clients,
+                "capacity": capacity,
+                "completed": completed[0],
+                "row_drift": drift[0],
+                "errors": errors[:5],
+                "peak_queued": peak_queued[0],
+                "burst_sheds": sheds_seen,
+                "sheds_without_retry_after": bad_sheds,
+                "shed_counters": shed_counters,
+                "wall_s": round(wall, 2),
+                "partial": False,
+            }
+        )
+        if errors:
+            print(f"FAIL: overload clients errored: {errors[:3]}")
+            summary["ok"] = False
+            return 1
+        if drift[0]:
+            print(f"FAIL: {drift[0]} admitted queries drifted under overload")
+            summary["ok"] = False
+            return 1
+        if completed[0] != clients * 4:
+            print(
+                f"FAIL: only {completed[0]}/{clients * 4} queries completed"
+            )
+            summary["ok"] = False
+            return 1
+        if peak_queued[0] > clients + burst_posts:
+            print(
+                f"FAIL: queue grew to {peak_queued[0]} with only {clients}"
+                f" closed-loop clients + {burst_posts} burst posts —"
+                " waiters are leaking"
+            )
+            summary["ok"] = False
+            return 1
+        if sheds_seen == 0:
+            print("FAIL: burst tenant was never shed — overload never bit")
+            summary["ok"] = False
+            return 1
+        if bad_sheds:
+            print(f"FAIL: {bad_sheds} 503s arrived without Retry-After")
+            summary["ok"] = False
+            return 1
+        print(
+            "OK: bit-identical under 4x admission overload"
+            f" ({completed[0]} queries, {sheds_seen} sheds all carrying"
+            " Retry-After, bounded queue)"
+        )
+        summary["ok"] = True
+        return 0
+    finally:
+        print(json.dumps(summary), flush=True)
 
 
 def main() -> int:
@@ -540,4 +759,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "overload":
+        sys.exit(overload())
     sys.exit(main())

@@ -75,6 +75,25 @@ class StatementClient:
         return h
 
     def _request(self, method: str, uri: str, body: Optional[bytes] = None) -> dict:
+        """One protocol round trip, honoring 503 + Retry-After shedding.
+
+        A shed response (503 carrying Retry-After) means the server is
+        overloaded, not failing: back off (jittered, deterministic —
+        ``ft.retry.Backoff``, floored at the server's hint) and retry a
+        bounded number of times. A 503 without Retry-After (draining
+        server) is not retried — that server is going away."""
+        attempts = max(1, int(self.session.shed_retry_attempts))
+        for attempt in range(1, attempts + 1):
+            try:
+                return self._request_once(method, uri, body)
+            except urllib.error.HTTPError as e:
+                retry_after = e.headers.get("Retry-After") if e.headers else None
+                if e.code != 503 or retry_after is None or attempt >= attempts:
+                    raise
+                _sleep_for_retry(retry_after, attempt)
+        raise AssertionError("unreachable")
+
+    def _request_once(self, method: str, uri: str, body: Optional[bytes] = None) -> dict:
         req = urllib.request.Request(uri, data=body, method=method)
         for k, v in self._headers().items():
             req.add_header(k, v)
@@ -149,6 +168,22 @@ class StatementClient:
                 )
 
 
+def _sleep_for_retry(retry_after: str, attempt: int) -> None:
+    import time
+
+    from trino_tpu.ft.retry import Backoff
+
+    base_ms = 100.0
+    try:
+        base_ms = max(base_ms, float(retry_after) * 1000.0)
+    except (TypeError, ValueError):
+        pass
+    delay = Backoff(
+        initial_ms=base_ms, max_ms=max(4 * base_ms, 5000.0), seed=0
+    ).delay(attempt)
+    time.sleep(delay)
+
+
 def _decode_value(v: Any, type_: str) -> Any:
     if v is None:
         return None
@@ -170,6 +205,9 @@ class ClientSession:
     # per-request socket timeout (seconds) for the statement protocol
     # (OkHttp client timeout analog; chaos tests shrink it)
     request_timeout: float = 120.0
+    # total tries for a request shed with 503 + Retry-After (overload);
+    # 1 disables retries entirely
+    shed_retry_attempts: int = 3
 
 
 class Connection:

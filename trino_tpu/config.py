@@ -245,3 +245,40 @@ class Session:
 
     def set(self, name: str, value: Any) -> None:
         self.properties[name] = value
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """Front-door (HTTP serving tier) knobs, analogous to airlift's
+    ``HttpServerConfig`` + Trino's ``QueryManagerConfig`` client-timeout.
+
+    These govern the serving edge — connection budgets, shedding, result
+    paging — not query semantics, so they live apart from ``Session``.
+    """
+
+    # Global ceiling on requests concurrently occupying blocking-pool
+    # workers; excess requests shed with 503 + Retry-After.
+    max_inflight_requests: int = 256
+    # Per-tenant (X-Trino-User) statement-submission rate limit; 0 = off.
+    tenant_rate_limit_qps: float = 0.0
+    tenant_rate_limit_burst: float = 16.0
+    # A query whose nextUri goes unpolled this long is canceled and its
+    # admission slot freed (reference: Trino query.client.timeout).
+    client_timeout_s: float = 120.0
+    # Byte budget per result page served off the streaming pager; <= 0
+    # falls back to fixed row-count pages over the materialized result.
+    result_page_max_bytes: int = 1 << 20
+    # Outbound intra-cluster HTTP calls (announce, drain spool push).
+    http_request_timeout_s: float = 10.0
+    # Serving-edge socket hygiene.
+    read_timeout_s: float = 30.0       # slowloris: max time to frame a request
+    idle_timeout_s: float = 300.0      # keep-alive connections with no traffic
+    write_timeout_s: float = 60.0      # peer stopped draining a response
+    max_connections: int = 4096
+    blocking_pool_size: int = 16
+    # Graceful drain.
+    drain_timeout_s: float = 120.0     # worker: max wait for running tasks
+    drain_grace_s: float = 0.5         # coordinator: settle time before stop
+    spool_finish_timeout_s: float = 30.0
+    # Retry-After hint attached to shed responses.
+    shed_retry_after_s: float = 1.0

@@ -44,6 +44,31 @@ def main(argv=None) -> int:
         default=None,
         help="coordinator-enforced cluster-wide memory ceiling",
     )
+    parser.add_argument(
+        "--max-inflight-requests",
+        type=int,
+        default=None,
+        help="global ceiling on concurrently handled external requests"
+        " (excess shed with 503 + Retry-After)",
+    )
+    parser.add_argument(
+        "--tenant-rate-limit-qps",
+        type=float,
+        default=None,
+        help="per-tenant statement token-bucket refill rate (0 disables)",
+    )
+    parser.add_argument(
+        "--client-timeout-s",
+        type=float,
+        default=None,
+        help="cancel a query unpolled by its client for this long",
+    )
+    parser.add_argument(
+        "--result-page-max-bytes",
+        type=int,
+        default=None,
+        help="byte budget per streamed result page (0 = materialized)",
+    )
     args = parser.parse_args(argv)
 
     if args.platform:
@@ -68,6 +93,20 @@ def main(argv=None) -> int:
         for spec in args.catalog:
             register_catalog_spec(engine.catalogs, spec)
 
+    server_config = None
+    overrides = {
+        "max_inflight_requests": args.max_inflight_requests,
+        "tenant_rate_limit_qps": args.tenant_rate_limit_qps,
+        "client_timeout_s": args.client_timeout_s,
+        "result_page_max_bytes": args.result_page_max_bytes,
+    }
+    if any(v is not None for v in overrides.values()):
+        from trino_tpu.config import ServerConfig
+
+        server_config = ServerConfig(
+            **{k: v for k, v in overrides.items() if v is not None}
+        )
+
     server = TrinoTpuServer(
         engine=engine,
         host=args.host,
@@ -77,6 +116,7 @@ def main(argv=None) -> int:
         discovery_uri=args.discovery,
         spmd=bool(args.spmd_coordinator),
         cluster_memory_limit_bytes=args.cluster_memory_limit_bytes,
+        server_config=server_config,
     )
     server.start()
     # parent supervisors (tests, orchestration) read this line

@@ -87,6 +87,12 @@ class ManagedQuery:
         self._end_mono: Optional[float] = None
         self.last_access = time.monotonic()  # protocol touch; guards history GC
         self._cancelled = threading.Event()
+        # set by QueryManager while this query waits un-admitted in a
+        # resource-group queue; cancel() invokes it to free the queue slot
+        self._admission_abandon: Optional[Any] = None
+        # lazy byte-budgeted pager over result.rows (streaming protocol)
+        self._pager: Optional["ResultPager"] = None
+        self._pager_lock = threading.Lock()
         self.query_attempts = 1  # >1 under retry_policy=QUERY
         self._engine = engine
         self._completed_fired = False
@@ -104,7 +110,12 @@ class ManagedQuery:
 
     # --- lifecycle --------------------------------------------------------
 
-    def run(self, engine: Engine) -> None:
+    def run(self, engine: Engine, release=None) -> None:
+        """Execute. ``release`` (the admission-slot release hook) is
+        invoked once engine work is done but BEFORE the terminal state
+        transition fires client-visible listeners — otherwise a client
+        can observe its query complete while the slot still reads as
+        running (the caller's finally still covers every early exit)."""
         from trino_tpu.ft.retry import Backoff, RetryPolicy, is_retryable
 
         if self._cancelled.is_set():
@@ -148,6 +159,8 @@ class ManagedQuery:
                         time.sleep(backoff.delay(attempt))
                         attempt += 1
                         self.query_attempts = attempt
+            if release is not None:
+                release()
             self.state.set(QueryState.FINISHING)
             self.state.set(QueryState.FINISHED)
         except Exception as e:  # noqa: BLE001 — any failure fails the query
@@ -159,6 +172,8 @@ class ManagedQuery:
                 str(e), code, name, typ, traceback.format_exc(),
                 retryable=is_retryable(e),
             )
+            if release is not None:
+                release()
             self.state.set(QueryState.FAILED)
         finally:
             self.end_time = time.time()
@@ -215,13 +230,34 @@ class ManagedQuery:
             )
         )
 
-    def cancel(self) -> None:
+    def cancel(self, message: str = "Query was canceled") -> None:
         self._cancelled.set()
+        abandon = self._admission_abandon
+        if abandon is not None:
+            self._admission_abandon = None
+            try:
+                abandon()  # free the un-admitted resource-group queue slot
+            except Exception:  # noqa: BLE001
+                pass
         if self.state.set(QueryState.CANCELED):
-            self.error = ErrorInfo("Query was canceled", 1, "USER_CANCELED", "USER_ERROR")
+            self.error = ErrorInfo(message, 1, "USER_CANCELED", "USER_ERROR")
             self.end_time = time.time()
             self._end_mono = time.monotonic()
             self._fire_completed()
+
+    def result_pager(
+        self, page_max_bytes: int, max_rows_per_page: int = 4096
+    ) -> Optional["ResultPager"]:
+        """The query's streaming pager (created lazily, one per query).
+        Returns None until the result materializes."""
+        if self.result is None:
+            return None
+        with self._pager_lock:
+            if self._pager is None:
+                self._pager = ResultPager(
+                    self.result.rows, page_max_bytes, max_rows_per_page
+                )
+            return self._pager
 
     def kill(self, message: str) -> bool:
         """Administrative kill (cluster memory manager): FAILED with
@@ -345,6 +381,83 @@ class ManagedQuery:
         if self.start_time is None:
             return None
         return self._create_mono + max(0.0, self.start_time - self.create_time)
+
+
+class ResultPager:
+    """Byte-budgeted page server over a query's result rows.
+
+    Reference: ``server/protocol/Query.java`` (targetResultSize paging).
+    Pages are cut on demand as the client polls ``nextUri`` — a page ends
+    when its JSON-encoded size reaches ``page_max_bytes`` or
+    ``max_rows_per_page`` rows, whichever first.  Serving token N acks
+    (frees) every buffered page below N, so at most the in-flight page
+    plus the just-produced one stay resident: producer backpressure is
+    the client's own poll cadence.  Re-requesting the last un-acked token
+    is idempotent (HTTP retry safety).
+    """
+
+    def __init__(
+        self, rows, page_max_bytes: int, max_rows_per_page: int = 4096
+    ):
+        self._src = iter(rows)
+        self.total_rows = len(rows)
+        self._budget = max(1, int(page_max_bytes))
+        self._max_rows = max(1, int(max_rows_per_page))
+        self._pages: dict[int, list] = {}
+        self._page_bytes: dict[int, int] = {}
+        self._next = 0  # next token to produce
+        self._exhausted = False
+        self.pages_produced = 0
+        self.buffered_bytes = 0
+        self.peak_buffered_bytes = 0
+        self._lock = threading.Lock()
+
+    def page(self, token: int) -> tuple[Optional[list], bool]:
+        """Rows for ``token`` (None when past the end) plus whether more
+        pages may follow."""
+        with self._lock:
+            self._ack_below_locked(token)
+            while token >= self._next and not self._exhausted:
+                self._produce_locked()
+            self._ack_below_locked(token)
+            rows = self._pages.get(token)
+            if rows is None:
+                return None, False
+            more = (token + 1 < self._next) or not self._exhausted
+            return rows, more
+
+    def _ack_below_locked(self, token: int) -> None:
+        for t in [t for t in self._pages if t < token]:
+            self.buffered_bytes -= self._page_bytes.pop(t)
+            del self._pages[t]
+
+    def _produce_locked(self) -> None:
+        import json
+
+        rows: list = []
+        nbytes = 2  # brackets
+        for row in self._src:
+            try:
+                enc = len(json.dumps(row, default=str))
+            except (TypeError, ValueError):
+                enc = 64
+            rows.append(row)
+            nbytes += enc + 2
+            if nbytes >= self._budget or len(rows) >= self._max_rows:
+                break
+        else:
+            self._exhausted = True
+        if not rows:
+            self._exhausted = True
+            return
+        self._pages[self._next] = rows
+        self._page_bytes[self._next] = nbytes
+        self._next += 1
+        self.pages_produced += 1
+        self.buffered_bytes += nbytes
+        self.peak_buffered_bytes = max(
+            self.peak_buffered_bytes, self.buffered_bytes
+        )
 
 
 class _DispatchPool:
@@ -476,6 +589,7 @@ class QueryManager:
         def ready(group, err) -> None:
             # fires on whichever thread freed the slot (or reaped the
             # timeout) — hand off immediately, never execute inline
+            q._admission_abandon = None
             if err is not None:
                 self._reject(q, err)
                 return
@@ -510,6 +624,13 @@ class QueryManager:
             return
         if admitted:
             self._pool.submit(self._run_admitted, q, group)
+        else:
+            # let cancel() free the queue slot if the client abandons the
+            # query before a slot opens (resource-group doubles may lack
+            # abandon(); getattr keeps them working)
+            abandon_fn = getattr(self.resource_groups, "abandon", None)
+            if abandon_fn is not None:
+                q._admission_abandon = lambda: abandon_fn(group, ready)
 
     def _history_hbm_gate(self, q: ManagedQuery) -> int:
         """Observed peak-HBM for this query's fingerprint, as an admission
@@ -545,11 +666,18 @@ class QueryManager:
             return 0
 
     def _run_admitted(self, q: ManagedQuery, group) -> None:
+        released = threading.Event()
+
+        def release() -> None:
+            if not released.is_set():
+                released.set()
+                self.resource_groups.finish(group)
+
         try:
             if q.state.get() == QueryState.QUEUED:
-                q.run(self.engine)
+                q.run(self.engine, release=release)
         finally:
-            self.resource_groups.finish(group)
+            release()
 
     def _reject(self, q: ManagedQuery, e: Exception) -> None:
         from trino_tpu.errors import classify_error
@@ -607,6 +735,40 @@ class QueryManager:
             return False
         q.cancel()
         return True
+
+    def expire_abandoned(self, client_timeout_s: float) -> list[str]:
+        """Cancel non-terminal queries whose ``nextUri`` went unpolled for
+        ``client_timeout_s`` (abandoned dashboards must not pin resource
+        groups). Returns the canceled query ids.
+
+        Reference: Trino ``query.client.timeout`` in SqlQueryManager's
+        ``enforceTimeouts``.
+        """
+        if client_timeout_s <= 0:
+            return []
+        now = time.monotonic()
+        victims = [
+            q for q in self.queries()
+            if not q.state.is_terminal()
+            and now - q.last_access > client_timeout_s
+        ]
+        out: list[str] = []
+        for q in victims:
+            q.cancel(
+                "Query abandoned: no client poll within "
+                f"{client_timeout_s:g}s"
+            )
+            out.append(q.query_id)
+        if out:
+            try:
+                from trino_tpu.obs.metrics import get_registry
+
+                get_registry().counter(
+                    "trino_tpu_queries_abandoned_total"
+                ).inc(len(out))
+            except Exception:  # noqa: BLE001
+                pass
+        return out
 
     def kill(self, query_id: str, message: str) -> bool:
         q = self.get(query_id)

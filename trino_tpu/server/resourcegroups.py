@@ -179,6 +179,11 @@ class ResourceGroupManager:
         self.roots: dict[str, ResourceGroup] = {}
         self.selectors: list[Selector] = []
         self.max_wait_seconds = max_wait_seconds
+        # Deterministic expiry: a one-shot daemon timer armed for the
+        # earliest callback-waiter deadline, so queue-timeout rejection
+        # fires on time even when no other query finishes.
+        self._reap_timer: Optional[threading.Timer] = None
+        self._reap_at = float("inf")
         self.configure(
             [GroupConfig("global", max_queued=1000, hard_concurrency_limit=100)],
             [Selector(group="global")],
@@ -325,6 +330,7 @@ class ResourceGroupManager:
                     group, now, now + self.max_wait_seconds, callback=ready,
                     peak_hbm_hint=peak_hbm_hint,
                 ))
+                self._schedule_reap_locked()
             self._publish_locked()
         self._fire_timeouts(timed_out)
         if err is not None:
@@ -347,10 +353,65 @@ class ResourceGroupManager:
                 pass  # strand other finishers
         self._fire_timeouts(timed_out)
 
+    def abandon(
+        self,
+        group: ResourceGroup,
+        callback: Callable[[ResourceGroup, Optional[Exception]], None],
+    ) -> bool:
+        """Remove a not-yet-admitted callback waiter (client abandoned the
+        query before it got a slot). Returns True if a waiter was removed;
+        False means it was already admitted, expired, or never queued."""
+        with self._lock:
+            for w in list(group.queue):
+                if w.callback is callback and not w.admitted:
+                    group.queue.remove(w)
+                    self._publish_locked()
+                    return True
+        return False
+
+    def _schedule_reap_locked(self) -> None:
+        """Arm (or re-arm) the expiry timer for the earliest callback-waiter
+        deadline. Caller holds the manager lock."""
+        earliest = float("inf")
+
+        def walk(g: ResourceGroup) -> None:
+            nonlocal earliest
+            for w in g.queue:
+                if w.callback is not None and w.deadline < earliest:
+                    earliest = w.deadline
+            for c in g.children.values():
+                walk(c)
+
+        for root in self.roots.values():
+            walk(root)
+        if earliest == float("inf"):
+            return
+        if self._reap_timer is not None and self._reap_at <= earliest + 1e-3:
+            return  # already armed early enough
+        if self._reap_timer is not None:
+            self._reap_timer.cancel()
+        delay = max(0.0, earliest - time.monotonic()) + 0.005
+        timer = threading.Timer(delay, self._reap_now)
+        timer.daemon = True
+        timer.start()
+        self._reap_timer = timer
+        self._reap_at = earliest
+
+    def _reap_now(self) -> None:
+        timed_out: list[_Waiter] = []
+        with self._lock:
+            self._reap_timer = None
+            self._reap_at = float("inf")
+            self._collect_expired_locked(timed_out)
+            self._schedule_reap_locked()
+            self._publish_locked()
+        self._fire_timeouts(timed_out)
+
     def _collect_expired_locked(self, out: list) -> None:
-        """Remove callback waiters whose deadline passed (opportunistic
-        reaping: there is no timer thread, so expiry fires on the next
-        submit/finish activity). Event waiters time themselves out —
+        """Remove callback waiters whose deadline passed. The armed reap
+        timer (``_schedule_reap_locked``) makes expiry deterministic;
+        submit/finish activity still reaps opportunistically so a stale
+        timer is never load-bearing. Event waiters time themselves out —
         their parked thread owns removal."""
         now = time.monotonic()
 

@@ -65,6 +65,14 @@ class StateMachine(Generic[S]):
             current = self._state
         fn(current)
 
+    def remove_listener(self, fn: Callable[[S], None]) -> None:
+        """Detach a listener (long-polls must not accumulate forever)."""
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
     def wait_for(self, predicate: Callable[[S], bool], timeout: float) -> S:
         """Block until predicate(state) or timeout (long-poll support)."""
         deadline = time.monotonic() + timeout
