@@ -1,13 +1,15 @@
 #!/usr/bin/env python
-"""Standalone entry point for the JAX jit-safety lint.
+"""Standalone entry point for the repo's static analysis.
 
 Equivalent to ``python -m trino_tpu.lint``; exists so the lint can run
 without the package on ``sys.path`` (e.g. from a CI checkout or a git
 hook). Typical use:
 
-    python scripts/lint.py                     # gate: new violations fail
-    python scripts/lint.py --no-baseline       # show all findings
-    python scripts/lint.py --update-baseline   # accept current findings
+    python scripts/lint.py                       # gate: new violations fail
+    python scripts/lint.py --no-baseline         # show all findings
+    python scripts/lint.py --update-baseline     # accept current findings
+    python scripts/lint.py --only concurrency    # one rule family
+    python scripts/lint.py --stats               # per-rule counts
 """
 
 import sys
@@ -15,7 +17,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-from trino_tpu.lint.jit_safety import main  # noqa: E402
+from trino_tpu.lint.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

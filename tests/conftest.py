@@ -42,6 +42,19 @@ try:
 except Exception:
     pass  # older jax without persistent-cache config
 
+# ── runtime lockdep ─────────────────────────────────────────────────────
+# Lock-order + loop-thread-wait validator (trino_tpu/lint/lockdep.py),
+# armed for the whole suite unless TT_LOCKDEP=0. Locks created from here
+# on are tracked (the interesting ones are per-instance, built during
+# tests); scoped to creation sites inside the repo so jax/stdlib
+# internals stay untouched. The session-teardown gate below fails the
+# run on any recorded problem.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if os.environ.get("TT_LOCKDEP", "1") != "0":
+    from trino_tpu.lint import lockdep as _lockdep
+
+    _lockdep.install(only_paths=(_REPO_ROOT,))
+
 import trino_tpu  # noqa: E402,F401  (enables x64)
 
 import pytest  # noqa: E402
@@ -208,6 +221,21 @@ def pytest_report_header(config):
     except Exception as e:  # noqa: BLE001 — header must never kill collection
         status = f"import failed: {type(e).__name__}"
     return [f"native columnar library: {status}"]
+
+
+@pytest.fixture(scope="session", autouse=True)
+def lockdep_gate():
+    """Fail the session if the runtime lockdep recorded a lock-order
+    cycle or an event-loop thread blocking on a lock."""
+    yield
+    from trino_tpu.lint import lockdep
+
+    if lockdep.installed():
+        problems = lockdep.report()
+        assert not problems, (
+            "runtime lockdep found concurrency problems:\n\n"
+            + "\n\n".join(problems)
+        )
 
 
 # Generated-table cache shared across Engine instances. Every

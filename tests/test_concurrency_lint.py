@@ -1,0 +1,566 @@
+"""Concurrency static analysis + runtime lockdep.
+
+Two halves mirror the lint module itself: malformed-corpus tests prove
+each static rule fires on a seeded bad pattern (and stays quiet on the
+corrected version), and lockdep unit tests exercise the runtime
+validator — cycle detection, RLock reentrancy, loop-thread waits, and
+the zero-overhead-when-off identity guarantee.
+"""
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from trino_tpu.lint import (
+    compare_to_baseline,
+    lint_all,
+    load_baseline,
+    lockdep,
+    main,
+)
+from trino_tpu.lint import concurrency
+
+
+def _lint_source(tmp_path, source: str, name: str = "seeded.py"):
+    mod = tmp_path / name
+    mod.write_text(textwrap.dedent(source))
+    return concurrency.lint_paths([mod])
+
+
+def _rules(violations):
+    return {v.rule for v in violations}
+
+
+# === whole-package gate =====================================================
+
+
+def test_repo_is_clean_against_baseline():
+    """CI gate, all families: new violations only."""
+    violations = lint_all(["trino_tpu"])
+    new, _stale = compare_to_baseline(violations, load_baseline())
+    assert not new, "new lint violations:\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_cli_only_and_stats(tmp_path, capsys):
+    assert main(["--only", "concurrency", "trino_tpu"]) == 0
+    capsys.readouterr()
+    assert main(["--stats", "--no-baseline", "trino_tpu"]) != 0
+    out = capsys.readouterr().out
+    assert "total:" in out
+
+
+# === LOCK001: lock-order inversion ==========================================
+
+
+def test_lock_order_inversion_fires(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def forward(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def backward(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """,
+    )
+    assert "LOCK001" in _rules(vs)
+
+
+def test_lock_order_inversion_via_call_graph(tmp_path):
+    """Holding A and calling a function that takes B counts as A->B."""
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def inner_b(self):
+                with self._b_lock:
+                    pass
+
+            def forward(self):
+                with self._a_lock:
+                    self.inner_b()
+
+            def backward(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """,
+    )
+    assert "LOCK001" in _rules(vs)
+
+
+def test_consistent_lock_order_is_clean(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+        """,
+    )
+    assert "LOCK001" not in _rules(vs)
+
+
+# === LOCK002: callback fired under a lock ===================================
+
+
+def test_callback_under_lock_fires(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._listeners = []
+
+            def fire(self, event):
+                with self._lock:
+                    for cb in self._listeners:
+                        cb(event)
+        """,
+    )
+    assert "LOCK002" in _rules(vs)
+
+
+def test_snapshot_then_fire_is_clean(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._listeners = []
+
+            def fire(self, event):
+                with self._lock:
+                    snapshot = list(self._listeners)
+                for cb in snapshot:
+                    cb(event)
+        """,
+    )
+    assert "LOCK002" not in _rules(vs)
+
+
+# === CONC001: blocking call under a lock ====================================
+
+
+def test_blocking_under_lock_fires(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)
+        """,
+    )
+    assert "CONC001" in _rules(vs)
+
+
+# === LOOP001: blocking call reachable from the event loop ==================
+
+
+def test_sleep_in_loop_callback_fires(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import time
+
+        class Handler:
+            def __init__(self, loop):
+                self.loop = loop
+
+            def kick(self):
+                self.loop.call_soon(self.on_tick)
+
+            def on_tick(self):
+                time.sleep(0.5)
+        """,
+    )
+    loop_vs = [v for v in vs if v.rule == "LOOP001"]
+    assert loop_vs, [v.render() for v in vs]
+    # the message carries the reachability chain, not just the site
+    assert "scheduled on loop" in loop_vs[0].message
+
+
+def test_thread_handoff_breaks_loop_reachability(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        class Handler:
+            def __init__(self, loop):
+                self.loop = loop
+
+            def kick(self):
+                self.loop.call_soon(self.on_tick)
+
+            def on_tick(self):
+                threading.Thread(target=self.blocking_work, daemon=True).start()
+
+            def blocking_work(self):
+                time.sleep(0.5)
+        """,
+    )
+    assert "LOOP001" not in _rules(vs)
+
+
+# === THRD001: daemon thread without shutdown path ===========================
+
+
+def test_sentinelless_daemon_thread_fires(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        class S:
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                while True:
+                    time.sleep(1)
+        """,
+    )
+    assert "THRD001" in _rules(vs)
+
+
+def test_daemon_thread_with_stop_event_is_clean(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def start(self):
+                t = threading.Thread(target=self._run, daemon=True)
+                t.start()
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self._stop.wait(1)
+
+            def stop(self):
+                self._stop.set()
+        """,
+    )
+    assert "THRD001" not in _rules(vs)
+
+
+# === inline suppression =====================================================
+
+
+def test_inline_ignore_suppresses(tmp_path):
+    vs = _lint_source(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow(self):
+                with self._lock:
+                    time.sleep(1.0)  # lint: ignore[CONC001]
+        """,
+    )
+    assert "CONC001" not in _rules(vs)
+
+
+# === lockdep: runtime validator =============================================
+
+
+@pytest.fixture
+def armed_lockdep():
+    was_installed = lockdep.installed()
+    if not was_installed:
+        lockdep.install()
+    lockdep.reset()
+    yield lockdep
+    lockdep.reset()
+    if not was_installed:
+        lockdep.uninstall()
+
+
+def test_lockdep_off_is_zero_overhead():
+    if lockdep.installed():
+        pytest.skip("lockdep armed for this session (TT_LOCKDEP=1)")
+    assert threading.Lock is lockdep._REAL_LOCK
+    assert threading.RLock is lockdep._REAL_RLOCK
+
+
+def test_lockdep_detects_inversion(armed_lockdep):
+    a = threading.Lock()
+    b = threading.Lock()
+    with a:
+        with b:
+            pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=backward)
+    t.start()
+    t.join()
+    rep = armed_lockdep.report()
+    cycles = [r for r in rep if "lock-order cycle" in r]
+    assert cycles, rep
+    # report names both edges with acquisition context
+    assert "edge" in cycles[0] and "inner acquired at" in cycles[0]
+    armed_lockdep.reset()
+    assert armed_lockdep.report() == []
+
+
+def test_lockdep_consistent_order_is_clean(armed_lockdep):
+    a = threading.Lock()
+    b = threading.Lock()
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert armed_lockdep.report() == []
+
+
+def test_lockdep_rlock_reentrancy_exempt(armed_lockdep):
+    r = threading.RLock()
+    with r:
+        with r:
+            with r:
+                pass
+    assert armed_lockdep.report() == []
+
+
+def test_lockdep_loop_thread_wait_detected(armed_lockdep):
+    lock = threading.Lock()
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(5)
+    armed_lockdep.register_loop_thread(threading.get_ident())
+    try:
+        timer = threading.Timer(0.2, release.set)
+        timer.start()
+        with lock:  # blocks past the grace window -> recorded
+            pass
+        timer.join()
+    finally:
+        armed_lockdep.unregister_loop_thread(threading.get_ident())
+    t.join()
+    rep = armed_lockdep.report()
+    waits = [r for r in rep if "event-loop thread blocked" in r]
+    assert waits, rep
+    assert "loop thread waiting at" in waits[0]
+
+
+def test_lockdep_non_loop_wait_not_flagged(armed_lockdep):
+    lock = threading.Lock()
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert held.wait(5)
+    timer = threading.Timer(0.2, release.set)
+    timer.start()
+    with lock:
+        pass
+    timer.join()
+    t.join()
+    assert armed_lockdep.report() == []
+
+
+def test_lockdep_condition_and_queue_interop(armed_lockdep):
+    import queue
+
+    q = queue.Queue()
+    q.put(1)
+    assert q.get() == 1
+    cond = threading.Condition()
+    with cond:
+        cond.notify_all()
+    rcond = threading.Condition(threading.RLock())
+    with rcond:
+        rcond.notify_all()
+    evt = threading.Event()
+    evt.set()
+    assert evt.wait(1)
+
+
+# === regression tests for findings fixed in this PR =========================
+
+
+def test_spool_finish_does_not_hold_lock_while_blocking(monkeypatch):
+    """SpoolWriter.finish used to hold _finish_lock across the drain wait
+    and the manifest PUT; now the lock only claims the attempt."""
+    from trino_tpu.exchange.spool import SpoolWriter
+
+    w = SpoolWriter.__new__(SpoolWriter)
+    w._finish_lock = threading.Lock()
+    w._finishing = False
+    w._finish_wave = threading.Event()
+    w.completed = False
+    w._aborted = False
+    w.failed = False
+    w.uri = "http://spool.invalid/q"
+    w.query_id = "q"
+    w._counts = {}
+
+    import queue as _q
+
+    w._q = _q.Queue()
+    w._drained = threading.Event()
+
+    in_request = threading.Event()
+    unblock = threading.Event()
+
+    def slow_request(*a, **k):
+        in_request.set()
+        unblock.wait(5)
+        return {"complete": True}
+
+    w._request = slow_request
+    w._drained.set()
+
+    t = threading.Thread(target=lambda: w.finish(timeout=5))
+    t.start()
+    assert in_request.wait(5)
+    # mid-finish: the claim lock must be free (network I/O is outside it)
+    assert w._finish_lock.acquire(blocking=False)
+    w._finish_lock.release()
+    unblock.set()
+    t.join(5)
+    assert not t.is_alive()
+    assert w.completed
+
+
+def test_announce_thread_stops_promptly():
+    """TrinoTpuServer._announce_loop waits on a stop event, not a bare
+    sleep, so stop() no longer leaves it parked for a full period."""
+    from trino_tpu.server.http import TrinoTpuServer
+
+    srv = TrinoTpuServer.__new__(TrinoTpuServer)
+    srv.state = "ACTIVE"
+    srv._announce_stop = threading.Event()
+    srv.discovery_uri = ""  # no coordinator: loop idles on the 2s wait
+
+    t = threading.Thread(target=srv._announce_loop, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    start = time.monotonic()
+    srv.state = "STOPPED"
+    srv._announce_stop.set()
+    t.join(2)
+    assert not t.is_alive(), "announce loop did not exit on stop event"
+    # the stop event interrupts the wait; a bare sleep would take ~2s
+    assert time.monotonic() - start < 1.0
+
+
+def test_dispatch_pool_submit_is_nonblocking():
+    """_DispatchPool.submit uses put_nowait: safe from the loop thread."""
+    import inspect
+
+    from trino_tpu.server.querymanager import _DispatchPool
+
+    src = inspect.getsource(_DispatchPool.submit)
+    assert "put_nowait" in src
+
+
+# === loop-thread assertion helpers ==========================================
+
+
+def test_assert_not_loop_thread_raises_under_pytest():
+    from trino_tpu.server import eventloop
+
+    ident = threading.get_ident()
+    eventloop._LOOP_THREAD_IDS.add(ident)
+    try:
+        with pytest.raises(RuntimeError, match="loop-thread discipline"):
+            eventloop.assert_not_loop_thread("test blocking call")
+    finally:
+        eventloop._LOOP_THREAD_IDS.discard(ident)
+    # off the loop thread it is a no-op returning True
+    assert eventloop.assert_not_loop_thread("test blocking call")
+
+
+def test_loop_thread_violation_counts_when_not_strict(monkeypatch):
+    from trino_tpu.server import eventloop
+    from trino_tpu.obs.metrics import get_registry
+
+    monkeypatch.setenv("TT_LOOP_ASSERTS", "count")
+    ident = threading.get_ident()
+    eventloop._LOOP_THREAD_IDS.add(ident)
+    try:
+        counter = get_registry().counter("trino_tpu_loop_thread_violations_total")
+        before = counter.value
+        assert not eventloop.assert_not_loop_thread("prod-mode check")
+        assert counter.value == before + 1
+    finally:
+        eventloop._LOOP_THREAD_IDS.discard(ident)

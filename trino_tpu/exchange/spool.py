@@ -466,6 +466,8 @@ class SpoolWriter:
         self._drained = threading.Event()
         self._aborted = False
         self._finish_lock = threading.Lock()
+        self._finishing = False  # a finish() attempt is in flight
+        self._finish_wave = threading.Event()  # set when that attempt ends
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._thread.start()
 
@@ -529,32 +531,58 @@ class SpoolWriter:
 
     def finish(self, timeout: float = 60.0) -> bool:
         """Drain and publish the manifest. Idempotent; returns whether
-        the coordinator verified the spool complete."""
-        with self._finish_lock:
-            if self.completed:
-                return True
-            if self._aborted or self.failed:
+        the coordinator verified the spool complete.
+
+        The lock only claims the attempt — the drain wait and the manifest
+        PUT run outside it, so concurrent finishers (task completion vs.
+        worker drain) park on the attempt's wave event instead of
+        serializing behind a mutex held across network I/O. A failed
+        attempt clears ``_finishing`` so the next caller retries."""
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._finish_lock:
+                if self.completed:
+                    return True
+                if self._aborted or self.failed:
+                    return False
+                if not self._finishing:
+                    self._finishing = True
+                    self._finish_wave.clear()
+                    break
+                wave = self._finish_wave
+            # another caller owns the in-flight attempt: wait it out, then
+            # re-check (it may have failed, in which case we retry)
+            if not wave.wait(max(0.0, deadline - time.monotonic())):
                 return False
-            self._q.put(None)
-            if not self._drained.wait(timeout) or self.failed:
-                return False
-            try:
-                resp = self._request(
-                    "PUT",
-                    f"{self.uri}/complete",
-                    body=json.dumps(
-                        {
-                            "queryId": self.query_id,
-                            "partitions": {
-                                str(p): c for p, c in self._counts.items()
-                            },
-                        }
-                    ).encode(),
-                )
-            except Exception:  # noqa: BLE001
-                return False
-            self.completed = bool((resp or {}).get("complete"))
-            return self.completed
+        ok = False
+        try:
+            self._q.put_nowait(None)
+            if (
+                self._drained.wait(max(0.0, deadline - time.monotonic()))
+                and not self.failed
+            ):
+                try:
+                    resp = self._request(
+                        "PUT",
+                        f"{self.uri}/complete",
+                        body=json.dumps(
+                            {
+                                "queryId": self.query_id,
+                                "partitions": {
+                                    str(p): c for p, c in self._counts.items()
+                                },
+                            }
+                        ).encode(),
+                    )
+                    ok = bool((resp or {}).get("complete"))
+                except Exception:  # noqa: BLE001
+                    ok = False
+        finally:
+            with self._finish_lock:
+                self.completed = ok or self.completed
+                self._finishing = False
+            self._finish_wave.set()
+        return ok
 
     def abort(self) -> None:
         """Stop spooling and delete remote data — unless the manifest

@@ -140,7 +140,11 @@ class BatchCollector:
             # same discipline as the single-query path: the entry lock
             # serializes executors over the shared program store and
             # capacity objects. Blocking here is fine — followers are
-            # parked on their events, not on this lock.
+            # parked on their events, not on this lock, and unrelated
+            # programs use different entries. The concurrency lint's
+            # CONC001/LOCK002 hits on this block are baselined
+            # (lint/baseline.json notes): moving execution outside the
+            # lock would let a second leader re-collect the same window.
             with entry["lock"]:
                 if entry["plan"] is None:
                     entry["plan"] = group.plan

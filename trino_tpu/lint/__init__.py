@@ -1,17 +1,34 @@
-"""Static analysis for JAX jit-safety (``python -m trino_tpu.lint``).
+"""Static analysis for the repo (``python -m trino_tpu.lint``).
 
-See ``jit_safety.py`` for the rule catalogue and ``baseline.json`` for the
-suppression baseline: CI fails only on violations *new* relative to the
-baseline, so pre-existing debt is visible but non-blocking.
+Two rule families share one harness (baseline, inline suppressions,
+CLI):
+
+- ``jit_safety.py`` — JIT### rules: host/device sync and tracer misuse
+  inside jitted code.
+- ``concurrency.py`` — CONC/LOCK/LOOP/THRD rules: blocking calls and
+  callback fires under locks, lock-order inversions, blocking ops
+  reachable from the event loop, daemon threads without a shutdown
+  path.
+
+``lockdep.py`` is the runtime complement: an opt-in (``TT_LOCKDEP=1``)
+lock-order and loop-thread-wait validator armed by conftest for tier-1.
+
+See ``baseline.json`` for the suppression baseline: CI fails only on
+violations *new* relative to the baseline, so pre-existing debt is
+visible but non-blocking; every entry carries a justification under
+``notes``.
 """
 
+from trino_tpu.lint import concurrency, lockdep  # noqa: F401
+from trino_tpu.lint.cli import FAMILIES, lint_all, main  # noqa: F401
 from trino_tpu.lint.jit_safety import (  # noqa: F401
     DEFAULT_PATHS,
-    RULES,
     Violation,
     compare_to_baseline,
     lint_paths,
     load_baseline,
-    main,
     to_baseline,
 )
+from trino_tpu.lint.jit_safety import RULES as _JIT_RULES
+
+RULES = {**_JIT_RULES, **concurrency.RULES}

@@ -1,5 +1,5 @@
 import sys
 
-from trino_tpu.lint.jit_safety import main
+from trino_tpu.lint.cli import main
 
 sys.exit(main())

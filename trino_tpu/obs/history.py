@@ -183,6 +183,11 @@ class QueryHistoryStore:
         elapsed_ms, rows, overflow_retries, compile_halvings,
         padding_ratio, shuffle_rows, flops, peak_hbm_bytes, batch_size,
         capacities ({stable_site: {value, provenance}})."""
+        from trino_tpu.server.eventloop import assert_not_loop_thread
+
+        # record() flushes the JSON document to disk under _lock; callers
+        # are query-finalize paths on dispatch workers, never the reactor
+        assert_not_loop_thread("QueryHistoryStore.record")
         with self._lock:
             self._adopt_disk_locked()
             self._seq += 1

@@ -27,6 +27,7 @@ import collections
 import heapq
 import itertools
 import json
+import os
 import selectors
 import socket
 import threading
@@ -43,9 +44,57 @@ __all__ = [
     "Response",
     "TenantRateLimiter",
     "TokenBucket",
+    "assert_not_loop_thread",
+    "current_thread_in_loop",
     "json_response",
     "parse_max_wait",
 ]
+
+# --- loop-thread discipline helpers ----------------------------------------
+#
+# Idents of threads currently running an EventLoop (there can be several in
+# tests). Blocking code paths — dispatch-pool workers, ManagedQuery.run —
+# assert they are NOT on one of these; loop-only paths (send_response)
+# assert they ARE. Misuse raises under pytest / TT_LOOP_ASSERTS=raise and
+# only bumps the trino_tpu_loop_thread_violations_total counter in
+# production, so a discipline bug degrades observability, not the service.
+
+_LOOP_THREAD_IDS: set[int] = set()
+
+
+def current_thread_in_loop() -> bool:
+    """True when the calling thread is running any EventLoop."""
+    return threading.get_ident() in _LOOP_THREAD_IDS
+
+
+def _strict_thread_asserts() -> bool:
+    mode = os.environ.get("TT_LOOP_ASSERTS", "")
+    if mode == "raise":
+        return True
+    if mode == "count":
+        return False
+    return "PYTEST_CURRENT_TEST" in os.environ
+
+
+def _loop_thread_violation(what: str) -> None:
+    if _strict_thread_asserts():
+        raise RuntimeError(f"loop-thread discipline violation: {what}")
+    try:
+        from trino_tpu.obs.metrics import get_registry
+
+        get_registry().counter(
+            "trino_tpu_loop_thread_violations_total"
+        ).inc()
+    except Exception:  # noqa: BLE001 — observability must not break serving
+        pass
+
+
+def assert_not_loop_thread(what: str = "blocking call") -> bool:
+    """Guard for code that may block: must not run on any loop thread."""
+    if not current_thread_in_loop():
+        return True
+    _loop_thread_violation(f"{what} on an event-loop thread")
+    return False
 
 # Hard framing limits; requests beyond these are refused outright.
 MAX_HEADER_BYTES = 64 << 10
@@ -278,11 +327,41 @@ class EventLoop:
     def in_loop(self) -> bool:
         return threading.current_thread() is self._thread
 
+    def assert_loop_thread(self, what: str = "loop-only call") -> bool:
+        """Guard for loop-affine code (connection I/O, timer wheel)."""
+        if self.in_loop():
+            return True
+        _loop_thread_violation(f"{what} off the loop thread")
+        return False
+
+    def assert_not_loop_thread(self, what: str = "blocking call") -> bool:
+        """Guard for blocking code handed off from this loop."""
+        if not self.in_loop():
+            return True
+        _loop_thread_violation(f"{what} on the loop thread")
+        return False
+
     # -- run / stop -------------------------------------------------------
 
     def run(self) -> None:
         self._thread = threading.current_thread()
         self._running = True
+        ident = threading.get_ident()
+        _LOOP_THREAD_IDS.add(ident)
+        try:
+            from trino_tpu.lint import lockdep
+
+            lockdep.register_loop_thread(ident)
+        except Exception:  # noqa: BLE001 — lockdep is optional tooling
+            lockdep = None
+        try:
+            self._run()
+        finally:
+            _LOOP_THREAD_IDS.discard(ident)
+            if lockdep is not None:
+                lockdep.unregister_loop_thread(ident)
+
+    def _run(self) -> None:
         while self._running:
             timeout = self._next_timeout()
             try:
@@ -517,6 +596,7 @@ class HttpConnection:
 
     def send_response(self, responder: Responder, response: Response) -> None:
         """Loop-thread only (marshalled by Responder.respond)."""
+        self.loop.assert_loop_thread("HttpConnection.send_response")
         if self.closed:
             return
         keep = self._keep_alive and response.status != 408

@@ -171,6 +171,10 @@ class TrinoTpuServer:
             # passes this to tasks as payload["spool"]["uri"])
             self.engine.spool_base_uri = self.base_uri
         self._announce_thread: Optional[threading.Thread] = None
+        # shutdown sentinel for the announce thread: stop() sets it, so the
+        # thread exits immediately instead of finishing a sleep that can be
+        # a 10s backoff (and the state-flag check alone can't interrupt)
+        self._announce_stop = threading.Event()
         # live node info for system.runtime.nodes
         self.engine._runtime_nodes_fn = lambda: [
             ("coordinator", self.base_uri, VERSION, True, self.state)
@@ -230,7 +234,7 @@ class TrinoTpuServer:
 
         backoff = Backoff(initial_ms=500.0, max_ms=10_000.0, seed=0)
         failures = 0
-        while self.state == "ACTIVE":
+        while self.state == "ACTIVE" and not self._announce_stop.is_set():
             delay = 2.0
             if self.discovery_uri and not self.discovery_uri.startswith("@"):
                 try:
@@ -263,12 +267,14 @@ class TrinoTpuServer:
                 except Exception:  # noqa: BLE001 — coordinator may not be up yet
                     failures += 1
                     delay = backoff.delay(min(failures, 8))
-            time.sleep(delay)
+            if self._announce_stop.wait(delay):
+                return
 
     def stop(self) -> None:
         from trino_tpu.obs.trace import get_tracer
 
         self.state = "STOPPED"
+        self._announce_stop.set()
         self.httpd.close()
         self._front_pool.shutdown()
         self.query_manager.shutdown(wait=False)

@@ -485,7 +485,9 @@ class _DispatchPool:
         with self._lock:
             if self._shutdown:
                 raise RuntimeError("dispatch pool is shut down")
-            self._q.put((fn, args))
+            # put_nowait: the queue is unbounded, so this never blocks —
+            # and the loop thread submits here, so it must never be able to
+            self._q.put_nowait((fn, args))
             if self._idle == 0 and len(self._threads) < self._max:
                 t = threading.Thread(
                     target=self._worker, daemon=True,
@@ -495,6 +497,11 @@ class _DispatchPool:
                 t.start()
 
     def _worker(self) -> None:
+        # pool workers block on the queue; running one on an event-loop
+        # thread would wedge the reactor
+        from trino_tpu.server.eventloop import assert_not_loop_thread
+
+        assert_not_loop_thread("_DispatchPool worker")
         while True:
             with self._lock:
                 self._idle += 1

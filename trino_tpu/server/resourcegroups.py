@@ -257,6 +257,9 @@ class ResourceGroupManager:
         """Blocks until a slot is available. Raises when the queue is full
         or the wait times out. (Thread-parking path; ``submit()`` is the
         event-driven equivalent.)"""
+        from trino_tpu.server.eventloop import assert_not_loop_thread
+
+        assert_not_loop_thread("ResourceGroupManager.admit")
         group = self._resolve(user, source)
         now = time.monotonic()
         with self._lock:
