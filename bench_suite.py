@@ -286,6 +286,147 @@ def adaptive_history(n_rows: int = 1 << 16) -> dict:
     return out
 
 
+def bench_join(log2_rows=(16, 18, 20), probe_factor: int = 1) -> dict:
+    """Join engine v2 microbench: the sort (bitonic), dense (open
+    addressing) and matmul (identity binned) tiers over the same
+    pre-staged device keys, at 2^16..2^22 build rows.
+
+    Each tier runs the whole hash->build->probe->verify pipeline under
+    one jit; the published ``*_rows_per_sec_per_chip`` is probe rows
+    over median wall time on one device. ``overflow_fallbacks`` counts
+    build-table/output overflows observed while timing — the graceful
+    ladder means the number must be 0 (nothing ever drops to the
+    interpreter)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trino_tpu.ops import dense_join as DJ
+    from trino_tpu.ops.join import build_side, hash_keys, probe_join, verify_equal
+
+    out: dict = {"chips": 1, "probe_factor": probe_factor}
+    fallbacks = 0
+    for lg in log2_rows:
+        n = 1 << lg
+        npr = n * probe_factor
+        cap = 4 * n  # the executor's default table load factor
+        out_cap = 2 * npr
+        rng = np.random.default_rng(17)
+        bk = jnp.asarray(rng.permutation(n).astype(np.int64))
+        pk = jnp.asarray(rng.integers(0, 2 * n, npr).astype(np.int64))
+        ones_b = jnp.ones(n, jnp.bool_)
+        ones_p = jnp.ones(npr, jnp.bool_)
+
+        def sort_tier(pk, bk):
+            ph, pv = hash_keys([(pk, ones_p)])
+            bh, bv = hash_keys([(bk, ones_b)])
+            sk, si, cnt = build_side(bh, bv, ones_b)
+            pp, bp, osel, total, ovf = probe_join(
+                sk, si, cnt, ph, pv, ones_p, out_cap
+            )
+            osel = verify_equal([(pk, ones_p)], [(bk, ones_b)], pp, bp, osel)
+            return jnp.sum(osel), ovf
+
+        def dense_tier(pk, bk):
+            ph, pv = hash_keys([(pk, ones_p)])
+            bh, bv = hash_keys([(bk, ones_b)])
+            table, tovf = DJ.build_table(
+                DJ.slot_base_hash(bh, cap), bv, ones_b, cap
+            )
+            pp, bp, osel, total, ovf = DJ.probe_table(
+                table, bh, DJ.slot_base_hash(ph, cap), ph, pv, ones_p,
+                out_cap,
+            )
+            osel = verify_equal([(pk, ones_p)], [(bk, ones_b)], pp, bp, osel)
+            return jnp.sum(osel), ovf | tovf
+
+        def matmul_tier(pk, bk):
+            # identity binning: build keys ARE a dense domain here, the
+            # shape the executor's history-seeded cost gate promotes
+            kmin = jnp.min(bk)
+            ph, pv = hash_keys([(pk, ones_p)])
+            bh, bv = hash_keys([(bk, ones_b)])
+            table, tovf = DJ.build_table(
+                DJ.slot_base_binned(bk, kmin, cap), bv, ones_b, cap
+            )
+            pp, bp, osel, total, ovf = DJ.probe_table(
+                table, bh, DJ.slot_base_binned(pk, kmin, cap), ph, pv,
+                ones_p, out_cap,
+            )
+            osel = verify_equal([(pk, ones_p)], [(bk, ones_b)], pp, bp, osel)
+            return jnp.sum(osel), ovf | tovf
+
+        entry: dict = {"build_rows": n, "probe_rows": npr}
+        totals = {}
+        for name, fn in (
+            ("sort", sort_tier), ("dense", dense_tier),
+            ("matmul", matmul_tier),
+        ):
+            jitted = jax.jit(fn)
+            total, ovf = jitted(pk, bk)  # warm: compile + stage
+            totals[name] = int(np.asarray(total))
+            fallbacks += int(bool(np.asarray(ovf)))
+            times = []
+            for _ in range(3):
+                t0 = time.time()
+                total, ovf = jitted(pk, bk)
+                _ = int(np.asarray(total))  # forces completion
+                times.append(time.time() - t0)
+                fallbacks += int(bool(np.asarray(ovf)))
+            times.sort()
+            dt = times[len(times) // 2]
+            entry[f"{name}_rows_per_sec_per_chip"] = round(npr / dt)
+        assert len(set(totals.values())) == 1, totals  # tiers agree
+        entry["join_rows"] = totals["sort"]
+        entry["dense_over_sort"] = round(
+            entry["dense_rows_per_sec_per_chip"]
+            / max(1, entry["sort_rows_per_sec_per_chip"]), 3,
+        )
+        out[f"2^{lg}"] = entry
+    out["overflow_fallbacks"] = fallbacks  # graceful ladder: must be 0
+    return out
+
+
+def bench_star_join() -> dict:
+    """TPC-DS star-shape fragment economics: the same 3-table star query
+    with the dense tier on (broadcast dimension builds fused into ONE
+    multiway program) vs off (pairwise, dims dispatched separately).
+    Reports fused-fragment and dispatch-round-trip counts plus row
+    identity between the two plans."""
+    from trino_tpu.testing import DistributedQueryRunner
+
+    sql = """
+        select i.i_category, d.d_year, sum(ss.ss_ext_sales_price) as s
+        from tpcds.tiny.store_sales ss
+        join tpcds.tiny.item i on ss.ss_item_sk = i.i_item_sk
+        join tpcds.tiny.date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+        group by i.i_category, d.d_year
+        order by i.i_category, d.d_year
+    """
+    out: dict = {}
+    rows = {}
+    for label, dense in (("multiway", True), ("pairwise", False)):
+        runner = DistributedQueryRunner()
+        runner.session.set("dense_join", dense)
+        res = runner.engine.execute_statement(sql, runner.session)
+        ex = res.exchange_stats or {}
+        out[f"{label}_fused_fragments"] = ex.get("fusedFragments", 0)
+        out[f"{label}_dispatch_round_trips"] = ex.get(
+            "dispatchRoundTrips", 0
+        )
+        if dense:
+            out["join_strategies"] = sorted(
+                set((ex.get("joinStrategy") or {}).values())
+            )
+            out["multiway_s"] = round(_median_time(runner, sql), 3)
+        rows[label] = res.rows
+    out["identical"] = rows["multiway"] == rows["pairwise"]
+    out["fragment_delta"] = (
+        out["multiway_fused_fragments"] - out["pairwise_fused_fragments"]
+    )
+    return out
+
+
 def _percentile(samples_ms: list, p: float) -> float:
     xs = sorted(samples_ms)
     if not xs:
@@ -667,6 +808,8 @@ def run_suite() -> dict:
         "bench_open_loop(clients=200, qps=400.0, duration_s=4.0)", 120
     )
     suite["adaptive_history"] = _subprocess_entry("adaptive_history()", 420)
+    suite["join"] = _subprocess_entry("bench_join()", 600)
+    suite["star_join"] = _subprocess_entry("bench_star_join()", 420)
     suite["suite_wall_s"] = round(time.time() - t0, 1)
     return suite
 

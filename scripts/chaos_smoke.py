@@ -35,19 +35,34 @@ Boots a 2-worker cluster and runs three scenarios:
    fusedFragments == 0 (the query silently not fusing would void the
    scenario); recovered/spooled/fused counters land in the summary.
 
-7. ``adaptive-warmup`` (in-process, no cluster): a Zipf-skewed
-   partitioned join with skew handling OFF overflows its capacity
-   estimate cold, recording observed truth into a persistent
-   query-history store; a FRESH engine sharing the same ``history_dir``
-   then repeats the query. FAIL unless the warm run shows
-   ``overflow_retries == 0`` AND ``compile_halvings == 0`` AND at least
-   one capacity site with provenance ``history`` AND bit-identical rows.
+7. ``star-join`` (its own 3-worker cluster): a TPC-DS star query whose
+   broadcast dimension builds fuse INTO the fact-probe program (the
+   dense join tier's multiway fusion) runs once clean, then with the
+   worker that executed the fused unit's task SIGKILLed right after the
+   task finishes. FAIL on row drift, on a query-level retry
+   (queryAttempts > 1), on the query not fusing, or on the dense
+   strategy not being the one that ran (exchangeStats.joinStrategy) —
+   recovery must engage at unit granularity, same ladder as
+   fused-node-death but across a multiway join program.
+
+8. ``adaptive-warmup`` (in-process, no cluster): a Zipf-skewed
+   partitioned join with skew handling OFF runs cold, recording
+   observed truth (capacities AND the dense-join key domain) into a
+   persistent query-history store; a FRESH engine sharing the same
+   ``history_dir`` then repeats the query. FAIL unless the warm run
+   shows ``overflow_retries == 0`` AND ``compile_halvings == 0`` AND
+   bit-identical rows AND the history-driven join promotion: the cold
+   run picks the dense tier, the warm run reads the history-seeded key
+   domain through the cost gate and promotes the same site to the
+   matmul tier (``joinStrategy`` dense -> matmul). When the cold run
+   actually grew a site, the warm run must additionally show at least
+   one capacity with provenance ``history``.
 
 Quick manual repro for the fault-tolerance stack (CI runs the same
 scenarios as ``tests/test_fault_tolerance.py -m faults`` /
 ``tests/test_speculation.py`` / ``tests/test_spool.py``).
 
-8. ``overload`` (own entry point: ``chaos_smoke.py overload``): an
+9. ``overload`` (own entry point: ``chaos_smoke.py overload``): an
    in-process coordinator with deliberately tiny admission capacity is
    offered 4× that capacity from closed-loop retrying clients while a
    burst tenant trips the token bucket. FAIL on row drift of any
@@ -109,6 +124,17 @@ FUSED_PROPS = {
     "fusion_max_fragments": 2,
 }
 
+# star-join: fact probes against two broadcast dimension builds — with
+# the dense join tier on (default) the dims are absorbed into ONE
+# multiway fused program (planner/fragmenter.py broadcast_links), the
+# shape the worker-SIGKILL scenario must recover at unit granularity
+Q_STAR = """select i.i_category, d.d_year, sum(ss.ss_ext_sales_price) as s
+       from tpcds.tiny.store_sales ss
+       join tpcds.tiny.item i on ss.ss_item_sk = i.i_item_sk
+       join tpcds.tiny.date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+       group by i.i_category, d.d_year
+       order by i.i_category, d.d_year"""
+
 
 def _fused_unit_site(sql, **props):
     """Fault site of the first fused unit's task ('{unit_root}.0'),
@@ -116,6 +142,7 @@ def _fused_unit_site(sql, **props):
     from trino_tpu.exec.fragments import fragment_fusable
     from trino_tpu.planner.fragmenter import (
         FusedFragment,
+        filtered_broadcast_fids,
         fragment_plan,
         fuse_groups,
         partitioned_join_pairs,
@@ -139,6 +166,12 @@ def _fused_unit_site(sql, **props):
                 else ()
             ),
             include_root=False,
+            broadcast_links=bool(r.session.get("dense_join")),
+            blocked=(
+                frozenset(filtered_broadcast_fids(sub))
+                if bool(r.session.get("enable_dynamic_filtering"))
+                else frozenset()
+            ),
         )
         if isinstance(u, FusedFragment)
     ]
@@ -212,17 +245,20 @@ def _adaptive_warmup(seed: int) -> dict:
         warm = _run(warm_runner)
 
     wex = warm.exchange_stats or {}
+    cex = cold.exchange_stats or {}
     provs = sorted({
         str(site.get("provenance", "")).split("+")[0]
         for site in (wex.get("capacities") or {}).values()
     })
     return {
-        "cold_retries": (cold.exchange_stats or {}).get(
-            "overflow_retries", 0),
-        "cold_halvings": (cold.exchange_stats or {}).get(
-            "compile_halvings", 0),
+        "cold_retries": cex.get("overflow_retries", 0),
+        "cold_halvings": cex.get("compile_halvings", 0),
+        "cold_strategies": sorted(
+            set((cex.get("joinStrategy") or {}).values())),
         "warm_retries": wex.get("overflow_retries", 0),
         "warm_halvings": wex.get("compile_halvings", 0),
+        "warm_strategies": sorted(
+            set((wex.get("joinStrategy") or {}).values())),
         "warm_provenance": provs,
         "history_seeds": wex.get("history_seeds", 0),
         "drift": warm.rows != cold.rows,
@@ -600,6 +636,51 @@ def main() -> int:
             "query_attempts": fused_info.get("queryAttempts", 1),
             "drift": fused_death != fused_clean,
         }
+        # star-join gets its OWN 3-worker cluster too: the SIGKILLed
+        # worker stays dead, and the multiway ladder deserves a full
+        # quorum rather than the fused-node-death cluster's survivors
+        star_site = _fused_unit_site(Q_STAR)  # dense_join defaults on
+        star_death_props = {
+            "retry_policy": "TASK",
+            "exchange_spooling": True,
+            "task_retry_attempts": 8,
+            "retry_initial_delay_ms": 20,
+            "retry_max_delay_ms": 200,
+            "fault_worker_exit_site": star_site or "2.0",
+            "fault_worker_exit_delay_ms": 300,
+            "fault_task_stall_ms": 1000,
+        }
+        with MultiProcessQueryRunner(n_workers=3) as runner4:
+            star_clean, _ = runner4.execute(Q_STAR)
+            star_death, _ = runner4.execute(
+                Q_STAR, session_properties=star_death_props
+            )
+            req = urllib.request.Request(
+                f"{runner4.coordinator_uri}/v1/query", headers=auth.headers()
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                star_queries = json.loads(r.read().decode())
+        star_info = next(
+            (
+                q
+                for q in reversed(star_queries)
+                if q.get("retryPolicy") == "TASK"
+            ),
+            {},
+        )
+        sex = star_info.get("exchangeStats") or {}
+        summary["star_join"] = {
+            "unit_site": star_site,
+            "fused_fragments": sex.get("fusedFragments", 0),
+            "join_strategies": sorted(
+                set((sex.get("joinStrategy") or {}).values())
+            ),
+            "recovered_tasks": star_info.get("recoveredTasks", 0),
+            "recovered_levels": star_info.get("recoveredTaskLevels", {}),
+            "spooled_bytes": star_info.get("spooledBytes", 0),
+            "query_attempts": star_info.get("queryAttempts", 1),
+            "drift": star_death != star_clean,
+        }
         # adaptive-warmup runs in-process (fresh engines + a shared
         # persistent history store), after the clusters are down
         summary["adaptive_warmup"] = _adaptive_warmup(seed)
@@ -716,6 +797,34 @@ def main() -> int:
         if fd["recovered_tasks"] == 0:
             print("WARN: fused-node-death recovered nothing — the unit"
                   " death raced the consumer pull")
+        sj = summary["star_join"]
+        if sj["drift"]:
+            print("FAIL: star-join result differs from fault-free")
+            summary["ok"] = False
+            return 1
+        if sj["query_attempts"] > 1:
+            print(
+                "FAIL: star-join escalated to a query-level retry"
+                f" (queryAttempts={sj['query_attempts']})"
+            )
+            summary["ok"] = False
+            return 1
+        if sj["fused_fragments"] == 0:
+            print("FAIL: star-join query never fused — the multiway"
+                  " broadcast absorption silently did not happen")
+            summary["ok"] = False
+            return 1
+        if "dense" not in sj["join_strategies"]:
+            print(
+                "FAIL: star-join ran without the dense tier"
+                f" (joinStrategy={sj['join_strategies']}) — the scenario"
+                " exercised the sort path instead"
+            )
+            summary["ok"] = False
+            return 1
+        if sj["recovered_tasks"] == 0:
+            print("WARN: star-join recovered nothing — the unit death"
+                  " raced the consumer pull")
         aw = summary["adaptive_warmup"]
         if aw["drift"]:
             print("FAIL: adaptive-warmup warm result differs from cold")
@@ -730,16 +839,29 @@ def main() -> int:
             )
             summary["ok"] = False
             return 1
-        if "history" not in aw["warm_provenance"]:
+        learned = aw["cold_retries"] > 0 or aw["cold_halvings"] > 0
+        if learned and "history" not in aw["warm_provenance"]:
             print(
-                "FAIL: adaptive-warmup warm run has no history-seeded"
-                f" capacity site (provenance={aw['warm_provenance']})"
+                "FAIL: adaptive-warmup cold run grew a capacity but the"
+                " warm run has no history-seeded site"
+                f" (provenance={aw['warm_provenance']})"
+            )
+            summary["ok"] = False
+            return 1
+        if aw["warm_strategies"] != ["matmul"]:
+            print(
+                "FAIL: adaptive-warmup warm run did not take the"
+                " history-driven matmul promotion (cold"
+                f" {aw['cold_strategies']} -> warm {aw['warm_strategies']})"
+                " — the recorded dense-join domain never reached the cost"
+                " gate"
             )
             summary["ok"] = False
             return 1
         if aw["cold_retries"] == 0:
             print("WARN: adaptive-warmup cold run never overflowed — the"
-                  " warm zero-retry check proves nothing at this size")
+                  " warm zero-retry check only proves the strategy loop"
+                  " at this size")
         if recovered == 0:
             print("WARN: no recovered tasks — the worker-exit fault"
                   " never bit a consumer")
@@ -750,7 +872,8 @@ def main() -> int:
         print(
             "OK: bit-identical under 30% task-crash injection"
             " (incl. skewed join, 10x slow worker, concurrent batched"
-            " clients, node death, fused node death, adaptive warmup)"
+            " clients, node death, fused node death, multiway star join,"
+            " adaptive warmup)"
         )
         summary["ok"] = True
         return 0

@@ -70,6 +70,7 @@ import pytest  # noqa: E402
 # bucket. Files absent from the table get a small default weight.
 _SHARD_WEIGHTS = {
     "test_tpcds_oracle.py": 120,
+    "test_dense_join.py": 150,
     "test_sqlite_oracle.py": 100,
     "test_tpcds_suite.py": 90,
     "test_tpch_suite.py": 90,

@@ -112,8 +112,11 @@ class TestEligibilityMatrix:
 
     def test_spill_threshold_feeds_the_blocked_set(self):
         """The exec layer's estimate-based gate: scans bigger than the
-        spill threshold keep their fragments out of fusion (the spill
-        fallback needs the per-fragment interpreter path)."""
+        spill threshold keep their fragments out of fusion ONLY when the
+        dense join tier's graceful overflow is unavailable (then the
+        spill fallback needs the per-fragment interpreter path). With
+        dense_join on — the default — the spill bar is gone: overflow
+        re-hashes at doubled capacity inside the retry ladder."""
         from trino_tpu.exec.fragments import FragmentedExecutor
 
         r = LocalQueryRunner()
@@ -126,6 +129,11 @@ class TestEligibilityMatrix:
 
         r.session.set("spill_enabled", True)
         r.session.set("spill_threshold_rows", 1)
+        # graceful overflow available (dense_join defaults on): the
+        # spill threshold no longer bars anything from fusion
+        assert ex._fusion_blocked(sub) == set()
+
+        r.session.set("dense_join", False)
         blocked = ex._fusion_blocked(sub)
         scan_fids = {
             f.id
@@ -133,6 +141,10 @@ class TestEligibilityMatrix:
             if any(isinstance(n, P.TableScan) for n in P.walk_plan(f.root))
         }
         assert scan_fids <= blocked
+        # pinning the strategy to sort also disables graceful overflow
+        r.session.set("dense_join", True)
+        r.session.set("join_strategy", "sort")
+        assert scan_fids <= ex._fusion_blocked(sub)
 
     def test_skew_pair_absorbed_atomically(self):
         """A partitioned-join probe/build pair fuses both-or-neither: the
@@ -204,6 +216,42 @@ def test_chain_runs_in_at_most_two_round_trips(fused_runner, unfused_runner):
     assert ex_u.get("fusedFragments", 0) == 0, ex_u
     assert ex_u.get("dispatchRoundTrips", 0) > ex.get("dispatchRoundTrips", 0)
     assert res.rows == res_u.rows
+
+
+def test_spill_sized_join_fuses_under_graceful_overflow(single_node):
+    """Regression: before the dense join tier, a spill-eligible fragment
+    was barred from fusion outright (the interpreter owned the overflow
+    story).  With graceful overflow — dense_join on, the default — the
+    same spill-sized join runs fused in strictly fewer dispatch
+    round-trips, and the rows stay bit-identical to the barred path."""
+    spill = {"spill_enabled": True, "spill_threshold_rows": 1}
+
+    r = DistributedQueryRunner()
+    r.session.set("join_distribution_type", "PARTITIONED")
+    for k, v in spill.items():
+        r.session.set(k, v)
+    res = r.engine.execute_statement(JOIN_SQL, r.session)
+    ex = res.exchange_stats or {}
+
+    r_bar = DistributedQueryRunner()
+    r_bar.session.set("join_distribution_type", "PARTITIONED")
+    r_bar.session.set("dense_join", False)  # re-raise the spill bar
+    for k, v in spill.items():
+        r_bar.session.set(k, v)
+    res_bar = r_bar.engine.execute_statement(JOIN_SQL, r_bar.session)
+    ex_bar = res_bar.exchange_stats or {}
+
+    # with the bar re-raised nothing fuses — the whole join drops to the
+    # per-fragment interpreter path (no compiled dispatches at all)
+    assert ex_bar.get("fusedFragments", 0) == 0, ex_bar
+    # gracefully-overflowing run: fused, and in fewer dispatch
+    # round-trips than one-per-fragment
+    sub = fragment_plan(r.plan(JOIN_SQL))
+    assert ex.get("fusedFragments", 0) >= 3, ex
+    assert ex.get("dispatchRoundTrips", 99) <= 2 < len(sub.all_fragments())
+    assert res.rows == res_bar.rows
+    ref, _ = single_node.execute(JOIN_SQL)
+    assert res.rows == ref
 
 
 def test_repeat_query_hits_fused_program_cache(fused_runner):

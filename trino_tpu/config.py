@@ -52,6 +52,18 @@ class Session:
         ("task_concurrency", 1),
         ("batch_capacity", 1 << 16),  # padded kernel batch rows
         ("broadcast_join_threshold_rows", 1 << 22),
+        # --- dense join tier (ops/dense_join.py) --------------------------
+        # master switch for the open-addressing join engine: dense build
+        # tables with graceful overflow (densejoin@ capacity sites), the
+        # spill-cliff removal, and broadcast-link star-join fusion
+        ("dense_join", True),
+        # auto | sort | dense | matmul — auto picks dense for INNER/LEFT
+        # equi-joins and escalates single-key dense-domain builds to the
+        # binned (matmul) tier when PR-15 history proves the domain fits
+        ("join_strategy", "auto"),
+        # largest binned key domain the auto gate may promote to the
+        # matmul tier (explicit join_strategy=matmul is not bounded)
+        ("matmul_join_max_domain", 1 << 13),
         ("enable_dynamic_filtering", True),
         ("dynamic_filtering_max_build_rows", 1 << 20),
         ("query_max_memory_bytes", 8 << 30),

@@ -58,6 +58,7 @@ from trino_tpu.planner.fragmenter import (
     FusedFragment,
     PlanFragment,
     SubPlan,
+    filtered_broadcast_fids,
     fragment_plan,
     fuse_groups,
     partitioned_join_pairs,
@@ -275,6 +276,16 @@ class _Caps:
         self.provenance: dict[str, str] = {}
         self._seed_floor: dict[str, tuple[int, str]] = {}
         self.sites: dict[str, str] = {}
+        # join engine v2: per-site chosen strategy (surfaced as
+        # exchangeStats.joinStrategy), grow counts, and the demotion set.
+        # A ``densejoin`` site that keeps overflowing after capacity
+        # growth has a duplicate-key chain longer than the static probe
+        # window — doubling can never place it (same key ⇒ same slot
+        # sequence), so the site demotes to the sort strategy and the
+        # retrace drops its table entirely (graceful, still compiled).
+        self.join_strategies: dict[str, str] = {}
+        self.grow_counts: dict[str, int] = {}
+        self.demoted: set[str] = set()
 
     def get(self, name: str, default: int) -> int:
         if name not in self.vals:
@@ -309,6 +320,15 @@ class _Caps:
             self.vals[name] = value
             self.provenance[name] = provenance
 
+    def seeded(self, name: str):
+        """(value, provenance) of a site's installed value or pending
+        seed floor, or None — lets cost gates consult history before the
+        site's first ``get()`` (the floor only installs at get time)."""
+        if name in self.vals:
+            return self.vals[name], self.provenance.get(name, "default")
+        fl = self._seed_floor.get(name)
+        return (fl[0], fl[1]) if fl is not None else None
+
     def grow(self, name: str, factor: int = 2) -> None:
         # quantize growth to power-of-two buckets: stats-seeded odd-sized
         # caps would otherwise walk a per-query ladder of unique shapes,
@@ -318,6 +338,16 @@ class _Caps:
         prev = self.provenance.get(name, "default")
         if not prev.endswith("+grown"):
             self.provenance[name] = prev + "+grown"
+        # count under the restart-stable alias: every retrace mints a
+        # fresh ``densejoin{id(node)}`` runtime name, so an id-keyed
+        # counter would reset each attempt and the ladder would grow
+        # until CapacityRetryExceeded instead of ever demoting
+        stable = self.sites.get(name, name)
+        self.grow_counts[stable] = self.grow_counts.get(stable, 0) + 1
+        # second fruitless table growth ⇒ duplicate-chain pathology, not
+        # sizing: demote the site to the sort strategy (class docstring)
+        if name.startswith("densejoin") and self.grow_counts[stable] >= 2:
+            self.demoted.add(stable)
 
     def shrink_all(self, factor: int = 2, floor: int = 64) -> bool:
         """Inverse ladder for RESOURCE_EXHAUSTED compile/alloc failures:
@@ -340,8 +370,10 @@ class _Caps:
 
     def signature(self) -> tuple:
         """Hashable view of the current capacity values — the part of a
-        traced program's shape that the plan fingerprint cannot see."""
-        return tuple(sorted(self.vals.items()))
+        traced program's shape that the plan fingerprint cannot see.
+        Demotions ride along: a demoted join site traces a different
+        kernel at the same capacities, so it must key a new program."""
+        return tuple(sorted(self.vals.items())) + tuple(sorted(self.demoted))
 
 
 @dataclasses.dataclass
@@ -660,6 +692,7 @@ class FragmentedExecutor(DistributedExecutor):
             elif isinstance(node, P.Join):
                 sites[f"join{id(node)}"] = f"join@{frag.id}#{join_k}"
                 sites[f"semi{id(node)}"] = f"semi@{frag.id}#{join_k}"
+                sites[f"densejoin{id(node)}"] = f"densejoin@{frag.id}#{join_k}"
                 join_k += 1
         return sites
 
@@ -805,6 +838,7 @@ class FragmentedExecutor(DistributedExecutor):
             4,
         )
         caps: dict[str, dict] = {}
+        join_strategy: dict[str, str] = {}
         history_seeds = 0
         for key, val in self.programs.items():
             if (
@@ -825,10 +859,15 @@ class FragmentedExecutor(DistributedExecutor):
                     }
                     if prov.startswith("history"):
                         history_seeds += 1
+                for nm, strat in val.join_strategies.items():
+                    join_strategy[val.sites.get(nm, nm)] = strat
         st["capacities"] = caps
         # capacity sites whose value came from the observed-history store
         # (surfaced as queryStats.historySeeds on /v1/query)
         st["history_seeds"] = history_seeds
+        # join engine v2: chosen kernel per join site (sort / dense /
+        # matmul, including demotions observed during the retry ladder)
+        st["joinStrategy"] = join_strategy
         return st
 
     def ingest_stats_snapshot(self):
@@ -990,18 +1029,29 @@ class FragmentedExecutor(DistributedExecutor):
         units = self.programs.get("__fusedunits__")
         if units is None:
             if bool(self.session.get("pipeline_fusion")):
+                blocked = set(self._fusion_blocked(sub))
+                if bool(self.session.get("enable_dynamic_filtering")):
+                    # a selective broadcast build must stay a fragment
+                    # boundary: worker-side dynamic filtering prunes the
+                    # probe from the MATERIALIZED build, which a fused
+                    # interior member never produces
+                    blocked |= filtered_broadcast_fids(sub)
                 units = fuse_groups(
                     sub,
                     fusable=fragment_fusable,
                     max_fragments=max(
                         1, int(self.session.get("fusion_max_fragments"))
                     ),
-                    blocked=frozenset(self._fusion_blocked(sub)),
+                    blocked=frozenset(blocked),
                     skew_pairs=(
                         partitioned_join_pairs(sub)
                         if bool(self.session.get("skew_handling"))
                         else ()
                     ),
+                    # star joins: absorb broadcast dim builds so a fact
+                    # chain probes every dim in ONE program (the traced
+                    # broadcast link replicates in-trace)
+                    broadcast_links=bool(self.session.get("dense_join")),
                 )
             else:
                 units = []
@@ -1014,6 +1064,17 @@ class FragmentedExecutor(DistributedExecutor):
                 visit(sub)
             self.programs["__fusedunits__"] = units
         return units
+
+    def _graceful_overflow(self) -> bool:
+        """True when the dense join tier's graceful overflow is active:
+        a spill-sized join input can stay on the compiled path because a
+        build-table overflow re-hashes at doubled capacity inside the
+        retry ladder (``densejoin@…`` sites) instead of needing the
+        interpreter's partitioned spill — so the spill threshold stops
+        barring fragments from fusion and from the compiled path."""
+        return bool(self.session.get("dense_join")) and str(
+            self.session.get("join_strategy") or "auto"
+        ).lower() != "sort"
 
     def _fusion_blocked(self, sub: SubPlan) -> set:
         """Fragment ids that must stay on the per-fragment path: scans
@@ -1031,6 +1092,7 @@ class FragmentedExecutor(DistributedExecutor):
         spill_threshold = (
             int(self.session.get("spill_threshold_rows"))
             if self.session.get("spill_enabled")
+            and not self._graceful_overflow()
             else None
         )
         for frag in sub.all_fragments():
@@ -1110,6 +1172,7 @@ class FragmentedExecutor(DistributedExecutor):
         spill_threshold = (
             int(self.session.get("spill_threshold_rows"))
             if self.session.get("spill_enabled")
+            and not self._graceful_overflow()
             else None
         )
         for frag in members:
@@ -1236,6 +1299,7 @@ class FragmentedExecutor(DistributedExecutor):
         spill_threshold = (
             int(self.session.get("spill_threshold_rows"))
             if self.session.get("spill_enabled")
+            and not self._graceful_overflow()
             else None
         )
         for n in P.walk_plan(frag.root):
@@ -2034,6 +2098,7 @@ class FragmentedExecutor(DistributedExecutor):
             spill_threshold = (
                 int(self.session.get("spill_threshold_rows"))
                 if self.session.get("spill_enabled")
+                and not self._graceful_overflow()
                 else None
             )
             for n in P.walk_plan(frag.root):
@@ -2083,6 +2148,7 @@ class FragmentedExecutor(DistributedExecutor):
             spill_threshold = (
                 int(self.session.get("spill_threshold_rows"))
                 if self.session.get("spill_enabled")
+                and not self._graceful_overflow()
                 else None
             )
             for frag in unit.fragments:
@@ -2973,6 +3039,44 @@ class _FragmentTracer(DistributedExecutor):
 
     # --- joins -----------------------------------------------------------
 
+    def _join_strategy(self, node: P.Join, lkeys) -> str:
+        """Pick the join kernel for one Join node (ops/dense_join.py
+        module doc).  ``sort`` is the PR-0 bitonic path; ``dense`` the
+        open-addressing table; ``matmul`` the identity-binned table for
+        densely-binning single integer keys.  The auto→matmul promotion
+        is a cost gate seeded from PR-15 history: a history-seeded
+        ``densejoin`` capacity within the domain bound proves an earlier
+        run's observed table fit a dense domain — static stats cannot
+        prove that cold, and a sparse 64-bit key domain would walk the
+        whole retry ladder before demoting.  Sites the ladder demoted
+        (duplicate chains beyond the probe window) are pinned to sort."""
+        if not bool(self.session.get("dense_join")):
+            return "sort"
+        site = f"densejoin{id(node)}"
+        # demotions are recorded under the restart-stable alias (node
+        # ids churn across retraces); the alias map is registered by
+        # _seed_history before any node of this fragment traces
+        if self.caps.sites.get(site, site) in self.caps.demoted:
+            return "sort"
+        pref = str(self.session.get("join_strategy") or "auto").lower()
+        if pref == "sort":
+            return "sort"
+        matmul_ok = len(lkeys) == 1 and jnp.issubdtype(
+            lkeys[0][0].dtype, jnp.integer
+        )
+        if pref == "matmul":
+            return "matmul" if matmul_ok else "dense"
+        if pref != "dense" and matmul_ok:
+            seeded = self.caps.seeded(site)
+            bound = int(self.session.get("matmul_join_max_domain"))
+            if (
+                seeded is not None
+                and seeded[1].startswith("history")
+                and 0 < seeded[0] <= bound
+            ):
+                return "matmul"
+        return "dense"
+
     def _exec_join(self, node: P.Join) -> Result:
         if node.join_type in ("SEMI", "ANTI"):
             return self._exec_semi_join_traced(node)
@@ -3029,7 +3133,22 @@ class _FragmentTracer(DistributedExecutor):
             max(1024, 2 * probe_cap // max(self.n, 1))
         )
         cap = self.caps.get(f"join{id(node)}", default_cap)
-        out_cols, out_sel, ovf = _sharded_probe(
+        strategy = self._join_strategy(node, lkeys)
+        self.caps.join_strategies[f"densejoin{id(node)}"] = strategy
+        table_cap = None
+        if strategy != "sort":
+            # table slots per shard: 4x the per-shard build rows (load
+            # factor <= 0.25 — linear-probe clusters coalesce past the
+            # static window at 0.5); a replicated build holds ALL rows
+            build_cap = right.batch.capacity
+            per_shard_build = (
+                build_cap // max(self.n, 1) if build_sharded else build_cap
+            )
+            table_cap = self.caps.get(
+                f"densejoin{id(node)}",
+                bucket_capacity(max(1024, 4 * per_shard_build)),
+            )
+        res = _sharded_probe(
             self.mesh,
             probe_cols,
             probe_keys,
@@ -3043,7 +3162,16 @@ class _FragmentTracer(DistributedExecutor):
             node.join_type,
             len(lkeys),  # wide criteria expand into two lane pairs
             build_sharded=build_sharded,
+            strategy=strategy,
+            table_cap=table_cap,
         )
+        if strategy == "sort":
+            out_cols, out_sel, ovf = res
+        else:
+            out_cols, out_sel, ovf, table_ovf = res
+            # graceful overflow: the ladder doubles the table site and
+            # re-hashes — never the interpreter's partitioned spill
+            self.overflows.append((f"densejoin{id(node)}", table_ovf))
         self.overflows.append((f"join{id(node)}", ovf))
         cols: list[Column] = []
         layout: dict[str, int] = {}

@@ -1,0 +1,384 @@
+"""Dense equi-join tier: device-resident open-addressing build table.
+
+The sort tier (``ops/join.py``) pays an O(n log n) bitonic ``lax.sort``
+on every build side.  This tier replaces it with a static-shape
+open-addressing table — the TPU translation of Trino's ``PagesHash``
+linear-probe table — built and probed with fully vectorized rounds:
+
+1. Each build row proposes itself for the slots ``base+0 .. base+W-1``
+   (``W = PROBE_WINDOW``), one displacement per round.  A round is one
+   masked ``scatter-min`` of row ids: vacant slots keep the smallest
+   proposing row id, occupied slots are untouched (any occupant id is
+   smaller than the ``EMPTY`` sentinel).  Rows whose id appears in the
+   table after a round stop proposing.
+2. Probing gathers the same W slots per probe row and filters on build
+   hash equality — two static W-round passes produce exactly the
+   ``probe_join`` contract ``(probe_pos, build_pos, out_sel, total,
+   overflow)``, so ``verify_equal`` and every downstream consumer are
+   shared with the sort tier unchanged.
+3. Rows that fail to place within W rounds raise the table-overflow
+   flag; the executor's retry ladder re-hashes the whole build side at
+   doubled capacity (``densejoin@…`` capacity sites) instead of
+   dropping the fragment to the interpreter's partitioned spill — the
+   graceful-overflow contract.  Duplicate-key chains longer than W can
+   never place regardless of capacity (same key ⇒ same probe sequence);
+   the executor demotes such a site back to the sort strategy after a
+   few fruitless growths (see ``_Caps.demoted``).
+
+Ordering guarantee (bit-identity with the sort tier after row sorting):
+among build rows with equal hash, round r of the min-id scatter places
+the r-th smallest unplaced row id, so matches of one probe row emit in
+ascending build-row order — the same set the sorted tier emits, and the
+exactness pass (``verify_equal``) ANDs out hash collisions identically.
+
+The ``matmul`` tier is the join analog of ``dense_groupby``'s binning:
+when the build key domain bins densely, ``slot_base_binned`` addresses
+the table by ``key - kmin`` directly (identity binning == perfect
+hashing — zero probe collisions when the domain fits the capacity).
+The per-probe match-count contraction ``counts = onehot(bins) @ hist``
+is MXU-shaped; ``matmul_join_counts`` computes it as a real chunked
+``jnp.dot`` for the bench/join-project path, while the traced tier uses
+the gather lowering of the same contraction (no n×C one-hot resident).
+
+Pallas: ``build_table_device`` is the NOTES_r05 gridless single-core
+kernel (in-kernel ``fori_loop`` insertion over double-buffered
+HBM→VMEM chunks, table resident in VMEM).  Per the NOTES constraints
+its outputs must be consumed from a SEPARATE jit (in-graph consumers of
+pallas outputs read corrupted values on this stack), so traced fragment
+programs use the jnp rounds above and the pallas kernel serves the
+standalone/bench path; both produce the same join output (see module
+tests for the equivalence).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from trino_tpu.ops.join import MISSING
+
+# vacant-slot sentinel: int32 max, deliberately equal to join.MISSING —
+# row ids are always < capacity < 2^31 so no live entry collides with it
+EMPTY = jnp.iinfo(jnp.int32).max
+
+# static displacement window: max open-addressing chain per slot base.
+# Capacity growth thins hash clusters past it; duplicate-key chains
+# longer than this demote the site to the sort tier (see module doc).
+PROBE_WINDOW = 16
+
+
+def slot_base_hash(key_hash: jnp.ndarray, capacity: int) -> jnp.ndarray:
+    """Dense tier: table slot base from the mix64 key hash."""
+    return (
+        key_hash.astype(jnp.uint64) & jnp.uint64(capacity - 1)
+    ).astype(jnp.int32)
+
+
+def slot_base_binned(
+    key: jnp.ndarray, kmin: jnp.ndarray, capacity: int
+) -> jnp.ndarray:
+    """Matmul tier: identity binning ``key - kmin`` onto the table —
+    collision-free (perfect hashing) while the key domain fits the
+    capacity; wider domains wrap and degrade to ordinary probing."""
+    return (
+        (key.astype(jnp.int64) - kmin).astype(jnp.uint64)
+        & jnp.uint64(capacity - 1)
+    ).astype(jnp.int32)
+
+
+def build_table(
+    slot_base: jnp.ndarray,
+    valid: jnp.ndarray,
+    sel: jnp.ndarray,
+    capacity: int,
+    window: int = PROBE_WINDOW,
+):
+    """Insert build rows into an open-addressing table of row ids.
+
+    Returns ``(table int32[capacity], overflow bool)`` — ``overflow``
+    set when any live row failed to place within ``window`` rounds (the
+    executor re-hashes at doubled capacity).
+    """
+    n = slot_base.shape[0]
+    window = min(window, capacity)
+    use = valid & sel
+    ids = jnp.arange(n, dtype=jnp.int32)
+    mask = jnp.int32(capacity - 1)
+    table0 = jnp.full((capacity,), EMPTY, dtype=jnp.int32)
+
+    def round_body(d, st):
+        table, placed = st
+        prop = (slot_base + d) & mask
+        vacant = table[prop] == EMPTY
+        cand = jnp.where(~placed & vacant, ids, EMPTY)
+        table = table.at[prop].min(cand)
+        placed = placed | (table[prop] == ids)
+        return table, placed
+
+    table, placed = jax.lax.fori_loop(
+        0, window, round_body, (table0, ~use)
+    )
+    return table, jnp.any(~placed)
+
+
+def probe_table(
+    table: jnp.ndarray,
+    build_hash: jnp.ndarray,
+    probe_base: jnp.ndarray,
+    probe_hash: jnp.ndarray,
+    probe_valid: jnp.ndarray,
+    probe_sel: jnp.ndarray,
+    out_capacity: int,
+    join_type: str = "inner",
+    window: int = PROBE_WINDOW,
+):
+    """Expand probe × table matches into fixed-capacity gather indices.
+
+    Same contract as ``join.probe_join``: ``(probe_pos, build_pos,
+    out_sel, total, overflow)`` with ``build_pos == MISSING`` for outer
+    rows; the caller runs ``verify_equal`` for hash-collision exactness.
+    """
+    capacity = table.shape[0]
+    window = min(window, capacity)
+    use = probe_valid & probe_sel
+    if probe_hash.shape[0] == 0 or build_hash.shape[0] == 0:
+        # statically empty side: defer to the sort tier's guard logic,
+        # which already covers LEFT-over-empty-build row emission
+        from trino_tpu.ops.join import probe_join
+
+        empty_keys = jnp.zeros((0,), dtype=jnp.int64)
+        empty_idx = jnp.zeros((0,), dtype=jnp.int32)
+        return probe_join(
+            empty_keys, empty_idx, jnp.int32(0), probe_hash,
+            probe_valid, probe_sel, out_capacity, join_type,
+        )
+    nb = build_hash.shape[0]
+    mask = jnp.int32(capacity - 1)
+
+    def count_body(d, counts):
+        e = table[(probe_base + d) & mask]
+        eh = build_hash[jnp.clip(e, 0, nb - 1)]
+        m = (e != EMPTY) & (eh == probe_hash) & use
+        return counts + m.astype(jnp.int32)
+
+    counts = jax.lax.fori_loop(
+        0, window, count_body,
+        jnp.zeros(probe_hash.shape[0], dtype=jnp.int32),
+    )
+    if join_type == "left":
+        emit = jnp.where(probe_sel, jnp.maximum(counts, 1), 0)
+    elif join_type == "inner":
+        emit = counts
+    else:
+        raise NotImplementedError(join_type)
+    from trino_tpu.ops.aggregation import _prefix_sum
+
+    offsets = _prefix_sum(emit) - emit  # exclusive prefix
+    total = offsets[-1] + emit[-1]
+    overflow = total > out_capacity
+
+    t = jnp.arange(out_capacity, dtype=emit.dtype)
+    ends = offsets + emit
+    probe_pos = jnp.searchsorted(ends, t, side="right").astype(jnp.int32)
+    probe_pos = jnp.minimum(probe_pos, emit.shape[0] - 1)
+    j = t - offsets[probe_pos]
+
+    # second W-round pass: per output slot, the j-th matching window
+    # entry of its owning probe row ((out_capacity,)-sized arrays only —
+    # the (n, W) match matrix is never materialized)
+    o_base = probe_base[probe_pos]
+    o_hash = probe_hash[probe_pos]
+    o_use = use[probe_pos]
+
+    def pick_body(d, st):
+        bpos, r = st
+        e = table[(o_base + d) & mask]
+        eh = build_hash[jnp.clip(e, 0, nb - 1)]
+        m = (e != EMPTY) & (eh == o_hash) & o_use
+        bpos = jnp.where(m & (r == j), e, bpos)
+        return bpos, r + m.astype(j.dtype)
+
+    build_pos, _ = jax.lax.fori_loop(
+        0, window, pick_body,
+        (
+            jnp.full(out_capacity, MISSING, dtype=jnp.int32),
+            jnp.zeros(out_capacity, dtype=j.dtype),
+        ),
+    )
+    out_sel = t < total
+    return probe_pos, build_pos, out_sel, total, overflow
+
+
+def matmul_join_counts(
+    probe_bins: jnp.ndarray,
+    build_bins: jnp.ndarray,
+    probe_use: jnp.ndarray,
+    build_use: jnp.ndarray,
+    domain: int,
+    chunk: int = 2048,
+):
+    """Per-probe match counts as a real MXU contraction.
+
+    ``counts[i] = Σ_g 1[probe_bin_i = g] · hist_g`` — the join-as-matmul
+    count kernel for join-project shapes, computed as chunked
+    ``onehot @ hist`` dots exactly like ``dense_groupby``'s binning
+    matmul.  Equal to the gather lowering ``hist[probe_bins]`` (asserted
+    by the unit tests); the traced tier uses the gather form to avoid a
+    resident n×domain one-hot.
+    """
+    hist = (
+        jnp.zeros((domain,), jnp.float32)
+        .at[jnp.where(build_use, build_bins, domain - 1)]
+        .add(build_use.astype(jnp.float32))
+    )
+    n = probe_bins.shape[0]
+    pad = (-n) % chunk
+    bins_p = jnp.pad(probe_bins, (0, pad))
+    use_p = jnp.pad(probe_use, (0, pad))
+    nch = bins_p.shape[0] // chunk
+    g = jnp.arange(domain, dtype=jnp.int32)
+
+    def chunk_body(c, out):
+        b = jax.lax.dynamic_slice(bins_p, (c * chunk,), (chunk,))
+        u = jax.lax.dynamic_slice(use_p, (c * chunk,), (chunk,))
+        onehot = ((b[:, None] == g[None, :]) & u[:, None]).astype(
+            jnp.float32
+        )
+        cc = jnp.dot(onehot, hist, preferred_element_type=jnp.float32)
+        return jax.lax.dynamic_update_slice(out, cc, (c * chunk,))
+
+    out = jax.lax.fori_loop(
+        0, nch, chunk_body, jnp.zeros(bins_p.shape[0], jnp.float32)
+    )
+    return out[:n].astype(jnp.int32)
+
+
+# ── gridless pallas build kernel (bench/standalone path) ────────────────
+
+
+def _make_build_kernel(ncap: int, capacity: int, ch: int, window: int):
+    nchunks = ncap // ch
+
+    def kernel(
+        base_hbm, use_hbm, table_out, ovf_out, tbuf, obuf, bbuf, ubuf,
+        sems, outsem,
+    ):
+        tbuf[:] = jnp.full((capacity,), EMPTY, jnp.int32)
+
+        def dma(c, slot):
+            off = c * jnp.int32(ch)
+            dst = pl.ds(slot * jnp.int32(ch), ch)
+            return [
+                pltpu.make_async_copy(
+                    base_hbm.at[pl.ds(off, ch)], bbuf.at[dst],
+                    sems.at[slot, jnp.int32(0)],
+                ),
+                pltpu.make_async_copy(
+                    use_hbm.at[pl.ds(off, ch)], ubuf.at[dst],
+                    sems.at[slot, jnp.int32(1)],
+                ),
+            ]
+
+        for d in dma(jnp.int32(0), jnp.int32(0)):
+            d.start()
+
+        def chunk_body(c, ovf):
+            slot = jax.lax.rem(c, jnp.int32(2))
+
+            @pl.when(c + jnp.int32(1) < jnp.int32(nchunks))
+            def _():
+                for d in dma(c + jnp.int32(1), jnp.int32(1) - slot):
+                    d.start()
+
+            for d in dma(c, slot):
+                d.wait()
+            off = slot * jnp.int32(ch)
+
+            def row_body(rr, ovf):
+                b = bbuf[off + rr]
+                u = ubuf[off + rr]
+                rid = c * jnp.int32(ch) + rr
+
+                def win(d, found):
+                    idx = (b + d) & jnp.int32(capacity - 1)
+                    vac = tbuf[idx] == EMPTY
+                    return jnp.where(
+                        (found < jnp.int32(0)) & vac, idx, found
+                    )
+
+                found = jax.lax.fori_loop(
+                    jnp.int32(0), jnp.int32(window), win, jnp.int32(-1)
+                )
+                # -2: dead row, no placement wanted (and no overflow)
+                found = jnp.where(u > jnp.int32(0), found, jnp.int32(-2))
+
+                @pl.when(found >= jnp.int32(0))
+                def _():
+                    tbuf[found] = rid
+
+                return ovf + jnp.where(
+                    found == jnp.int32(-1), jnp.int32(1), jnp.int32(0)
+                )
+
+            return jax.lax.fori_loop(
+                jnp.int32(0), jnp.int32(ch), row_body, ovf
+            )
+
+        ovf = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(nchunks), chunk_body, jnp.int32(0)
+        )
+        obuf[:] = jnp.zeros((8,), jnp.int32)
+        obuf[0] = ovf
+        d1 = pltpu.make_async_copy(tbuf, table_out, outsem.at[jnp.int32(0)])
+        d2 = pltpu.make_async_copy(obuf, ovf_out, outsem.at[jnp.int32(1)])
+        d1.start()
+        d2.start()
+        d1.wait()
+        d2.wait()
+
+    return kernel
+
+
+def build_table_device(
+    slot_base: jnp.ndarray,
+    use: jnp.ndarray,
+    capacity: int,
+    window: int = PROBE_WINDOW,
+    interpret: bool = False,
+):
+    """Pallas build: sequential in-kernel insertion (first-vacant-slot
+    per row, rows in id order — the same per-key ascending placement the
+    jnp rounds produce, so probing either table emits identical joins).
+
+    Returns ``(table int32[capacity], unplaced int32)``; consume from a
+    SEPARATE jit (module doc).
+    """
+    n = slot_base.shape[0]
+    window = min(window, capacity)
+    ch = min(1024, max(256, n))
+    pad = (-n) % ch
+    base_p = jnp.pad(slot_base.astype(jnp.int32), (0, pad))
+    use_p = jnp.pad(use.astype(jnp.int32), (0, pad))
+    ncap = n + pad
+    kernel = _make_build_kernel(ncap, capacity, ch, window)
+    table, ovf = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct((capacity,), jnp.int32),
+            jax.ShapeDtypeStruct((8,), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((capacity,), jnp.int32),
+            pltpu.VMEM((8,), jnp.int32),
+            pltpu.VMEM((2 * ch,), jnp.int32),
+            pltpu.VMEM((2 * ch,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(base_p, use_p)
+    return table, ovf[0]

@@ -895,9 +895,19 @@ def _sharded_probe(
     nlk,
     build_sharded=False,
     profiler=None,
+    strategy="sort",
+    table_cap=None,
 ):
     """Per-shard join: build local table from (replicated or co-partitioned)
     build side, probe local rows, expand into fixed capacity.
+
+    ``strategy`` picks the join kernel: ``sort`` (ops/join.py bitonic
+    build + binary-search probe), ``dense`` (ops/dense_join.py
+    open-addressing table of ``table_cap`` slots), or ``matmul`` (same
+    table addressed by identity binning of the single key column).
+    Non-sort strategies return a FOURTH element — the table-overflow
+    flag whose ``densejoin@…`` capacity site the executor's retry ladder
+    doubles (graceful re-hash instead of the spill cliff).
 
     ``profiler`` (``DistributedExecutor._profiled_call``) optionally wraps
     the shard_map program so its XLA cost/memory analysis is captured."""
@@ -940,12 +950,11 @@ def _sharded_probe(
         + (build_spec, build_spec)
     )
 
-    @partial(
-        smap,
-        mesh=mesh,
-        in_specs=in_specs,
-        out_specs=(tuple(PS(AXIS) for _ in range(n_probe + n_build)), PS(AXIS), PS()),
-    )
+    out_specs = (tuple(PS(AXIS) for _ in range(n_probe + n_build)), PS(AXIS), PS())
+    if strategy != "sort":
+        out_specs = out_specs + (PS(),)
+
+    @partial(smap, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     def go(*ops):
         i = 0
         p_cols = ops[i : i + n_probe]; i += n_probe
@@ -966,11 +975,36 @@ def _sharded_probe(
         bv = jnp.ones_like(b_sel)
         for _, kv in bk_pairs:
             bv = bv & kv
-        sbk, sbi, bcount = J.build_side(b_hash, bv, b_sel)
-        ppos, bpos, osel, total, ovf = J.probe_join(
-            sbk, sbi, bcount, p_hash, pv, p_sel,
-            per_shard_cap, "left" if join_type == "LEFT" else "inner",
-        )
+        jt = "left" if join_type == "LEFT" else "inner"
+        tovf = None
+        if strategy == "sort":
+            sbk, sbi, bcount = J.build_side(b_hash, bv, b_sel)
+            ppos, bpos, osel, total, ovf = J.probe_join(
+                sbk, sbi, bcount, p_hash, pv, p_sel, per_shard_cap, jt,
+            )
+        else:
+            from trino_tpu.ops import dense_join as DJ
+
+            if strategy == "matmul":
+                # identity binning of the single key column (the caller
+                # gates matmul on nlk == 1 and an integer key dtype)
+                use_b = bv & b_sel
+                kmin = jnp.min(
+                    jnp.where(
+                        use_b,
+                        bk_pairs[0][0].astype(jnp.int64),
+                        jnp.iinfo(jnp.int64).max,
+                    )
+                )
+                bbase = DJ.slot_base_binned(bk_pairs[0][0], kmin, table_cap)
+                pbase = DJ.slot_base_binned(pk_pairs[0][0], kmin, table_cap)
+            else:
+                bbase = DJ.slot_base_hash(b_hash, table_cap)
+                pbase = DJ.slot_base_hash(p_hash, table_cap)
+            table, tovf = DJ.build_table(bbase, bv, b_sel, table_cap)
+            ppos, bpos, osel, total, ovf = DJ.probe_table(
+                table, b_hash, pbase, p_hash, pv, p_sel, per_shard_cap, jt,
+            )
         osel = J.verify_equal(pk_pairs, bk_pairs, ppos, bpos, osel)
         is_outer = bpos == J.MISSING
         safe_bpos = jnp.where(is_outer, 0, bpos)
@@ -982,7 +1016,10 @@ def _sharded_probe(
             outs.append(b_cols[k][safe_bpos])
             outs.append(b_cols[k + 1][safe_bpos] & ~is_outer)
         ovf_any = jax.lax.pmax(ovf.astype(jnp.int32), AXIS)
-        return tuple(outs), osel, ovf_any
+        if tovf is None:
+            return tuple(outs), osel, ovf_any
+        tovf_any = jax.lax.pmax(tovf.astype(jnp.int32), AXIS)
+        return tuple(outs), osel, ovf_any, tovf_any
 
     args = (
         list(probe_cols)
@@ -994,7 +1031,11 @@ def _sharded_probe(
     )
     if profiler is not None:
         label = "probe_join" + ("_partitioned" if build_sharded else "_broadcast")
-        outs, osel, ovf = profiler(label, go, *args)
+        res = profiler(label, go, *args)
     else:
-        outs, osel, ovf = go(*args)
-    return list(outs), osel, ovf
+        res = go(*args)
+    if strategy == "sort":
+        outs, osel, ovf = res
+        return list(outs), osel, ovf
+    outs, osel, ovf, tovf = res
+    return list(outs), osel, ovf, tovf

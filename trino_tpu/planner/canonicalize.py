@@ -68,6 +68,10 @@ _HOIST_FORMS = frozenset({"in", "between"})
 _CODEGEN_PROPS = (
     "batch_capacity",
     "broadcast_join_threshold_rows",
+    # the dense-join knobs pick which join kernel a fragment traces (and
+    # whether spill-sized inputs stay on the compiled path), so sort- and
+    # dense-strategy runs of one plan must not share a fingerprint
+    "dense_join",
     "dynamic_filtering_max_build_rows",
     "enable_dynamic_filtering",
     "execution_mode",
@@ -75,6 +79,8 @@ _CODEGEN_PROPS = (
     "fusion_max_fragments",
     "join_distribution_type",
     "join_reordering_strategy",
+    "join_strategy",
+    "matmul_join_max_domain",
     # fusion regroups fragments into multi-fragment programs, and the
     # grouping itself is cached per entry (__fusedunits__), so fused and
     # unfused runs of the same plan must not share a fingerprint

@@ -432,6 +432,27 @@ def partitioned_join_pairs(sub) -> list[tuple[int, int]]:
     return pairs
 
 
+def filtered_broadcast_fids(sub) -> set[int]:
+    """Fragment ids of broadcast producers carrying a selective Filter.
+
+    Absorbing such a build into its consumer's fused unit (the
+    ``broadcast_links`` star-join path) would erase the dynamic-filter
+    boundary: worker-side DF prunes probe splits/rows from a
+    *materialized* build, and a fused interior member never
+    materializes. A selective dim build is exactly where DF pays more
+    than the saved dispatch round-trip, so callers keep these links
+    unfused when dynamic filtering is enabled; predicate-free dim
+    builds (full-domain DF, nothing to prune) still fuse."""
+    fids: set[int] = set()
+    frags = sub.all_fragments() if isinstance(sub, SubPlan) else sub
+    for frag in frags:
+        if frag.output_exchange != "broadcast":
+            continue
+        if any(isinstance(n, P.Filter) for n in P.walk_plan(frag.root)):
+            fids.add(frag.id)
+    return fids
+
+
 def fuse_groups(
     sub: SubPlan,
     *,
@@ -440,6 +461,7 @@ def fuse_groups(
     blocked: frozenset = frozenset(),
     skew_pairs=(),
     include_root: bool = True,
+    broadcast_links: bool = False,
 ):
     """Post-fragmentation grouping: partition the fragment tree into
     fused units. Returns a list of units in bottom-up execution order;
@@ -455,7 +477,10 @@ def fuse_groups(
       callers block spool-required boundaries);
     - the connecting exchange is plain or skew-salted HASH, or a gather
       ('single' — e.g. into a final global aggregation). Broadcast links
-      stay fragment boundaries;
+      stay fragment boundaries unless ``broadcast_links`` is set (the
+      dense join tier): then REPLICATE/broadcast dim builds ride inside
+      their consumer's unit, so a star-join fact chain probes every dim
+      in ONE fused program instead of pairwise join fragments;
     - skew-paired producers (``skew_pairs``) are absorbed atomically —
       both or neither;
     - the unit stays within ``max_fragments`` members.
@@ -480,6 +505,11 @@ def fuse_groups(
         peer[a] = b
         peer[b] = a
     max_fragments = max(1, int(max_fragments))
+    links = (
+        ("hash", "single", "broadcast")
+        if broadcast_links
+        else ("hash", "single")
+    )
     ok = {
         f.id
         for f in order
@@ -509,9 +539,7 @@ def fuse_groups(
             claimed.update(c.id for c in group)
             if any(c.id not in ok for c in group):
                 continue
-            if any(
-                c.output_exchange not in ("hash", "single") for c in group
-            ):
+            if any(c.output_exchange not in links for c in group):
                 continue
             if size[ru] + len(group) > max_fragments:
                 continue

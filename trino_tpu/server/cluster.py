@@ -39,6 +39,7 @@ from trino_tpu.planner.fragmenter import (
     FusedFragment,
     PlanFragment,
     SubPlan,
+    filtered_broadcast_fids,
     fragment_plan,
     fuse_groups,
     partitioned_join_pairs,
@@ -507,12 +508,20 @@ class ClusterScheduler:
                 max_fragments=max(
                     1, int(session.get("fusion_max_fragments"))
                 ),
+                # selective broadcast builds keep the dynamic-filter
+                # boundary (worker-side DF needs the materialized build)
+                blocked=(
+                    frozenset(filtered_broadcast_fids(sub))
+                    if bool(session.get("enable_dynamic_filtering"))
+                    else frozenset()
+                ),
                 skew_pairs=(
                     partitioned_join_pairs(sub)
                     if bool(session.get("skew_handling"))
                     else ()
                 ),
                 include_root=False,  # the root runs on the coordinator
+                broadcast_links=bool(session.get("dense_join")),
             )
             for u in units:
                 if isinstance(u, FusedFragment):
@@ -1758,16 +1767,21 @@ class ClusterScheduler:
         # retried attempts whose work was discarded
         exchange_totals: dict = {}
         total_caps: dict = {}
+        join_strategy: dict = {}
         for entry in stages:
             for k, v in (entry.get("exchange") or {}).items():
                 if k == "capacities" and isinstance(v, dict):
                     total_caps.update(v)  # site names are per-stage unique
+                elif k == "joinStrategy" and isinstance(v, dict):
+                    join_strategy.update(v)  # ditto: densejoin@{fid}#{ord}
                 elif k != "padding_ratio" and isinstance(
                     v, (int, float)
                 ) and not isinstance(v, bool):
                     exchange_totals[k] = exchange_totals.get(k, 0) + v
         if total_caps:
             exchange_totals["capacities"] = total_caps
+        if join_strategy:
+            exchange_totals["joinStrategy"] = join_strategy
         round_trips = sum(e.get("attempts", 0) for e in stages)
         if exchange_totals or round_trips:
             exchange_totals["dispatchRoundTrips"] = round_trips
@@ -1813,6 +1827,7 @@ class ClusterScheduler:
         peak = 0
         exchange: dict = {}
         exchange_caps: dict = {}
+        exchange_join: dict = {}
         ingest: dict = {}
         for t in tasks:
             st = t.last_status or {}
@@ -1851,6 +1866,11 @@ class ClusterScheduler:
                     old.get("value", 0) or 0
                 ):
                     exchange_caps[name] = ent
+            # join sites are per-stage unique, same strategy on every
+            # sibling task — a plain union is exact
+            js = (ts.get("exchange") or {}).get("joinStrategy")
+            if isinstance(js, dict):
+                exchange_join.update(js)
             for k, v in (ts.get("ingest") or {}).items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     ingest[k] = ingest.get(k, 0) + v
@@ -1873,6 +1893,8 @@ class ClusterScheduler:
             entry["compileMs"] = round(compile_ms, 3)
         if exchange_caps:
             exchange["capacities"] = exchange_caps
+        if exchange_join:
+            exchange["joinStrategy"] = exchange_join
         if exchange:
             if exchange.get("shuffle_rows"):
                 exchange["padding_ratio"] = round(
