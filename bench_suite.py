@@ -754,6 +754,55 @@ def bench_open_loop(
     }
 
 
+def bench_result_cache(repeats: int = 15) -> dict:
+    """Semantic result cache: one cold TPC-H Q1 execution stores the
+    result, then ``repeats`` warm repeats must be served from the RESULT
+    tier — no parse, no plan, no dispatch. Headline: warm p50 < 1 ms and
+    rows bit-identical to a cache-off run."""
+    from trino_tpu.benchmarks.tpch import queries as corpus
+    from trino_tpu.config import Session
+    from trino_tpu.testing import LocalQueryRunner
+
+    runner = LocalQueryRunner()
+    sql = corpus("tpch.tiny")[1]
+    session = Session(properties={"execution_mode": "distributed",
+                                  "result_cache": True})
+    baseline = runner.engine.execute_statement(
+        sql, Session(properties={"execution_mode": "distributed"})
+    )
+    t0 = time.time()
+    cold = runner.engine.execute_statement(sql, session)
+    cold_s = time.time() - t0
+    lat_ms, hits = [], 0
+    rows = None
+    for _ in range(repeats):
+        t0 = time.time()
+        res = runner.engine.execute_statement(sql, session)
+        lat_ms.append((time.time() - t0) * 1000.0)
+        if (res.result_cache_stats or {}).get("resultCacheHit"):
+            hits += 1
+        rows = res.rows
+    p50 = _percentile(lat_ms, 50)
+    identical = sorted(map(tuple, rows or ())) == sorted(
+        map(tuple, baseline.rows or ())
+    ) and sorted(map(tuple, cold.rows or ())) == sorted(
+        map(tuple, baseline.rows or ())
+    )
+    out = {
+        "cold_s": round(cold_s, 3),
+        "warm_p50_ms": p50,
+        "warm_p99_ms": _percentile(lat_ms, 99),
+        "hits": hits,
+        "repeats": repeats,
+        "identical": identical,
+        "speedup": round(cold_s * 1000.0 / max(p50, 1e-6), 1),
+    }
+    assert p50 < 1.0, f"warm p50 {p50}ms >= 1ms"
+    assert hits >= 1, "no result-cache hit observed"
+    assert identical, "cached rows drifted from cache-off baseline"
+    return out
+
+
 def _subprocess_entry(call: str, timeout_s: int) -> dict:
     """Run ``bench_suite.<call>`` in a fresh python, hard-killed on
     timeout (a cancelled XLA compile holds the chip: the child must DIE,
@@ -808,6 +857,7 @@ def run_suite() -> dict:
         "bench_open_loop(clients=200, qps=400.0, duration_s=4.0)", 120
     )
     suite["adaptive_history"] = _subprocess_entry("adaptive_history()", 420)
+    suite["result_cache"] = _subprocess_entry("bench_result_cache()", 300)
     suite["join"] = _subprocess_entry("bench_join()", 600)
     suite["star_join"] = _subprocess_entry("bench_star_join()", 420)
     suite["suite_wall_s"] = round(time.time() - t0, 1)

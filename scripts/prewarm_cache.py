@@ -13,6 +13,10 @@ serving run, obs/history.py) the corpus is reordered by OBSERVED elapsed
 bounds how many history-ranked entries run — and a fingerprint →
 observed-stats table prints what the history knew about each.
 
+With ``--results`` the corpus is additionally executed with the semantic
+result cache enabled and a fingerprint → cached-bytes table prints what
+landed in the RESULT tier (see README "Semantic result cache").
+
 The suite's conftest honors the same variable, so tests reuse the warmed
 entries. Idempotent: re-running only adds missing entries.
 """
@@ -46,6 +50,12 @@ def main() -> None:
         "--top", type=int, default=0,
         help="with --history-dir: only prewarm the N slowest "
              "history-known fingerprints (0 = all, history-known first)",
+    )
+    ap.add_argument(
+        "--results", action="store_true",
+        help="also populate the semantic RESULT cache (re-run the corpus "
+             "with result_cache=on) and print a fingerprint -> "
+             "cached-bytes table",
     )
     args = ap.parse_args()
 
@@ -203,6 +213,29 @@ def main() -> None:
             fp = key[0] if isinstance(key, tuple) else str(key)
             print(f"{fp[:12]}  {len(entry.get('programs', {})):>8}  "
                   f"{seen_fps.get(fp, '?')}")
+    # --results: re-run the corpus with the semantic result cache on so a
+    # serving run that shares this engine (or reads /v1/cache) starts with
+    # warm RESULT entries, then print what got cached. Literal variants
+    # that share a fingerprint still store separately (the param vector is
+    # part of the entry key), so the table can show more rows than the
+    # compile table above.
+    if args.results:
+        for sql, props in shapes:
+            s = Session(properties={"execution_mode": "distributed",
+                                    "result_cache": True, **props})
+            try:
+                runner.engine.execute_statement(sql, s)
+            except Exception as e:  # noqa: BLE001 — warm what we can
+                print(f"skip   [result] {type(e).__name__}: {e}")
+        snap = runner.engine.result_cache.snapshot()
+        print("\nfingerprint   rows     bytes  maint  query")
+        for ent in snap["entries"]:
+            fp = ent["fingerprint"] or "?"
+            print(f"{fp[:12]}  {ent['rows']:>4}  {ent['nbytes']:>8}  "
+                  f"{'yes' if ent['maintainable'] else ' no':>5}  "
+                  f"{ent['query'][:48]}")
+        print(f"result cache: {len(snap['entries'])} entries, "
+              f"{snap['totalBytes']} / {snap['maxBytes']} bytes")
     n_entries = (
         len(os.listdir(cache_dir)) if os.path.isdir(cache_dir) else 0
     )

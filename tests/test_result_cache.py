@@ -1,0 +1,373 @@
+"""Semantic result cache (trino_tpu/cache/result_cache.py).
+
+Invalidation matrix (param vector, data versions, ACL generation, LRU
+byte budget), bit-identity across cache on/off/invalidated, incremental
+aggregate maintenance on append (delta splits only), and concurrent
+reader/writer snapshot consistency.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.columnar import Batch, Column
+from trino_tpu.config import Session
+from trino_tpu.connectors.api import ColumnSchema, TableSchema
+from trino_tpu.engine import Engine
+from trino_tpu.security import AccessDeniedError, FileBasedAccessControl
+
+AGG = (
+    "select k, sum(v) as s, count(*) as c, min(v) as mn, max(v) as mx "
+    "from t group by k"
+)
+
+
+def _batch(n, seed):
+    r = np.random.default_rng(seed)
+    return Batch(
+        [
+            Column(T.BIGINT, r.integers(0, 8, n).astype(np.int64)),
+            Column(T.BIGINT, r.integers(0, 100, n).astype(np.int64)),
+        ],
+        n,
+    )
+
+
+def _schema():
+    return TableSchema(
+        "t", (ColumnSchema("k", T.BIGINT), ColumnSchema("v", T.BIGINT))
+    )
+
+
+def _engine(parts=((2000, 0),)):
+    engine = Engine()
+    mem = engine.catalogs.get("memory")
+    mem.create_table("default", "t", _schema())
+    for n, seed in parts:
+        mem.insert("default", "t", _batch(n, seed))
+    return engine, mem
+
+
+def _sess(**props):
+    return Session(
+        catalog="memory",
+        schema="default",
+        properties={"result_cache": True, **props},
+    )
+
+
+def _sorted(rows):
+    return sorted(tuple(r) for r in rows)
+
+
+def test_warm_repeat_pure_hit():
+    engine, _ = _engine()
+    s = _sess()
+    cold = engine.execute_statement(AGG, s)
+    assert cold.result_cache_stats is None
+    warm = engine.execute_statement(AGG, s)
+    rc = warm.result_cache_stats
+    assert rc is not None and rc["resultCacheHit"] == 1
+    # zero device dispatches: no scan ran, so no ingest accounting at all
+    assert warm.ingest_stats is None
+    assert warm.trace_count == 0 and warm.compile_ms == 0.0
+    assert _sorted(warm.rows) == _sorted(cold.rows)
+    assert warm.column_names == cold.column_names
+    snap = engine.result_cache.snapshot()
+    assert snap["hits"] == 1 and snap["entries"][0]["maintainable"]
+
+
+def test_bit_identical_on_off_invalidated():
+    engine, mem = _engine()
+    on = engine.execute_statement(AGG, _sess())
+    off = engine.execute_statement(AGG, _sess(result_cache=False))
+    hit = engine.execute_statement(AGG, _sess())
+    assert hit.result_cache_stats["resultCacheHit"] == 1
+    # rewrite: same data re-inserted -> entry invalid, rows still identical
+    mem.truncate("default", "t")
+    mem.insert("default", "t", _batch(2000, 0))
+    inval = engine.execute_statement(AGG, _sess())
+    assert inval.result_cache_stats is None
+    assert (
+        _sorted(on.rows)
+        == _sorted(off.rows)
+        == _sorted(hit.rows)
+        == _sorted(inval.rows)
+    )
+
+
+def test_param_vector_miss():
+    engine, _ = _engine()
+    s = _sess()
+    a = engine.execute_statement("select sum(v) as s from t where k < 3", s)
+    b = engine.execute_statement("select sum(v) as s from t where k < 5", s)
+    # different literal -> different param vector -> no cross-serving
+    assert b.result_cache_stats is None
+    assert a.rows != b.rows
+    a2 = engine.execute_statement("select sum(v) as s from t where k < 3", s)
+    b2 = engine.execute_statement("select sum(v) as s from t where k < 5", s)
+    assert a2.result_cache_stats["resultCacheHit"] == 1
+    assert b2.result_cache_stats["resultCacheHit"] == 1
+    assert a2.rows == a.rows and b2.rows == b.rows
+
+
+def test_coarse_version_bump_invalidates(monkeypatch):
+    """Connectors without part enumeration fall back to data_version():
+    ANY bump invalidates (the legacy whole-table-digest behavior)."""
+    engine, mem = _engine()
+    monkeypatch.setattr(mem, "data_versions", lambda schema, table: None)
+    s = _sess()
+    engine.execute_statement(AGG, s)
+    assert engine.execute_statement(AGG, s).result_cache_stats is not None
+    mem._version += 1  # catalog version bump without a data change
+    stale = engine.execute_statement(AGG, s)
+    assert stale.result_cache_stats is None
+    assert engine.result_cache.snapshot()["invalidations"] == 1
+
+
+def test_acl_generation_bump_drops_entry():
+    engine, _ = _engine()
+    s = _sess()
+    engine.execute_statement(AGG, s)
+    assert engine.execute_statement(AGG, s).result_cache_stats is not None
+    engine.access_control.add(
+        FileBasedAccessControl({"catalogs": [{"allow": "all"}]})
+    )
+    # policy changed: entry must not serve even though rules still allow
+    stale = engine.execute_statement(AGG, s)
+    assert stale.result_cache_stats is None
+    assert engine.execute_statement(AGG, s).result_cache_stats is not None
+
+
+def test_acl_denied_user_never_served_from_cache():
+    engine, _ = _engine()
+    engine.access_control.add(
+        FileBasedAccessControl(
+            {"catalogs": [{"user": "alice", "catalog": ".*", "allow": "all"}]}
+        )
+    )
+    alice = Session(
+        user="alice",
+        catalog="memory",
+        schema="default",
+        properties={"result_cache": True},
+    )
+    engine.execute_statement(AGG, alice)
+    assert engine.execute_statement(AGG, alice).result_cache_stats is not None
+    bob = Session(
+        user="bob",
+        catalog="memory",
+        schema="default",
+        properties={"result_cache": True},
+    )
+    with pytest.raises(AccessDeniedError):
+        engine.execute_statement(AGG, bob)
+
+
+def test_lru_eviction_order():
+    engine, _ = _engine()
+    s = _sess()
+    qa = "select sum(v) as s from t where k < 2"
+    qb = "select sum(v) as s from t where k < 4"
+    qc = "select sum(v) as s from t where k < 6"
+    engine.execute_statement(qa, s)
+    per_entry = engine.result_cache.snapshot()["entries"][0]["nbytes"]
+    budget = per_entry * 2 + per_entry // 2  # room for two entries only
+    s2 = _sess(result_cache_max_bytes=budget)
+    engine.execute_statement(qb, s2)
+    # touch A so B becomes least-recently-used
+    assert engine.execute_statement(qa, s2).result_cache_stats is not None
+    engine.execute_statement(qc, s2)  # evicts B (LRU), keeps A + C
+    snap = engine.result_cache.snapshot()
+    assert snap["evictions"] == 1 and len(snap["entries"]) == 2
+    assert engine.execute_statement(qa, s2).result_cache_stats is not None
+    assert engine.execute_statement(qc, s2).result_cache_stats is not None
+    assert engine.execute_statement(qb, s2).result_cache_stats is None
+
+
+def test_incremental_maintenance_append():
+    engine, mem = _engine()
+    s = _sess()
+    cold = engine.execute_statement(AGG, s)
+    cold_splits = (cold.ingest_stats or {}).get("splits_decoded", 0)
+    assert cold_splits >= 1
+    mem.insert("default", "t", _batch(500, 1))
+    maintained = engine.execute_statement(AGG, s)
+    rc = maintained.result_cache_stats
+    assert rc is not None and rc["incrementalMaintenance"] == 1
+    # only the appended part was re-read: one delta split, fewer than a
+    # cold re-execution of the grown table would decode
+    assert rc["deltaSplits"] == 1
+    assert maintained.ingest_stats["splits_decoded"] == 1
+    # bit-identical to a cold re-execution over the full grown table
+    ref_engine, ref_mem = _engine(parts=())
+    ref_mem.insert("default", "t", _batch(2000, 0))
+    ref_mem.insert("default", "t", _batch(500, 1))
+    ref = ref_engine.execute_statement(AGG, Session(
+        catalog="memory", schema="default"
+    ))
+    assert _sorted(maintained.rows) == _sorted(ref.rows)
+    # next repeat is a pure hit on the maintained entry
+    again = engine.execute_statement(AGG, s)
+    assert again.result_cache_stats["resultCacheHit"] == 1
+    assert "incrementalMaintenance" not in again.result_cache_stats
+    assert again.result_cache_stats["maintainedCount"] == 1
+    assert _sorted(again.rows) == _sorted(ref.rows)
+
+
+def test_maintenance_disabled_falls_back_to_invalidation():
+    engine, mem = _engine()
+    s = _sess(incremental_maintenance=False)
+    engine.execute_statement(AGG, s)
+    mem.insert("default", "t", _batch(500, 1))
+    re_exec = engine.execute_statement(AGG, s)
+    assert re_exec.result_cache_stats is None
+    assert engine.execute_statement(AGG, s).result_cache_stats is not None
+
+
+def test_rewrite_invalidates_not_maintains():
+    engine, mem = _engine()
+    s = _sess()
+    engine.execute_statement(AGG, s)
+    mem.truncate("default", "t")
+    mem.insert("default", "t", _batch(2500, 2))
+    fresh = engine.execute_statement(AGG, s)
+    assert fresh.result_cache_stats is None  # full re-execution
+    ref_engine, ref_mem = _engine(parts=((2500, 2),))
+    ref = ref_engine.execute_statement(AGG, Session(
+        catalog="memory", schema="default"
+    ))
+    assert _sorted(fresh.rows) == _sorted(ref.rows)
+
+
+def test_non_maintainable_shapes_invalidate():
+    engine, mem = _engine()
+    s = _sess()
+    for sql in (
+        "select k, avg(v) as a from t group by k",  # avg: not mergeable
+        AGG + " order by k",  # sort above the aggregate
+        "select count(distinct v) as d from t",  # exact distinct
+    ):
+        first = engine.execute_statement(sql, s)
+        mem.insert("default", "t", _batch(100, hash(sql) % 1000))
+        second = engine.execute_statement(sql, s)
+        assert second.result_cache_stats is None  # re-executed, not merged
+        third = engine.execute_statement(sql, s)
+        assert third.result_cache_stats["resultCacheHit"] == 1
+        assert _sorted(third.rows) == _sorted(second.rows)
+        assert first.column_names == second.column_names
+
+
+def test_uncacheable_sql_and_cache_off():
+    engine, _ = _engine()
+    off = Session(catalog="memory", schema="default")
+    engine.execute_statement(AGG, off)
+    engine.execute_statement(AGG, off)
+    assert engine.result_cache.snapshot()["entries"] == []
+    # time-dependent idents never cache even with the knob on
+    assert not engine._sql_cacheable("select now()")
+    assert engine._result_cache_begin("select now()", _sess(), None) is None
+
+
+def test_file_connector_parts_delta(tmp_path):
+    """The satellite fix: part-level data_versions() tells appends from
+    rewrites where the whole-table data_version() digest cannot."""
+    from trino_tpu.connectors.file import FileConnector
+    from trino_tpu.ingest import parts_delta
+
+    conn = FileConnector(str(tmp_path))
+    conn.create_table("default", "t", _schema())
+    conn.insert("default", "t", _batch(100, 0))
+    v1 = conn.data_versions("default", "t")
+    conn.insert("default", "t", _batch(50, 1))
+    v2 = conn.data_versions("default", "t")
+    verdict, appended = parts_delta(v1, v2)
+    assert verdict == "append" and len(appended) == 1
+    splits = conn.splits_for_parts("default", "t", appended)
+    assert len(splits) == 1 and splits[0].info == appended[0]
+    conn.truncate("default", "t")
+    conn.insert("default", "t", _batch(150, 2))
+    v3 = conn.data_versions("default", "t")
+    assert parts_delta(v2, v3)[0] == "changed"
+    assert parts_delta(v2, v2)[0] == "same"
+
+
+def test_memory_restore_state_invalidates():
+    engine, mem = _engine()
+    s = _sess()
+    snap = mem.snapshot_state()
+    engine.execute_statement(AGG, s)
+    mem.restore_state(snap)  # rollback: same bytes, fresh part identities
+    res = engine.execute_statement(AGG, s)
+    assert res.result_cache_stats is None  # conservatively re-executed
+
+
+def test_concurrent_readers_see_consistent_snapshots():
+    """Readers hammering a cached aggregate while a writer appends must
+    only ever observe the pre-append or the post-append result — never a
+    torn or half-maintained row set."""
+    engine, mem = _engine(parts=((4000, 0),))
+    s = _sess()
+    snap_a = _sorted(engine.execute_statement(AGG, s).rows)
+    ref_engine, ref_mem = _engine(parts=())
+    ref_mem.insert("default", "t", _batch(4000, 0))
+    ref_mem.insert("default", "t", _batch(1000, 1))
+    snap_b = _sorted(
+        ref_engine.execute_statement(
+            AGG, Session(catalog="memory", schema="default")
+        ).rows
+    )
+    bad: list = []
+    hits = [0]
+    lock = threading.Lock()
+    start = threading.Barrier(5)
+
+    def reader():
+        start.wait()
+        for _ in range(12):
+            res = engine.execute_statement(AGG, _sess())
+            got = _sorted(res.rows)
+            with lock:
+                if res.result_cache_stats is not None:
+                    hits[0] += 1
+                if got != snap_a and got != snap_b:
+                    bad.append(got)
+
+    def writer():
+        start.wait()
+        mem.insert("default", "t", _batch(1000, 1))
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    threads.append(threading.Thread(target=writer))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not bad, f"inconsistent snapshot observed: {bad[:1]}"
+    assert hits[0] >= 1
+    final = engine.execute_statement(AGG, _sess())
+    assert _sorted(final.rows) == snap_b
+
+
+def test_query_manager_fast_path_bypasses_admission():
+    from trino_tpu.server.querymanager import QueryManager
+
+    engine, _ = _engine()
+    engine.execute_statement(AGG, _sess())  # warm the entry
+    qm = QueryManager(engine)
+    q = qm.create_query(AGG, _sess())
+    # a pure hit completes synchronously inside create_query: no
+    # admission queueing, no dispatch thread
+    assert q.state.get().value == "FINISHED"
+    info = q.info()
+    assert info["queryStats"]["resultCacheHit"] == 1
+    assert info["resultCacheStats"]["resultCacheHit"] == 1
+    # a cold query still dispatches normally
+    q2 = qm.create_query("select count(*) as c from t where k < 7", _sess())
+    from trino_tpu.server.statemachine import TERMINAL_QUERY_STATES
+
+    q2.state.wait_for(lambda st: st in TERMINAL_QUERY_STATES, timeout=30.0)
+    assert q2.state.get().value == "FINISHED"
+    assert q2.info()["queryStats"]["resultCacheHit"] == 0

@@ -1,0 +1,5 @@
+"""Coordinator-level caches (result tier of the repeat-path stack)."""
+
+from trino_tpu.cache.result_cache import ResultCache
+
+__all__ = ["ResultCache"]

@@ -221,6 +221,19 @@ class Session:
         # splits); warm repeat scans issue zero H2D bytes
         ("table_cache", True),
         ("table_cache_max_bytes", 1 << 30),
+        # --- semantic result cache (trino_tpu/cache/result_cache.py) -------
+        # coordinator-level final-result reuse keyed by (canonical plan
+        # fingerprint, hoisted-param vector, per-catalog data versions,
+        # ACL generation): a warm repeat returns in microseconds with zero
+        # device dispatches. Off by default — serving tiers opt in per
+        # session (existing warm-repeat tests assert real executions).
+        ("result_cache", False),
+        ("result_cache_max_bytes", 64 << 20),
+        # on an append-only data_versions() delta, re-execute the cached
+        # aggregation plan over ONLY the new parts and merge partial
+        # aggregates into the cached rows instead of invalidating;
+        # non-maintainable shapes invalidate as before
+        ("incremental_maintenance", True),
         # --- cross-query device batching (exec/batching.py) ----------------
         # hold compatible queries (same canonical-plan fingerprint,
         # differing only in hoisted literals) for a short window and

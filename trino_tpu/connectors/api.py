@@ -73,6 +73,10 @@ class Connector:
     # True when concurrent inserts from several NODES are safe (shared
     # storage): enables scaled-writer dispatch (ScaledWriterScheduler)
     supports_distributed_writes: bool = False
+    # False for connectors whose reads reflect live process state rather
+    # than versioned table data (system tables): the coordinator result
+    # cache (trino_tpu/cache) refuses to cache queries touching them
+    supports_result_caching: bool = True
 
     # --- metadata --------------------------------------------------------
     def list_schemas(self) -> list[str]:
@@ -175,6 +179,25 @@ class Connector:
         Mutable connectors bump ``_version``; file-backed connectors
         override with a (file list, mtime) digest."""
         return getattr(self, "_version", 0)
+
+    def data_versions(self, schema: str, table: str) -> Optional[list]:
+        """Part-level version enumeration: ordered ``(part_id, token)``
+        pairs, one per immutable storage part, or None when the connector
+        cannot enumerate parts (the result cache then falls back to the
+        coarse :meth:`data_version` token, where ANY change invalidates).
+
+        Contract: a part's token never changes while its id is live; an
+        APPEND adds new ids and leaves every old pair intact; any other
+        mutation (rewrite, delete, truncate) removes or changes at least
+        one old pair. This is what lets the result cache distinguish
+        "maintain incrementally over the new parts" from "invalidate"."""
+        return None
+
+    def splits_for_parts(self, schema: str, table, part_ids) -> list["Split"]:
+        """Splits covering exactly the parts named by ``part_ids`` (ids
+        from :meth:`data_versions`) — the delta scan for incremental
+        aggregate maintenance. Required when data_versions is implemented."""
+        raise NotImplementedError(f"{self.name}: part-level splits not supported")
 
     # --- optional stats (drives join distribution / sizing) -------------
     def estimate_rows(self, schema: str, table: str) -> Optional[int]:

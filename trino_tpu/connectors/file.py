@@ -284,6 +284,32 @@ class FileConnector(Connector):
                 out.append((p, 0.0))
         return tuple(out)
 
+    def data_versions(self, schema, table):
+        # part files are written once under uuid names (id = filename):
+        # an append adds names, a rewrite swaps/mutates them — exactly the
+        # data_versions() contract, with mtime_ns+size as the part token
+        # (data_version()'s float mtime is a whole-table digest and too
+        # coarse to tell the two apart)
+        if self.get_table(schema, table) is None:
+            return None
+        d = self._table_dir(schema, table)
+        out = []
+        for p in self._parts(schema, table):
+            try:
+                st = os.stat(os.path.join(d, p))
+                out.append((p, (st.st_mtime_ns, st.st_size)))
+            except OSError:
+                out.append((p, None))
+        return out
+
+    def splits_for_parts(self, schema, table, part_ids):
+        want = set(part_ids)
+        chosen = [p for p in self._parts(schema, table) if p in want]
+        return [
+            Split(table, i, max(len(chosen), 1), info=p)
+            for i, p in enumerate(chosen)
+        ]
+
     def split_stats(self, schema, table, split):
         entry = self._file_stats(schema, table).get(split.info)
         if entry is None:

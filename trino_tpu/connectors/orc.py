@@ -152,6 +152,36 @@ class OrcConnector(Connector):
             for p in self._files(schema, table)
         )
 
+    def data_versions(self, schema, table):
+        # one immutable uuid-named file per insert (id = basename, token =
+        # mtime_ns+size): part-level pairs let the result cache classify a
+        # change as append (maintain) vs rewrite (invalidate), which the
+        # whole-table data_version() digest cannot
+        if self.get_table(schema, table) is None:
+            return None
+        out = []
+        for p in self._files(schema, table):
+            try:
+                st = os.stat(p)
+                out.append((os.path.basename(p), (st.st_mtime_ns, st.st_size)))
+            except OSError:
+                out.append((os.path.basename(p), None))
+        return out
+
+    def splits_for_parts(self, schema, table, part_ids):
+        want = set(part_ids)
+        pairs = []
+        for path in self._files(schema, table):
+            if os.path.basename(path) not in want:
+                continue
+            f = self._file(path)
+            for si in range(len(f.stripes)):
+                pairs.append((path, si))
+        return [
+            Split(table, i, max(len(pairs), 1), info=pair)
+            for i, pair in enumerate(pairs)
+        ]
+
     # --- writes: one ORC file per insert ----------------------------------
 
     def create_table(self, schema, table, schema_def: TableSchema) -> None:

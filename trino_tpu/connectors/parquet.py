@@ -134,6 +134,36 @@ class ParquetConnector(Connector):
             for p in self._files(schema, table)
         )
 
+    def data_versions(self, schema, table):
+        # one immutable uuid-named file per insert (id = basename, token =
+        # mtime_ns+size): appends add pairs, rewrites change them — unlike
+        # data_version()'s whole-table digest, the result cache can tell
+        # which happened and maintain instead of invalidating
+        if self.get_table(schema, table) is None:
+            return None
+        out = []
+        for p in self._files(schema, table):
+            try:
+                st = os.stat(p)
+                out.append((os.path.basename(p), (st.st_mtime_ns, st.st_size)))
+            except OSError:
+                out.append((os.path.basename(p), None))
+        return out
+
+    def splits_for_parts(self, schema, table, part_ids):
+        want = set(part_ids)
+        pairs = []
+        for path in self._files(schema, table):
+            if os.path.basename(path) not in want:
+                continue
+            meta = self._meta(path)
+            for rg in range(len(meta.row_groups)):
+                pairs.append((path, rg))
+        return [
+            Split(table, i, max(len(pairs), 1), info=pair)
+            for i, pair in enumerate(pairs)
+        ]
+
     # --- writes -----------------------------------------------------------
 
     def create_table(self, schema, table, schema_def: TableSchema) -> None:

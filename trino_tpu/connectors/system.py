@@ -81,6 +81,8 @@ class SystemConnector(Connector):
     """Bound to an Engine; rows materialize live state at scan time."""
 
     name = "system"
+    # live process state, not versioned data: never result-cacheable
+    supports_result_caching = False
 
     def __init__(self, engine):
         self._engine = engine

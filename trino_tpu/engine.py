@@ -65,6 +65,10 @@ class StatementResult:
     # batchWaitMs for queries that shared a stacked dispatch — surfaced
     # in /v1/query queryStats; None when the query ran alone
     batch_stats: Optional[dict[str, Any]] = None
+    # semantic result cache (trino_tpu/cache): resultCacheHit plus
+    # incrementalMaintenance/deltaSplits when a statement was served (or
+    # maintained) from the coordinator result cache; None on real runs
+    result_cache_stats: Optional[dict[str, Any]] = None
 
 
 class Engine:
@@ -152,6 +156,13 @@ class Engine:
         # dir shares one store object (and its lock)
         self._history_stores: dict[str, Any] = {}
         self._history_lock = threading.Lock()
+        # semantic result cache (trino_tpu/cache): final result sets keyed
+        # by (canonical fingerprint, hoisted-param vector) and validated
+        # against data versions + ACL generation; the result_cache session
+        # knob gates both probe and store
+        from trino_tpu.cache.result_cache import ResultCache
+
+        self.result_cache = ResultCache()
 
     _QUERY_CACHE_MAX = 64
     # statements whose results depend on evaluation time/randomness must
@@ -537,9 +548,128 @@ class Engine:
                 ds["peak_hbm_bytes"]
             )
 
+    # --- semantic result cache (trino_tpu/cache) --------------------------
+
+    def _result_cache_on(self, session: Session) -> bool:
+        try:
+            if not bool(session.get("result_cache")):
+                return False
+        except KeyError:
+            return False
+        # snapshot semantics inside explicit transactions are per-txn
+        return "__txn" not in session.properties
+
+    def try_cached_result(
+        self, sql: str, session: Session, allow_maintenance: bool = True
+    ) -> Optional[StatementResult]:
+        """Serve this statement from the semantic result cache, or None.
+
+        Pure-hit lookups are microseconds and safe anywhere off the event
+        loop; ``allow_maintenance`` additionally permits an incremental
+        delta merge, which executes a scan and therefore belongs on a
+        worker/dispatch thread only (the QueryManager admission fast path
+        passes False)."""
+        if not self._result_cache_on(session):
+            return None
+        try:
+            return self.result_cache.lookup(
+                self, sql, session, allow_maintenance=allow_maintenance
+            )
+        except Exception:  # noqa: BLE001 — the cache must never fail a query
+            return None
+
+    def _result_cache_begin(
+        self, sql_text: Optional[str], session: Session, plan: P.PlanNode
+    ) -> Optional[dict]:
+        """Pre-execution snapshot for the store: referenced tables + their
+        data versions, captured BEFORE execution so a write landing during
+        the run leaves the entry conservatively stale, never wrong."""
+        if sql_text is None or not self._result_cache_on(session):
+            return None
+        if not self._sql_cacheable(sql_text):
+            return None
+        try:
+            from trino_tpu.cache.result_cache import (
+                referenced_tables,
+                versions_snapshot,
+            )
+
+            tables = referenced_tables(plan)
+            if not tables:
+                return None  # literal-only results are not worth an entry
+            for cat in dict.fromkeys(c for c, _, _ in tables):
+                conn = self.catalogs.get(cat)
+                if not getattr(conn, "supports_result_caching", True):
+                    return None  # live state (system tables): never cache
+            versions = versions_snapshot(self.catalogs, tables)
+        except Exception:  # noqa: BLE001
+            return None
+        return {"tables": tables, "versions": versions}
+
+    def _result_cache_store(
+        self,
+        ctx: Optional[dict],
+        sql_text: str,
+        session: Session,
+        plan: P.PlanNode,
+        res: Optional[StatementResult],
+    ) -> None:
+        if ctx is None or res is None or res.update_type is not None:
+            return
+        try:
+            from trino_tpu.planner.canonicalize import canonicalize_plan
+
+            mesh_n = (
+                int(self.mesh.devices.size) if self.mesh is not None else 1
+            )
+            # recompute the (fingerprint, params) pair from the BAKED plan
+            # here rather than reusing the dispatch path's: cluster mode
+            # computes a record-only fingerprint with the param vector
+            # discarded, and aliasing two literal variants onto one
+            # entry key would serve one query's rows for the other
+            _, params, fp = canonicalize_plan(plan, session, mesh_n)
+            if fp is None:
+                return
+            maintain = None
+            try:
+                if bool(session.get("incremental_maintenance")):
+                    from trino_tpu.planner.canonicalize import (
+                        classify_maintainability,
+                    )
+
+                    maintain = classify_maintainability(plan)
+            except KeyError:
+                maintain = None
+            try:
+                max_bytes = int(session.get("result_cache_max_bytes"))
+            except KeyError:
+                max_bytes = None
+            self.result_cache.store(
+                sql=sql_text,
+                session=session,
+                fingerprint=fp,
+                params=params,
+                tables=ctx["tables"],
+                versions=ctx["versions"],
+                acl_generation=self.access_control.generation,
+                res=res,
+                maintain=maintain,
+                plan=plan,
+                max_bytes=max_bytes,
+            )
+        except Exception:  # noqa: BLE001 — the cache must never fail a query
+            pass
+
     def _execute_statement_inner(
         self, sql: str, session: Session, query_id: Optional[str] = None
     ) -> StatementResult:
+        # result-cache probe BEFORE parse: sub-millisecond hits cannot
+        # afford parse+plan, so the cache's SQL-text memo (populated at
+        # store time, validated against data versions + ACL generation)
+        # routes repeat texts straight to host-resident rows
+        cached = self.try_cached_result(sql, session)
+        if cached is not None:
+            return cached
         stmt = parse_statement(sql)
         if isinstance(stmt, t.Prepare):
             # keep the statement's SQL text: it must survive the stateless
@@ -571,6 +701,9 @@ class Engine:
             # text — keys the program cache, so `x < 24` and `x < 25`
             # land on the same entry with different parameter vectors
             plan = self.plan(stmt, session)
+            # result-cache store context (tables + PRE-execution data
+            # versions); None when the cache is off or the shape refuses
+            rc_ctx = self._result_cache_begin(sql_text, session, plan)
             exec_plan, params, entry, fp = plan, [], None, None
             mode = session.get("execution_mode")
             try:
@@ -651,6 +784,7 @@ class Engine:
                     res.exchange_stats["history_hits"] = (
                         1 if hist_entry is not None else 0
                     )
+                self._result_cache_store(rc_ctx, sql_text, session, plan, res)
                 return res
             # shared program stores and capacity objects are not safe for
             # concurrent executors: a second in-flight run of the same
@@ -683,6 +817,7 @@ class Engine:
                     res.exchange_stats["history_hits"] = (
                         1 if hist_entry is not None else 0
                     )
+                self._result_cache_store(rc_ctx, sql_text, session, plan, res)
                 return res
             finally:
                 if entry is not None:

@@ -425,6 +425,28 @@ def splits_fingerprint(splits: Sequence) -> str:
     return hashlib.sha1(blob.encode()).hexdigest()[:16]
 
 
+def parts_delta(old_parts, new_parts):
+    """Classify a part-version transition (pairs from connector
+    ``data_versions()``): ``("same", [])`` when identical,
+    ``("append", new_ids)`` when every old ``(id, token)`` pair survives
+    untouched and only new ids were added, else ``("changed", [])``.
+    Drives the result cache's maintain-vs-invalidate decision; anything
+    ambiguous (duplicate ids, removed or re-tokened parts) is "changed"."""
+    old = dict(old_parts)
+    new = dict(new_parts)
+    if len(old) != len(old_parts) or len(new) != len(new_parts):
+        return "changed", []
+    if old == new:
+        return "same", []
+    appended = [pid for pid, _ in new_parts if pid not in old]
+    if not appended or len(new) != len(old) + len(appended):
+        return "changed", []
+    for pid, tok in old_parts:
+        if new.get(pid) != tok:
+            return "changed", []
+    return "append", appended
+
+
 class DeviceTableCache:
     """Byte-budget LRU of HBM-resident scanned tables.
 

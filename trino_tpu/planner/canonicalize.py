@@ -368,3 +368,84 @@ def canonicalize_plan(
             root = _strip_scan_constraints(root)
     fp = plan_fingerprint(root, session, mesh_devices, nparams=len(params))
     return root, params, fp
+
+
+# aggregate kinds whose partial state merges exactly by row-wise combine
+# of final values: sum/min/max combine with themselves, count combines by
+# addition. avg is OUT (final value loses the count weight); distinct and
+# filtered aggregates are OUT (their state is not the output value).
+_MAINTAINABLE_AGGS = frozenset({"sum", "count", "count_star", "min", "max"})
+
+
+def _sum_merges_exactly(t) -> bool:
+    # float sums are order-dependent: cached + delta would differ in the
+    # last ulp from a cold re-execution, breaking bit-identity. Integer
+    # and decimal sums are exact under any association.
+    return T.is_integer(t) or isinstance(t, T.DecimalType)
+
+
+def classify_maintainability(root: P.PlanNode) -> Optional[dict]:
+    """Can this plan's cached result be maintained incrementally on
+    append? Yes only for the shape ``Output <- Aggregate(single) <-
+    (Filter|Project)* <- TableScan`` where every aggregate merges exactly
+    (:data:`_MAINTAINABLE_AGGS`, exact-sum types) and every group key is
+    visible in the output (hidden keys could merge distinct output rows).
+
+    Returns ``{"table": (catalog, schema, table), "cols": (kind, ...)}``
+    with one kind per output column — ``"key"``, ``"sum"``, ``"count"``,
+    ``"min"`` or ``"max"`` — or None for non-maintainable shapes (joins,
+    sorts, limits, avg, distinct, filtered aggregates, multi-scan plans),
+    which fall back to plain invalidation.
+    """
+    if not isinstance(root, P.Output):
+        return None
+    from trino_tpu.ir import Variable
+
+    # the planner renames aggregate symbols to output names through pure
+    # identity Projects (sum_4 -> s); follow each output symbol down the
+    # rename chain to the symbol the Aggregate actually produces. Any
+    # computed assignment (sum(v) + 1) makes that column non-maintainable.
+    rename: dict[str, Optional[str]] = {s.name: s.name for s in root.symbols}
+    node = root.source
+    while isinstance(node, P.Project):
+        sub: dict[str, Optional[str]] = {}
+        for sym, expr in node.assignments:
+            sub[sym.name] = expr.name if isinstance(expr, Variable) else None
+        rename = {
+            out: (sub.get(cur) if cur is not None else None)
+            for out, cur in rename.items()
+        }
+        node = node.source
+    agg = node
+    if not isinstance(agg, P.Aggregate) or agg.step != "single":
+        return None
+    by_symbol: dict[str, str] = {}
+    for s in agg.group_keys:
+        by_symbol[s.name] = "key"
+    for s, fn in agg.aggregates:
+        if fn.kind not in _MAINTAINABLE_AGGS:
+            return None
+        if fn.distinct or fn.filter is not None:
+            return None
+        if fn.kind == "sum" and not _sum_merges_exactly(fn.result_type):
+            return None
+        by_symbol[s.name] = "count" if fn.kind in ("count", "count_star") else fn.kind
+    cols = []
+    for s in root.symbols:
+        src_name = rename.get(s.name)
+        kind = by_symbol.get(src_name) if src_name is not None else None
+        if kind is None:  # output column that is neither key nor aggregate
+            return None
+        cols.append(kind)
+    visible = {rename[s.name] for s in root.symbols}
+    if any(s.name not in visible for s in agg.group_keys):
+        return None
+    node = agg.source
+    while isinstance(node, (P.Filter, P.Project)):
+        node = node.source
+    if not isinstance(node, P.TableScan):
+        return None
+    return {
+        "table": (node.catalog, node.schema, node.table),
+        "cols": tuple(cols),
+    }

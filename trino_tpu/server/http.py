@@ -828,6 +828,14 @@ class TrinoTpuServer:
             return responder.respond(json_response(
                 snap_fn() if callable(snap_fn) else {"stores": []}
             ))
+        if path == "/v1/cache":
+            # semantic result cache snapshot (trino_tpu/cache): entries,
+            # byte budget, hit/miss/eviction/maintenance counters. Brief
+            # lock only — same loop-thread discipline as /v1/metrics.
+            rc = getattr(self.engine, "result_cache", None)
+            return responder.respond(json_response(
+                rc.snapshot() if rc is not None else {"entries": []}
+            ))
         if path == "/v1/query":
             return responder.respond(json_response(
                 [q.info() for q in self.query_manager.queries()]

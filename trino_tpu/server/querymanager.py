@@ -323,6 +323,9 @@ class ManagedQuery:
             # wall, coalesced H2D bytes, device-table-cache hits/misses —
             # a warm repeat scan shows h2d_bytes == 0
             "ingestStats": self.result.ingest_stats if self.result else None,
+            "resultCacheStats": (
+                self.result.result_cache_stats if self.result else None
+            ),
             # cross-query batching (exec/batching.py): which dispatch this
             # query shared and how long it waited; None when it ran alone
             "batchStats": (
@@ -350,6 +353,8 @@ class ManagedQuery:
               if self.result else None) or {}
         ex = (getattr(self.result, "exchange_stats", None)
               if self.result else None) or {}
+        rc = (getattr(self.result, "result_cache_stats", None)
+              if self.result else None) or {}
         return {
             "elapsedMs": int(elapsed_s * 1000),
             "queuedMs": int(
@@ -365,6 +370,11 @@ class ManagedQuery:
             # informed this one
             "historySeeds": ex.get("history_seeds", 0),
             "historyHits": ex.get("history_hits", 0),
+            # semantic result cache (trino_tpu/cache): 1 when this query
+            # was served from (or incrementally maintained in) the
+            # coordinator result cache
+            "resultCacheHit": rc.get("resultCacheHit", 0),
+            "resultCacheMaintained": rc.get("incrementalMaintenance", 0),
             "speculativeAttempts": cluster_stats.get("speculative_attempts", 0),
             "speculativeWins": cluster_stats.get("speculative_wins", 0),
             "recoveredTasks": cluster_stats.get("recovered_tasks", 0),
@@ -582,6 +592,14 @@ class QueryManager:
                     q.query_id, sql, session.user, q.create_time
                 )
             )
+        # semantic result-cache fast path: a pure hit consumes no
+        # execution slot, so it bypasses admission queueing entirely (ACL
+        # generation + per-user checks still run inside the probe).
+        # Maintenance is deliberately disallowed here — delta merges
+        # execute scans and belong on the dispatch pool via the admitted
+        # path, which then refreshes or overwrites the entry.
+        if self._try_result_cache(q):
+            return q
         if self.resource_groups is not None and self._admit is None:
             self._submit_admission(q)
         else:
@@ -589,6 +607,29 @@ class QueryManager:
                 target=self._dispatch, args=(q,), daemon=True
             ).start()
         return q
+
+    def _try_result_cache(self, q: ManagedQuery) -> bool:
+        """Complete ``q`` from the result cache; False -> normal dispatch."""
+        probe = getattr(self.engine, "try_cached_result", None)
+        if probe is None:
+            return False
+        try:
+            res = probe(q.sql, q.session, allow_maintenance=False)
+        except Exception:  # noqa: BLE001 — the probe must never fail a query
+            return False
+        if res is None:
+            return False
+        q.start_time = time.time()
+        q._start_mono_ts = time.monotonic()
+        q.state.set(QueryState.PLANNING)
+        q.state.set(QueryState.RUNNING)
+        q.result = res
+        q.state.set(QueryState.FINISHING)
+        q.state.set(QueryState.FINISHED)
+        q.end_time = time.time()
+        q._end_mono = time.monotonic()
+        q._fire_completed(self.engine)
+        return True
 
     # --- event-driven admission (resource_groups path) --------------------
 
