@@ -43,10 +43,36 @@ def _median_time(runner, sql: str, runs: int = 3) -> float:
     return times[len(times) // 2]
 
 
+def _operator_rollup(operator_stats) -> dict:
+    """In-program operator telemetry rollup (exec/fragments.py op!
+    channel): total rows in/out per operator kind, plus the WORST
+    partial-agg reduction ratio (rows_out/rows_in — highest = the
+    exchange whose partial agg reduced least, i.e. the best candidate
+    for skipping partial aggregation)."""
+    out: dict = {}
+    worst = None
+    for ent in (operator_stats or {}).values():
+        kind = str(ent.get("kind") or "")
+        if not kind:
+            continue
+        key = kind.replace("-", "_")
+        rin = int(ent.get("rows_in", 0) or 0)
+        rout = int(ent.get("rows_out", 0) or 0)
+        out[f"op_{key}_rows_in"] = out.get(f"op_{key}_rows_in", 0) + rin
+        out[f"op_{key}_rows_out"] = out.get(f"op_{key}_rows_out", 0) + rout
+        if kind == "partial-agg" and rin > 0:
+            ratio = rout / rin
+            worst = ratio if worst is None else max(worst, ratio)
+    if worst is not None:
+        out["op_worst_partial_agg_reduction"] = round(worst, 4)
+    return out
+
+
 def _dispatch_stats(runner, sql: str) -> dict:
     """Pipeline-fusion telemetry for one warm run: how many device
     dispatches the query costs (fused chains collapse N fragment
-    dispatches into 1) and how many fragments rode in fused programs."""
+    dispatches into 1) and how many fragments rode in fused programs —
+    plus the per-kind operator row-flow rollup."""
     res = runner.engine.execute_statement(sql, runner.session)
     ex = res.exchange_stats or {}
     out = {}
@@ -54,6 +80,7 @@ def _dispatch_stats(runner, sql: str) -> dict:
         out["dispatch_round_trips"] = ex["dispatchRoundTrips"]
     if ex.get("fusedFragments"):
         out["fused_fragments"] = ex["fusedFragments"]
+    out.update(_operator_rollup(getattr(res, "operator_stats", None)))
     return out
 
 

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Offline flight-journal reader (obs/flight.py).
+
+Replays a coordinator's crash-safe query journal straight from disk — no
+server, no live process — and prints it as a timeline or JSON. This is
+the post-mortem tool for a coordinator that is not coming back: the
+journal's intact prefix survives SIGKILL mid-write by construction
+(length-prefixed CRC records; replay stops at the first torn record).
+
+    python scripts/flightdump.py /var/trino-tpu/flight
+    python scripts/flightdump.py /var/trino-tpu/flight --query 20260807_...
+    python scripts/flightdump.py /var/trino-tpu/flight --json
+    python scripts/flightdump.py /var/trino-tpu/flight --events completed
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from trino_tpu.obs.flight import replay_dir  # noqa: E402
+
+
+def _fmt_ts(ts) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(ts))) + (
+            "%.3f" % (float(ts) % 1.0)
+        )[1:]
+    except (TypeError, ValueError):
+        return "?"
+
+
+def _summarize(rec: dict) -> str:
+    """One timeline line per record; the completed record carries the
+    post-mortem payload, so surface its verdict inline."""
+    event = rec.get("event", "?")
+    bits = []
+    if event == "completed":
+        bits.append(f"state={rec.get('state')}")
+        bits.append(f"wallMs={rec.get('wallMs')}")
+        if (rec.get("queryAttempts") or 1) > 1:
+            bits.append(f"attempts={rec.get('queryAttempts')}")
+        if rec.get("taskRetries"):
+            bits.append(f"taskRetries={rec.get('taskRetries')}")
+        if rec.get("recoveredTasks"):
+            bits.append(f"recovered={rec.get('recoveredTasks')}")
+        err = rec.get("error")
+        if err:
+            bits.append(f"error={err.get('errorName')}")
+        ops = rec.get("operatorStats") or {}
+        if ops:
+            bits.append(f"operators={len(ops)}")
+        reg = (rec.get("queryStats") or {}).get("regression")
+        if reg:
+            bits.append(
+                f"REGRESSED x{reg.get('magnitude')} ({reg.get('severity')})"
+            )
+    elif event == "retry":
+        bits.append(f"attempt={rec.get('attempt')}")
+        bits.append(f"error={rec.get('errorClass')}")
+    elif event == "running":
+        bits.append(f"queuedMs={rec.get('queuedMs')}")
+    elif event in ("rejected", "canceled", "killed"):
+        bits.append(str(rec.get("error") or rec.get("message") or ""))
+    elif event in ("admitted", "queued"):
+        if rec.get("group"):
+            bits.append(f"group={rec.get('group')}")
+    elif event == "created":
+        q = str(rec.get("query") or "").replace("\n", " ")
+        bits.append(q[:60] + ("…" if len(q) > 60 else ""))
+    return " ".join(str(b) for b in bits if b)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("directory", help="flight journal directory")
+    ap.add_argument("--query", help="filter to one query id")
+    ap.add_argument(
+        "--events", help="comma-separated event filter (e.g. completed,retry)"
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit full records as JSON lines (everything the journal has)",
+    )
+    args = ap.parse_args(argv)
+
+    records = replay_dir(args.directory, args.query)
+    if args.events:
+        wanted = {e.strip() for e in args.events.split(",") if e.strip()}
+        records = [r for r in records if r.get("event") in wanted]
+    if args.json:
+        for rec in records:
+            print(json.dumps(rec, default=str))
+        return 0
+    if not records:
+        print(f"no flight records under {args.directory}", file=sys.stderr)
+        return 1
+    for rec in records:
+        print(
+            f"{_fmt_ts(rec.get('ts'))}  {rec.get('queryId', '?'):<32}"
+            f"  {rec.get('event', '?'):<10} {_summarize(rec)}"
+        )
+    print(f"-- {len(records)} records", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

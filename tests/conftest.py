@@ -90,6 +90,7 @@ _SHARD_WEIGHTS = {
     "test_single_device_lane.py": 30,
     "test_speculation.py": 30,
     "test_result_cache.py": 30,
+    "test_flight.py": 30,
 }
 _SHARD_DEFAULT_WEIGHT = 10
 

@@ -1,0 +1,415 @@
+"""Flight recorder, SLO sentinel, and operator-telemetry tests.
+
+Covers the three observability layers end to end:
+
+- the crash-safe flight journal (obs/flight.py): framing, torn-tail
+  replay, segment bounding, a REAL ``SIGKILL`` of a coordinator process
+  mid-query with intact-prefix replay served by a fresh server via
+  ``GET /v1/query/{id}/flight?dir=``;
+- the SLO regression sentinel (obs/slo.py): warm-up, fire/clear,
+  severity buckets, absolute SLOs, metrics counters;
+- the in-program operator row-count channel (exec/fragments.py):
+  bit-identity with ``operator_stats`` on/off across TPC-H Q1/Q5 and a
+  TPC-DS star join, plus reduction ratios landing in query history.
+"""
+
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+import zlib
+
+import pytest
+
+from trino_tpu.config import Session
+from trino_tpu.obs.flight import FlightRecorder, replay_dir
+from trino_tpu.obs.slo import SloSentinel
+from trino_tpu.testing import LocalQueryRunner
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+# ── journal format ──────────────────────────────────────────────────────
+
+
+class TestFlightJournal:
+    def test_roundtrip_and_query_filter(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path))
+        for i in range(10):
+            rec.record(f"q{i % 2}", "created", {"n": i})
+        assert rec.flush()
+        assert len(replay_dir(str(tmp_path))) == 10
+        q1 = replay_dir(str(tmp_path), "q1")
+        assert [e["n"] for e in q1] == [1, 3, 5, 7, 9]
+        assert all(e["queryId"] == "q1" and e["ts"] > 0 for e in q1)
+        rec.close()
+
+    def test_torn_tail_replays_intact_prefix(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path))
+        for i in range(5):
+            rec.record("q", "event", {"n": i})
+        rec.flush()
+        rec.close()
+        seg = sorted(tmp_path.iterdir())[-1]
+        body = json.dumps({"queryId": "q", "event": "torn"}).encode()
+        with open(seg, "ab") as f:  # SIGKILL mid-write: header + half body
+            f.write(struct.pack("<II", len(body), zlib.crc32(body)))
+            f.write(body[: len(body) // 2])
+        events = replay_dir(str(tmp_path))
+        assert [e["n"] for e in events] == [0, 1, 2, 3, 4]
+
+    def test_corrupt_record_ends_prefix(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path))
+        for i in range(4):
+            rec.record("q", "event", {"n": i})
+        rec.flush()
+        rec.close()
+        seg = sorted(tmp_path.iterdir())[-1]
+        data = bytearray(seg.read_bytes())
+        # flip a bit inside record 2's body (skip records 0 and 1)
+        off = 0
+        for _ in range(2):
+            length = struct.unpack_from("<II", data, off)[0]
+            off += 8 + length
+        data[off + 8 + 2] ^= 0xFF
+        seg.write_bytes(bytes(data))
+        events = replay_dir(str(tmp_path))
+        assert [e["n"] for e in events] == [0, 1]  # CRC stops the replay
+
+    def test_segment_roll_and_byte_budget(self, tmp_path):
+        rec = FlightRecorder(
+            str(tmp_path), max_bytes=4096, segment_bytes=1024
+        )
+        for i in range(200):
+            rec.record("q", "event", {"n": i, "pad": "x" * 64})
+        rec.flush()
+        segs = [p for p in tmp_path.iterdir() if p.suffix == ".seg"]
+        assert len(segs) > 1  # rolled
+        assert sum(p.stat().st_size for p in segs) < 3 * 4096
+        assert rec.segments_deleted > 0
+        # replay still yields a contiguous SUFFIX of what was written
+        events = replay_dir(str(tmp_path))
+        ns = [e["n"] for e in events]
+        assert ns == list(range(ns[0], 200))
+        rec.close()
+
+    def test_restart_never_appends_to_old_segment(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path))
+        rec.record("q", "before", {})
+        rec.flush()
+        rec.close()
+        old = sorted(tmp_path.iterdir())
+        rec2 = FlightRecorder(str(tmp_path))
+        rec2.record("q", "after", {})
+        rec2.flush()
+        rec2.close()
+        assert len(sorted(tmp_path.iterdir())) == len(old) + 1
+        assert [e["event"] for e in replay_dir(str(tmp_path))] == [
+            "before", "after",
+        ]
+
+
+# ── SIGKILL crash-safety, end to end ────────────────────────────────────
+
+# A real coordinator process: QueryManager journaling to flight_dir, one
+# query parked inside the engine ("mid-query"), killed with SIGKILL.
+_CHILD = r"""
+import os, sys, threading, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, sys.argv[1])
+from trino_tpu.config import Session
+from trino_tpu.server.querymanager import QueryManager
+
+class StuckEngine:
+    def execute_statement(self, sql, session):
+        time.sleep(600)  # parked "mid-query" until the SIGKILL
+
+qm = QueryManager(StuckEngine())
+session = Session(properties={"flight_dir": sys.argv[2]})
+q = qm.create_query("select 1", session)
+time.sleep(0.3)      # let the dispatch thread journal "running"
+q._flight.flush()
+print("READY " + q.query_id, flush=True)
+time.sleep(600)
+"""
+
+
+class TestFlightCrashSafety:
+    def test_sigkill_mid_query_then_replay_via_endpoint(self, tmp_path):
+        flight_dir = str(tmp_path / "flight")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CHILD, REPO_ROOT, flight_dir],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        try:
+            line = ""
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline()
+                if line.startswith("READY"):
+                    break
+            assert line.startswith("READY"), f"child never ready: {line!r}"
+            qid = line.split()[1]
+            os.kill(proc.pid, signal.SIGKILL)
+        finally:
+            proc.wait(timeout=30)
+
+        # intact-prefix replay straight off disk: the lifecycle up to the
+        # kill survives, and nothing claims the query completed
+        events = replay_dir(flight_dir, qid)
+        names = [e["event"] for e in events]
+        assert names[:2] == ["created", "running"]
+        assert "completed" not in names
+        assert events[0]["query"] == "select 1"
+
+        # a FRESH coordinator (restart) serves the dead process's journal
+        from trino_tpu.server.http import TrinoTpuServer
+
+        s = TrinoTpuServer().start()
+        try:
+            url = (
+                f"{s.base_uri}/v1/query/{qid}/flight?"
+                + urllib.parse.urlencode({"dir": flight_dir})
+            )
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = json.loads(r.read().decode())
+            assert body["queryId"] == qid
+            assert [e["event"] for e in body["events"]] == names
+        finally:
+            s.stop()
+
+
+# ── lifecycle events through the server ─────────────────────────────────
+
+
+class TestFlightLifecycle:
+    def test_completed_query_journals_stats(self, tmp_path):
+        from trino_tpu.server.http import TrinoTpuServer
+
+        flight_dir = str(tmp_path / "flight")
+        s = TrinoTpuServer().start()
+        try:
+            req = urllib.request.Request(
+                f"{s.base_uri}/v1/statement",
+                data=b"select 1",
+                method="POST",
+                headers={
+                    "X-Trino-User": "test",
+                    "X-Trino-Session": "flight_dir=" + flight_dir,
+                },
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.loads(r.read().decode())
+            qid = out["id"]
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                    f"{s.base_uri}/v1/query/{qid}", timeout=5
+                ) as r:
+                    if json.loads(r.read().decode())["state"] in (
+                        "FINISHED", "FAILED",
+                    ):
+                        break
+                time.sleep(0.05)
+            with urllib.request.urlopen(
+                f"{s.base_uri}/v1/query/{qid}/flight", timeout=10
+            ) as r:
+                body = json.loads(r.read().decode())
+        finally:
+            s.stop()
+        events = {e["event"]: e for e in body["events"]}
+        assert "created" in events and "completed" in events
+        done = events["completed"]
+        assert done["state"] == "FINISHED"
+        assert done["queryStats"]["elapsedMs"] >= 0
+        assert done["error"] is None
+        assert isinstance(done.get("spans"), list) and done["spans"]
+
+
+# ── SLO sentinel ────────────────────────────────────────────────────────
+
+
+def _session(**props):
+    return Session(properties=props)
+
+
+_BASELINE = {"elapsed_samples": [100.0, 100.0, 110.0, 90.0, 100.0]}
+
+
+class TestSloSentinel:
+    def test_warmup_below_min_samples_is_silent(self):
+        sen = SloSentinel()
+        v = sen.evaluate(
+            _session(), "fp1", 10_000.0,
+            {"elapsed_samples": [100.0, 100.0]},
+        )
+        assert v is None
+        assert sen.snapshot()["regressed"] == []
+
+    def test_fire_minor_then_clear(self):
+        sen = SloSentinel()
+        v = sen.evaluate(_session(), "fp1", 250.0, _BASELINE)
+        assert v is not None and v["severity"] == "minor"
+        assert v["magnitude"] == 2.5
+        assert v["baselineP50Ms"] == 100.0
+        assert [r["fingerprint"] for r in sen.snapshot()["regressed"]] == [
+            "fp1"
+        ]
+        # an in-bounds completion clears the flag
+        assert sen.evaluate(_session(), "fp1", 105.0, _BASELINE) is None
+        assert sen.snapshot()["regressed"] == []
+        assert sen.snapshot()["regressions"] == 1
+
+    def test_severity_buckets(self):
+        sen = SloSentinel()
+        minor = sen.evaluate(_session(), "fp", 300.0, _BASELINE)
+        severe = sen.evaluate(_session(), "fp", 450.0, _BASELINE)
+        assert minor["severity"] == "minor"
+        assert severe["severity"] == "severe"
+
+    def test_absolute_slo_violation(self):
+        sen = SloSentinel()
+        v = sen.evaluate(
+            _session(slo_elapsed_ms=50.0), "fp", 80.0, None
+        )
+        assert v == {
+            "sloViolation": 1, "sloElapsedMs": 50.0, "elapsedMs": 80.0,
+        }
+        assert sen.snapshot()["violations"] == 1
+
+    def test_metrics_counters(self):
+        from trino_tpu.obs.metrics import get_registry
+
+        sen = SloSentinel()
+        before = get_registry().snapshot()["counters"]
+        sen.evaluate(_session(), "fp", 500.0, _BASELINE)
+        sen.evaluate(_session(slo_elapsed_ms=10.0), "fp2", 20.0, None)
+        after = get_registry().snapshot()["counters"]
+
+        def delta(name):
+            return sum(
+                v for k, v in after.items() if k.startswith(name)
+            ) - sum(v for k, v in before.items() if k.startswith(name))
+
+        assert delta("trino_tpu_query_regressions_total") == 1
+        assert delta("trino_tpu_slo_violations_total") == 1
+
+    def test_slo_endpoint(self):
+        from trino_tpu.obs.slo import get_sentinel
+        from trino_tpu.server.http import TrinoTpuServer
+
+        get_sentinel().evaluate(
+            _session(), "fp-endpoint", 999.0, _BASELINE, query_id="q9"
+        )
+        s = TrinoTpuServer().start()
+        try:
+            with urllib.request.urlopen(
+                f"{s.base_uri}/v1/slo", timeout=10
+            ) as r:
+                body = json.loads(r.read().decode())
+        finally:
+            s.stop()
+            get_sentinel().reset()
+        fps = [row["fingerprint"] for row in body["regressed"]]
+        assert "fp-endpoint" in fps
+        row = body["regressed"][fps.index("fp-endpoint")]
+        assert row["queryId"] == "q9" and row["severity"] == "severe"
+
+
+# ── operator telemetry bit-identity ─────────────────────────────────────
+
+_STAR = """select i.i_category, d.d_year, sum(ss.ss_ext_sales_price) as s
+    from tpcds.tiny.store_sales ss
+    join tpcds.tiny.item i on ss.ss_item_sk = i.i_item_sk
+    join tpcds.tiny.date_dim d on ss.ss_sold_date_sk = d.d_date_sk
+    group by i.i_category, d.d_year order by i.i_category, d.d_year"""
+
+
+def _tpch(n):
+    from trino_tpu.benchmarks.tpch import queries
+
+    return queries("tpch.tiny")[n]
+
+
+class TestOperatorStatsBitIdentity:
+    @pytest.mark.parametrize(
+        "name,sql",
+        [
+            ("q1", "tpch:1"),
+            ("q5", "tpch:5"),
+            ("star", _STAR),
+        ],
+    )
+    def test_rows_identical_on_off(self, runner, name, sql):
+        if sql.startswith("tpch:"):
+            sql = _tpch(int(sql.split(":")[1]))
+        base = {"execution_mode": "distributed"}
+        on = runner.engine.execute_statement(
+            sql, Session(properties=dict(base))
+        )
+        off = runner.engine.execute_statement(
+            sql, Session(properties={**base, "operator_stats": False})
+        )
+        assert on.rows == off.rows
+        assert off.operator_stats is None
+        ops = on.operator_stats
+        assert ops, "operator telemetry missing with the channel on"
+        # restart-stable sites only, closed kind vocabulary, sane flow
+        kinds = {
+            "scan", "filter", "join", "semijoin", "partial-agg",
+            "final-agg", "agg", "exchange",
+        }
+        for site, ent in ops.items():
+            assert "@" in site, f"unstable site name {site!r}"
+            assert ent["kind"] in kinds
+            assert ent["rows_in"] >= 0 and ent["rows_out"] >= 0
+        assert any(e["kind"] == "scan" for e in ops.values())
+
+    def test_operator_stats_survive_explain_analyze(self, runner):
+        res = runner.engine.execute_statement(
+            "explain analyze select l_returnflag, count(*)"
+            " from tpch.tiny.lineitem group by l_returnflag",
+            Session(properties={"execution_mode": "distributed"}),
+        )
+        text = "\n".join(str(r[0]) for r in res.rows)
+        assert "Operators (in-program row flow" in text
+
+
+class TestOperatorHistoryFold:
+    def test_reduction_ratio_lands_in_history(self, tmp_path):
+        """Warm fingerprint history carries per-site EWMA'd rows and the
+        partial-agg reduction ratio (the mid-query-adaptivity signal),
+        and /v1/history's snapshot shape serves it."""
+        props = {
+            "execution_mode": "distributed",
+            "history_dir": str(tmp_path),
+        }
+        r = LocalQueryRunner()
+        sql = ("select l_returnflag, count(*) c from tpch.tiny.lineitem"
+               " group by l_returnflag")
+        for _ in range(2):
+            r.engine.execute_statement(sql, Session(properties=dict(props)))
+        snap = r.engine.history_snapshot()
+        entries = snap["stores"][0]["fingerprints"]
+        ops = entries[0].get("operators") or {}
+        assert ops, "history entry has no operators block"
+        pagg = [
+            ent for ent in ops.values()
+            if ent.get("kind") == "partial-agg"
+        ]
+        assert pagg and all(
+            0 < ent["reduction_ratio"] <= 1.0 for ent in pagg
+        )
+        assert all("rows_in" in ent and "rows_out" in ent for ent in pagg)

@@ -284,7 +284,8 @@ class ResultCache:
         try:
             from trino_tpu.obs.metrics import get_registry
 
-            get_registry().counter(f"trino_tpu_result_cache_{name}").inc(n)
+            # closed vocabulary: callers pass literal suffixes only
+            get_registry().counter(f"trino_tpu_result_cache_{name}").inc(n)  # lint: ignore[OBS002]
         except Exception:  # noqa: BLE001 — metrics must never fail a query
             pass
 

@@ -258,6 +258,30 @@ class Session:
         # (satellite of the same observability story: coordinator memory
         # under sustained traffic)
         ("query_manager_max_history", 100),
+        # --- operator telemetry (exec/fragments.py tracer) ------------------
+        # per-operator input/output row counters minted inside the traced
+        # program (scan/filter/join/agg/exchange), riding the existing
+        # deferred-counter pull: zero extra D2H round trips, bit-identical
+        # results on/off. Unlike device_profiling this IS part of the
+        # canonical-plan fingerprint — the extra reductions change the
+        # compiled program.
+        ("operator_stats", True),
+        # --- flight recorder (obs/flight.py) --------------------------------
+        # crash-safe on-disk journal of query lifecycle events; "" disables
+        # journaling (tier-1 default: no cross-process state)
+        ("flight_dir", ""),
+        ("flight_max_bytes", 16 << 20),
+        ("flight_segment_bytes", 1 << 20),
+        # --- SLO regression sentinel (obs/slo.py) ---------------------------
+        # absolute elapsed-time SLO per query in ms; 0 = no absolute SLO
+        # (history-relative regressions still fire)
+        ("slo_elapsed_ms", 0.0),
+        # a completion regresses when elapsed > multiplier * the
+        # fingerprint's history p50 baseline (severe at severe_multiplier),
+        # once the baseline holds at least slo_min_samples samples
+        ("slo_regression_multiplier", 2.0),
+        ("slo_severe_multiplier", 4.0),
+        ("slo_min_samples", 3),
     )
 
     def get(self, name: str) -> Any:

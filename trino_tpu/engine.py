@@ -69,6 +69,15 @@ class StatementResult:
     # incrementalMaintenance/deltaSplits when a statement was served (or
     # maintained) from the coordinator result cache; None on real runs
     result_cache_stats: Optional[dict[str, Any]] = None
+    # in-program operator telemetry (exec/fragments.py op! channel):
+    # {stable_site: {kind, rows_in, rows_out}} — surfaced in /v1/query as
+    # ``operatorStats`` and as per-operator EXPLAIN ANALYZE rows; None
+    # when operator_stats is off or nothing traced
+    operator_stats: Optional[dict[str, Any]] = None
+    # SLO sentinel verdict (obs/slo.py): regression/violation record vs
+    # the fingerprint's history baseline — surfaced as
+    # ``queryStats.regression``; None when within baseline or cold
+    regression: Optional[dict[str, Any]] = None
 
 
 class Engine:
@@ -400,6 +409,20 @@ class Engine:
                 "shuffle_rows": int(ex.get("shuffle_rows", 0) or 0),
                 "capacities": caps,
             }
+            ops: dict[str, dict] = {}
+            for site, ent in (ex.get("operators") or {}).items():
+                if not isinstance(ent, dict) or "@" not in str(site):
+                    continue
+                ops[str(site)] = {
+                    "kind": str(ent.get("kind", "")),
+                    "rows_in": int(ent.get("rows_in", 0) or 0),
+                    "rows_out": int(ent.get("rows_out", 0) or 0),
+                }
+            if ops:
+                # the partial-agg reduction-ratio seed the mid-query
+                # adaptivity roadmap item reads (EWMA'd per site in
+                # obs/history.py)
+                observed["operators"] = ops
             flops = ds.get("total_flops")
             if isinstance(flops, (int, float)):
                 observed["flops"] = float(flops)
@@ -409,6 +432,29 @@ class Engine:
             if bs.get("batchSize"):
                 observed["batch_size"] = int(bs["batchSize"])
             hist.record(fp, observed)
+        except Exception:  # noqa: BLE001
+            pass
+
+    @staticmethod
+    def _sentinel_check(
+        session, fp, res, elapsed_ms: float, hist_entry, query_id=None
+    ) -> None:
+        """Judge this completion against the fingerprint's PRE-run history
+        baseline and configured SLOs (obs/slo.py); the verdict rides the
+        result as ``regression`` → queryStats. Best-effort like history:
+        the sentinel must never fail the query it observes."""
+        try:
+            from trino_tpu.obs.slo import get_sentinel
+
+            verdict = get_sentinel().evaluate(
+                session,
+                fp,
+                elapsed_ms,
+                hist_entry,
+                query_id=query_id,
+            )
+            if res is not None and verdict is not None:
+                res.regression = verdict
         except Exception:  # noqa: BLE001
             pass
 
@@ -539,7 +585,20 @@ class Engine:
             if key == "batchedQueries":
                 continue
             if isinstance(val, (int, float)) and not isinstance(val, bool):
-                reg.counter(f"trino_tpu_exchange_{key}_total").inc(val)
+                # key = exchange stat field names, a closed vocabulary
+                reg.counter(f"trino_tpu_exchange_{key}_total").inc(val)  # lint: ignore[OBS002]
+        for ent in (res.operator_stats or {}).values():
+            # kind is a closed vocabulary minted by the tracer
+            # (scan/filter/join/semijoin/partial-agg/final-agg/agg/exchange)
+            if isinstance(ent, dict) and ent.get("kind"):
+                reg.counter(
+                    "trino_tpu_operator_rows_total",
+                    kind=ent["kind"], io="in",
+                ).inc(int(ent.get("rows_in", 0) or 0))
+                reg.counter(
+                    "trino_tpu_operator_rows_total",
+                    kind=ent["kind"], io="out",
+                ).inc(int(ent.get("rows_out", 0) or 0))
         ds = res.device_stats or {}
         if isinstance(ds.get("total_flops"), (int, float)):
             reg.counter("trino_tpu_query_flops_total").inc(ds["total_flops"])
@@ -777,9 +836,12 @@ class Engine:
                     params,
                     query_id or self._next_query_id(),
                 )
-                self._history_record(
-                    hist, fp, res, (_time.monotonic() - t0) * 1000.0
+                elapsed_ms = (_time.monotonic() - t0) * 1000.0
+                self._sentinel_check(
+                    session, fp, res, elapsed_ms, hist_entry,
+                    query_id=query_id,
                 )
+                self._history_record(hist, fp, res, elapsed_ms)
                 if isinstance(res.exchange_stats, dict):
                     res.exchange_stats["history_hits"] = (
                         1 if hist_entry is not None else 0
@@ -808,9 +870,12 @@ class Engine:
                     exec_plan, session, query_id=query_id,
                     programs=programs, params=params, history=hist_entry,
                 )
-                self._history_record(
-                    hist, fp, res, (_time.monotonic() - t0) * 1000.0
+                elapsed_ms = (_time.monotonic() - t0) * 1000.0
+                self._sentinel_check(
+                    session, fp, res, elapsed_ms, hist_entry,
+                    query_id=query_id,
                 )
+                self._history_record(hist, fp, res, elapsed_ms)
                 if isinstance(res.exchange_stats, dict):
                     # did a prior run of this fingerprint inform this one?
                     # (surfaced as queryStats.historyHits on /v1/query)
@@ -899,6 +964,9 @@ class Engine:
                     device_stats=cluster_stats.get("deviceStats"),
                     exchange_stats=cluster_stats.get("exchangeStats"),
                     ingest_stats=cluster_stats.get("ingestStats"),
+                    operator_stats=(
+                        cluster_stats.get("exchangeStats") or {}
+                    ).get("operators"),
                 )
         ctx = QueryMemoryContext(
             self.memory_pool,
@@ -933,6 +1001,7 @@ class Engine:
                 program_cache_misses=int(cs.get("program_cache_misses", 0)),
                 device_stats=dsnap() if callable(dsnap) else None,
                 ingest_stats=executor.ingest_stats_snapshot(),
+                operator_stats=(exchange_stats or {}).get("operators"),
             )
         finally:
             ctx.close()
@@ -999,6 +1068,7 @@ class Engine:
                     ),
                     device_stats=device_stats,
                     ingest_stats=ingest_stats,
+                    operator_stats=(exchange_stats or {}).get("operators"),
                 )
                 for batch in batches
             ]
@@ -1141,6 +1211,12 @@ class Engine:
                     from trino_tpu.stats import render_capacity_stats
 
                     text += "\n\n" + render_capacity_stats(ex_caps)
+                if res.operator_stats:
+                    from trino_tpu.stats import render_operator_stats
+
+                    text += "\n\n" + render_operator_stats(
+                        res.operator_stats
+                    )
                 wall_ms = collector.total_wall() * 1000
             text += (
                 f"\n\npeak memory: {res.peak_memory_bytes} bytes"

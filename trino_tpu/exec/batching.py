@@ -241,7 +241,8 @@ class BatchCollector:
         # sum(size*n)/sum(n), so solo dispatches must stay in the
         # denominator
         get_registry().counter(
-            "trino_tpu_batched_dispatches_total", size=str(k)
+            # size is bounded by batch_max_queries (a handful of values)
+            "trino_tpu_batched_dispatches_total", size=str(k)  # lint: ignore[OBS001]
         ).inc()
         if k < 2:
             return

@@ -547,6 +547,9 @@ class FragmentedExecutor(DistributedExecutor):
             "program_cache_hits": 0,
             "program_cache_misses": 0,
         }
+        # per-query operator telemetry accumulated off the op! counter
+        # channel: {stable_site: {kind, rows_in, rows_out}}
+        self.operator_stats: dict[str, dict] = {}
         # per-query: replicated hot-key tables exported by probe-side
         # exchanges, keyed by producer fragment id (device arrays)
         self._hot_sets: dict[int, tuple] = {}
@@ -678,13 +681,16 @@ class FragmentedExecutor(DistributedExecutor):
         dynamic-filter rewrites — so history keys them by kind, fragment
         id, and walk ordinal instead (``agg@3#0``), which is stable for a
         given fingerprint. ``semi`` sites are minted on Join nodes (the
-        semi/mark-join exec path), so each Join registers both."""
+        semi/mark-join exec path), so each Join registers both. Scan and
+        filter sites carry no capacities — they exist so the operator
+        row counters (the ``op!`` channel) key those nodes by the same
+        restart-stable scheme."""
         sites = {
             f"exch{frag.id}": f"exch@{frag.id}",
             f"spill{frag.id}": f"spill@{frag.id}",
             f"hot{frag.id}": f"hot@{frag.id}",
         }
-        agg_k = join_k = 0
+        agg_k = join_k = scan_k = filter_k = 0
         for node in P.walk_plan(frag.root):
             if isinstance(node, P.Aggregate):
                 sites[f"agg{id(node)}"] = f"agg@{frag.id}#{agg_k}"
@@ -694,6 +700,12 @@ class FragmentedExecutor(DistributedExecutor):
                 sites[f"semi{id(node)}"] = f"semi@{frag.id}#{join_k}"
                 sites[f"densejoin{id(node)}"] = f"densejoin@{frag.id}#{join_k}"
                 join_k += 1
+            elif isinstance(node, P.TableScan):
+                sites[f"opscan{id(node)}"] = f"scan@{frag.id}#{scan_k}"
+                scan_k += 1
+            elif isinstance(node, P.Filter):
+                sites[f"opfilter{id(node)}"] = f"filter@{frag.id}#{filter_k}"
+                filter_k += 1
         return sites
 
     def _seed_history(self, frag: PlanFragment, caps: "_Caps") -> None:
@@ -828,6 +840,19 @@ class FragmentedExecutor(DistributedExecutor):
                 st["salted_rows"] += int(v)
             elif nm.startswith("hotkeys"):
                 st["hot_keys"] += int(v)
+            elif nm.startswith("op!"):
+                # operator row counters: op!{kind}!{in|out}!{stable_site},
+                # minted with the restart-stable site resolved at trace
+                # time (deferred entries don't carry the _Caps site map)
+                _, kind, io, site = nm.split("!", 3)
+                ent = self.operator_stats.get(site)
+                if ent is None:
+                    ent = self.operator_stats[site] = {
+                        "kind": kind,
+                        "rows_in": 0,
+                        "rows_out": 0,
+                    }
+                ent["rows_in" if io == "in" else "rows_out"] += int(v)
 
     def exchange_stats_snapshot(self) -> dict:
         """Finalized per-query exchange counters (engine attaches this to
@@ -868,6 +893,13 @@ class FragmentedExecutor(DistributedExecutor):
         # join engine v2: chosen kernel per join site (sort / dense /
         # matmul, including demotions observed during the retry ladder)
         st["joinStrategy"] = join_strategy
+        if self.operator_stats:
+            # per-operator row flow keyed by restart-stable site; batched
+            # dispatches sum across stacked members (one program, K
+            # queries), which the rollups document as combined flow
+            st["operators"] = {
+                site: dict(ent) for site, ent in self.operator_stats.items()
+            }
         return st
 
     def ingest_stats_snapshot(self):
@@ -2461,6 +2493,11 @@ class _FragmentTracer(DistributedExecutor):
         # replicated hot-key tables exported for the peer build exchange
         self.aux_out: tuple = ()
         self._memo: dict[int, Result] = {}
+        # operator telemetry: per-node traced row counts appended to the
+        # shared counter channel (pulled with the overflow flags — zero
+        # extra host round trips). Off -> no extra ops traced at all.
+        self._op_enabled = bool(base.session.get("operator_stats"))
+        self._op_rowcounts: dict[int, jax.Array] = {}
 
     @property
     def n(self) -> int:
@@ -2470,6 +2507,7 @@ class _FragmentTracer(DistributedExecutor):
         key = id(node)
         if key not in self._memo:
             self._memo[key] = self._dispatch(node)
+            self._op_count(node)
         return self._memo[key]
 
     def _dispatch(self, node: P.PlanNode) -> Result:
@@ -2477,6 +2515,58 @@ class _FragmentTracer(DistributedExecutor):
         if method is None:
             raise FusedUnsupported(type(node).__name__)
         return method(node)
+
+    # --- operator telemetry (op! counter channel) -----------------------
+
+    def _op_rows(self, node: P.PlanNode) -> jax.Array:
+        """Traced selected-row count of a memoized node result, computed
+        once per node regardless of how many parents (or the in/out pair)
+        reference it."""
+        key = id(node)
+        r = self._op_rowcounts.get(key)
+        if r is None:
+            sel = self._memo[key].batch.selection_mask()
+            r = jnp.sum(sel.astype(jnp.int64))
+            self._op_rowcounts[key] = r
+        return r
+
+    def _op_count(self, node: P.PlanNode) -> None:
+        """Mint per-operator input/output row counters for the just-memoized
+        node. Counters ride the existing deferred pull: per-shard partial
+        sums are pure reductions XLA folds into the program, so results
+        stay bit-identical with telemetry on or off and no new D2H round
+        trip is issued. Site names resolve at trace time via the _Caps
+        site map (always registered by _seed_history), so deferred
+        accumulation needs no capture context."""
+        if not self._op_enabled:
+            return
+        if isinstance(node, P.Aggregate):
+            kind = {
+                "partial": "partial-agg",
+                "final": "final-agg",
+            }.get(node.step, "agg")
+            site = self.caps.sites.get(f"agg{id(node)}")
+        elif isinstance(node, P.Join):
+            kind = "semijoin" if node.join_type in ("SEMI", "ANTI") else "join"
+            site = self.caps.sites.get(f"join{id(node)}")
+        elif isinstance(node, P.TableScan):
+            kind, site = "scan", self.caps.sites.get(f"opscan{id(node)}")
+        elif isinstance(node, P.Filter):
+            kind, site = "filter", self.caps.sites.get(f"opfilter{id(node)}")
+        else:
+            return
+        if site is None:
+            return  # node not registered (e.g. synthetic rewrite artifact)
+        sources = [] if isinstance(node, P.TableScan) else list(node.sources)
+        if sources and all(id(s) in self._memo for s in sources):
+            rows_in = self._op_rows(sources[0])
+            for s in sources[1:]:
+                rows_in = rows_in + self._op_rows(s)
+        else:
+            # leaves count their own batch as input (scan in == out)
+            rows_in = self._op_rows(node)
+        self.counters.append((f"op!{kind}!in!{site}", rows_in))
+        self.counters.append((f"op!{kind}!out!{site}", self._op_rows(node)))
 
     # --- leaves ---------------------------------------------------------
 
@@ -3376,6 +3466,7 @@ class _FragmentTracer(DistributedExecutor):
         if frag.output_exchange == "broadcast":
             out, out_sel = X.broadcast_all(self.mesh, arrays, sel)
             cols = rebuild(out)
+            self._op_exchange(frag, sel, out_sel)
             return Result(
                 Batch(cols, cols[0].data.shape[0], out_sel), res.layout
             )
@@ -3450,4 +3541,21 @@ class _FragmentTracer(DistributedExecutor):
             + n * wire_slots * row_bytes
         )
         cols = rebuild(out)
+        self._op_exchange(frag, sel, out_sel)
         return Result(Batch(cols, cols[0].data.shape[0], out_sel), res.layout)
+
+    def _op_exchange(self, frag: PlanFragment, sel_in, sel_out) -> None:
+        """Exchange leg of the op! channel: rows offered to the exchange
+        vs rows landed after repartition/broadcast (broadcast lands n×
+        copies — the fan-out is the signal). The in/out pair around a
+        partial-agg producer is the per-exchange reduction-ratio seed the
+        mid-query-adaptivity roadmap item reads from history."""
+        if not self._op_enabled:
+            return
+        site = self.caps.sites.get(f"exch{frag.id}", f"exch@{frag.id}")
+        self.counters.append(
+            (f"op!exchange!in!{site}", jnp.sum(sel_in.astype(jnp.int64)))
+        )
+        self.counters.append(
+            (f"op!exchange!out!{site}", jnp.sum(sel_out.astype(jnp.int64)))
+        )

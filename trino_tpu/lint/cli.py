@@ -14,7 +14,7 @@ from collections import Counter
 from pathlib import Path
 from typing import Optional
 
-from trino_tpu.lint import concurrency, jit_safety
+from trino_tpu.lint import concurrency, jit_safety, obs_metrics
 from trino_tpu.lint.jit_safety import (
     BASELINE_PATH,
     DEFAULT_PATHS,
@@ -27,6 +27,7 @@ from trino_tpu.lint.jit_safety import (
 FAMILIES = {
     "jit": jit_safety.lint_paths,
     "concurrency": concurrency.lint_paths,
+    "obs": obs_metrics.lint_paths,
 }
 
 
@@ -96,7 +97,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
     if args.only:
         # compare only against this family's slice of the baseline
-        prefixes = {"jit": ("JIT",), "concurrency": ("CONC", "LOOP", "LOCK", "THRD")}
+        prefixes = {
+            "jit": ("JIT",),
+            "concurrency": ("CONC", "LOOP", "LOCK", "THRD"),
+            "obs": ("OBS",),
+        }
         keep = prefixes[args.only]
         baseline = {
             "version": baseline.get("version", 1),

@@ -182,7 +182,8 @@ class QueryHistoryStore:
         aggregate entry and flush. ``observed`` keys (all optional):
         elapsed_ms, rows, overflow_retries, compile_halvings,
         padding_ratio, shuffle_rows, flops, peak_hbm_bytes, batch_size,
-        capacities ({stable_site: {value, provenance}})."""
+        capacities ({stable_site: {value, provenance}}),
+        operators ({stable_site: {kind, rows_in, rows_out}})."""
         from trino_tpu.server.eventloop import assert_not_loop_thread
 
         # record() flushes the JSON document to disk under _lock; callers
@@ -242,6 +243,34 @@ class QueryHistoryStore:
                     # the bigger shape failed to compile/allocate
                     val = max(val, int(old.get("value", 0)))
                 ent["capacities"][site] = {"value": val, "provenance": prov}
+            for site, op in (observed.get("operators") or {}).items():
+                # per-operator row flow as EWMAs; reduction_ratio on a
+                # partial-agg/exchange site is the seed the mid-query
+                # adaptive-execution roadmap item (a) consumes
+                try:
+                    rin = int(op.get("rows_in", 0) or 0)
+                    rout = int(op.get("rows_out", 0) or 0)
+                    kind = str(op.get("kind", ""))
+                except (AttributeError, TypeError, ValueError):
+                    continue
+                ops = ent.setdefault("operators", {})
+                old = ops.get(site) or {}
+                rec = {
+                    "kind": kind or old.get("kind", ""),
+                    "rows_in": round(_ewma(old.get("rows_in"), float(rin)), 1),
+                    "rows_out": round(
+                        _ewma(old.get("rows_out"), float(rout)), 1
+                    ),
+                }
+                if rin > 0:
+                    # significant digits, not decimal places: a 3/60175
+                    # partial-agg reduction must not round to 0.0
+                    rec["reduction_ratio"] = float(
+                        "%.4g" % _ewma(old.get("reduction_ratio"), rout / rin)
+                    )
+                elif "reduction_ratio" in old:
+                    rec["reduction_ratio"] = old["reduction_ratio"]
+                ops[site] = rec
             self.records += 1
             self._evict_locked()
             self._flush_locked()

@@ -81,6 +81,10 @@ _CODEGEN_PROPS = (
     "join_reordering_strategy",
     "join_strategy",
     "matmul_join_max_domain",
+    # operator telemetry mints extra traced reductions (op! counters), so
+    # on/off runs of one plan compile different programs — unlike
+    # device_profiling, which observes the SAME program from outside
+    "operator_stats",
     # fusion regroups fragments into multi-fragment programs, and the
     # grouping itself is cached per entry (__fusedunits__), so fused and
     # unfused runs of the same plan must not share a fingerprint

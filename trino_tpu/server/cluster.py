@@ -1637,7 +1637,8 @@ class ClusterScheduler:
             if obs is not None:
                 obs["elapsed"].setdefault(frag_id, []).append(elapsed_ms)
             reg.histogram(
-                "trino_tpu_task_elapsed_ms", stage=str(frag_id)
+                # fragment ids restart at 0 per plan: a bounded domain
+                "trino_tpu_task_elapsed_ms", stage=str(frag_id)  # lint: ignore[OBS001]
             ).observe(elapsed_ms)
         if t.span is not None:
             attrs = {"state": state, "elapsedMs": elapsed_ms}
@@ -1739,7 +1740,8 @@ class ClusterScheduler:
             )
             stages.append(entry)
             reg.histogram(
-                "trino_tpu_stage_elapsed_ms", stage=str(fid)
+                # fragment ids restart at 0 per plan: a bounded domain
+                "trino_tpu_stage_elapsed_ms", stage=str(fid)  # lint: ignore[OBS001]
             ).observe(elapsed_ms)
             obs["stage_spans"][fid].finish(
                 status="OK" if ok else "ERROR",
@@ -1768,12 +1770,15 @@ class ClusterScheduler:
         exchange_totals: dict = {}
         total_caps: dict = {}
         join_strategy: dict = {}
+        total_operators: dict = {}
         for entry in stages:
             for k, v in (entry.get("exchange") or {}).items():
                 if k == "capacities" and isinstance(v, dict):
                     total_caps.update(v)  # site names are per-stage unique
                 elif k == "joinStrategy" and isinstance(v, dict):
                     join_strategy.update(v)  # ditto: densejoin@{fid}#{ord}
+                elif k == "operators" and isinstance(v, dict):
+                    total_operators.update(v)  # ditto: scan@{fid}#{ord}
                 elif k != "padding_ratio" and isinstance(
                     v, (int, float)
                 ) and not isinstance(v, bool):
@@ -1782,6 +1787,8 @@ class ClusterScheduler:
             exchange_totals["capacities"] = total_caps
         if join_strategy:
             exchange_totals["joinStrategy"] = join_strategy
+        if total_operators:
+            exchange_totals["operators"] = total_operators
         round_trips = sum(e.get("attempts", 0) for e in stages)
         if exchange_totals or round_trips:
             exchange_totals["dispatchRoundTrips"] = round_trips
@@ -1828,6 +1835,7 @@ class ClusterScheduler:
         exchange: dict = {}
         exchange_caps: dict = {}
         exchange_join: dict = {}
+        exchange_ops: dict = {}
         ingest: dict = {}
         for t in tasks:
             st = t.last_status or {}
@@ -1871,6 +1879,22 @@ class ClusterScheduler:
             js = (ts.get("exchange") or {}).get("joinStrategy")
             if isinstance(js, dict):
                 exchange_join.update(js)
+            # operator row counters sum across sibling tasks: each task
+            # saw a disjoint partition of the stage's rows
+            for site, ent in ((ts.get("exchange") or {}).get(
+                "operators"
+            ) or {}).items():
+                if not isinstance(ent, dict):
+                    continue
+                acc = exchange_ops.get(site)
+                if acc is None:
+                    acc = exchange_ops[site] = {
+                        "kind": ent.get("kind", ""),
+                        "rows_in": 0,
+                        "rows_out": 0,
+                    }
+                acc["rows_in"] += int(ent.get("rows_in", 0) or 0)
+                acc["rows_out"] += int(ent.get("rows_out", 0) or 0)
             for k, v in (ts.get("ingest") or {}).items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     ingest[k] = ingest.get(k, 0) + v
@@ -1895,6 +1919,8 @@ class ClusterScheduler:
             exchange["capacities"] = exchange_caps
         if exchange_join:
             exchange["joinStrategy"] = exchange_join
+        if exchange_ops:
+            exchange["operators"] = exchange_ops
         if exchange:
             if exchange.get("shuffle_rows"):
                 exchange["padding_ratio"] = round(

@@ -836,6 +836,13 @@ class TrinoTpuServer:
             return responder.respond(json_response(
                 rc.snapshot() if rc is not None else {"entries": []}
             ))
+        if path == "/v1/slo":
+            # SLO regression sentinel (obs/slo.py): currently-regressed
+            # fingerprints with magnitudes + process counters. Brief lock
+            # only — same loop-thread discipline as /v1/metrics.
+            from trino_tpu.obs.slo import get_sentinel
+
+            return responder.respond(json_response(get_sentinel().snapshot()))
         if path == "/v1/query":
             return responder.respond(json_response(
                 [q.info() for q in self.query_manager.queries()]
@@ -856,6 +863,30 @@ class TrinoTpuServer:
             return responder.respond(
                 json_response({"queryId": parts[2], "spans": spans})
             )
+        if (
+            len(parts) == 4
+            and parts[:2] == ["v1", "query"]
+            and parts[3] == "flight"
+        ):
+            # flight-journal replay for one query (obs/flight.py). A
+            # restarted coordinator serves the pre-crash journal via
+            # ?dir= (its in-memory query registry is gone, so no 404
+            # gating on the query manager). Replay flushes + reads
+            # files — offloaded off the loop thread.
+            qid = parts[2]
+            directory = qs.get("dir", [""])[0]
+
+            def read_flight() -> Response:
+                from trino_tpu.obs import flight as flight_mod
+
+                events = flight_mod.replay_known(qid, directory or None)
+                if not events and self.query_manager.get(qid) is None:
+                    return json_response(
+                        {"error": "no flight records for query"}, 404
+                    )
+                return json_response({"queryId": qid, "events": events})
+
+            return self._offload(responder, read_flight, ceiling=False)
         if len(parts) == 3 and parts[:2] == ["v1", "query"]:
             q = self.query_manager.get(parts[2])
             if q is None:

@@ -104,6 +104,21 @@ class ManagedQuery:
             trace_id=self.query_id,
             attrs={"queryId": self.query_id, "user": session.user},
         )
+        # flight recorder (obs/flight.py): crash-safe lifecycle journal.
+        # None when flight_dir is unset; every _flight() call is
+        # non-blocking (queue put) so cancel()/admission callbacks may
+        # journal from loop threads
+        from trino_tpu.obs import flight as _flight_mod
+
+        self._flight = _flight_mod.for_session(session)
+        self._flight_event(
+            "created", query=sql, user=session.user,
+            source=getattr(session, "source", None),
+        )
+
+    def _flight_event(self, event: str, **payload: Any) -> None:
+        if self._flight is not None:
+            self._flight.record(self.query_id, event, payload)
 
     def touch(self) -> None:
         self.last_access = time.monotonic()
@@ -141,6 +156,11 @@ class ManagedQuery:
             if self._cancelled.is_set():
                 return
             self.state.set(QueryState.RUNNING)
+            self._flight_event(
+                "running",
+                queuedMs=int((self._start_mono_ts - self._create_mono) * 1000),
+                maxAttempts=max_attempts,
+            )
             attempt = 1
             with tracer.activate(self.span):
                 while True:
@@ -156,6 +176,10 @@ class ManagedQuery:
                             or not is_retryable(e)
                         ):
                             raise
+                        self._flight_event(
+                            "retry", attempt=attempt + 1,
+                            error=str(e), errorClass=type(e).__name__,
+                        )
                         time.sleep(backoff.delay(attempt))
                         attempt += 1
                         self.query_attempts = attempt
@@ -209,6 +233,7 @@ class ManagedQuery:
             status="OK" if st == QueryState.FINISHED else "ERROR",
             state=st.value,
         )
+        self._flight_completed(st, wall)
         eng = engine or self._engine
         listeners = getattr(eng, "event_listeners", None)
         if listeners is None:
@@ -230,6 +255,47 @@ class ManagedQuery:
             )
         )
 
+    def _flight_completed(self, st: "QueryState", wall_s: float) -> None:
+        """Journal the terminal post-mortem record: enough that the flight
+        journal ALONE explains how the query ended — state, error
+        classification, retry/recovery accounting, queryStats,
+        operatorStats, and the span tree (when a sink retained it)."""
+        if self._flight is None:
+            return
+        cluster_stats = self.result.cluster_stats if self.result else {}
+        elapsed = (self._end_mono or time.monotonic()) - self._create_mono
+        err = self.error.to_json() if self.error else None
+        if err is not None:
+            # classification only — the full stack would bloat the
+            # bounded journal without aiding post-mortem triage
+            err.pop("failureInfo", None)
+        spans = None
+        try:
+            for sink in getattr(get_tracer(), "_sinks", []):
+                spans_for = getattr(sink, "spans_for", None)
+                if spans_for is not None:
+                    spans = spans_for(self.query_id)
+                    break
+        except Exception:  # noqa: BLE001
+            spans = None
+        self._flight_event(
+            "completed",
+            state=st.value,
+            wallMs=int(wall_s * 1000),
+            queryAttempts=self.query_attempts,
+            taskRetries=cluster_stats.get("task_retries", 0),
+            recoveredTasks=cluster_stats.get("recovered_tasks", 0),
+            recoveredTaskLevels=cluster_stats.get("recovered_levels", {}),
+            spooledBytes=cluster_stats.get("spooled_bytes", 0),
+            queryStats=self._query_stats(elapsed, cluster_stats),
+            operatorStats=(
+                getattr(self.result, "operator_stats", None)
+                if self.result else None
+            ),
+            error=err,
+            spans=spans,
+        )
+
     def cancel(self, message: str = "Query was canceled") -> None:
         self._cancelled.set()
         abandon = self._admission_abandon
@@ -240,6 +306,7 @@ class ManagedQuery:
             except Exception:  # noqa: BLE001
                 pass
         if self.state.set(QueryState.CANCELED):
+            self._flight_event("canceled", message=message)
             self.error = ErrorInfo(message, 1, "USER_CANCELED", "USER_ERROR")
             self.end_time = time.time()
             self._end_mono = time.monotonic()
@@ -265,6 +332,7 @@ class ManagedQuery:
         ``ClusterMemoryManager.java:104`` killQuery)."""
         self._cancelled.set()
         if self.state.set(QueryState.FAILED):
+            self._flight_event("killed", message=message)
             self.error = ErrorInfo(
                 message, 131081, "CLUSTER_OUT_OF_MEMORY",
                 "INSUFFICIENT_RESOURCES",
@@ -319,6 +387,12 @@ class ManagedQuery:
             # skew-aware exchange counters (shuffle rows/bytes, padding
             # ratio, overflow retries, hot/salted keys, capacity provenance)
             "exchangeStats": self.result.exchange_stats if self.result else None,
+            # in-program operator telemetry (exec/fragments.py op!
+            # channel): per-site row flow, cluster-merged across workers
+            "operatorStats": (
+                getattr(self.result, "operator_stats", None)
+                if self.result else None
+            ),
             # columnar ingest tier (trino_tpu/ingest.py): split decode
             # wall, coalesced H2D bytes, device-table-cache hits/misses —
             # a warm repeat scan shows h2d_bytes == 0
@@ -375,6 +449,13 @@ class ManagedQuery:
             # coordinator result cache
             "resultCacheHit": rc.get("resultCacheHit", 0),
             "resultCacheMaintained": rc.get("incrementalMaintenance", 0),
+            # SLO sentinel (obs/slo.py): the regression verdict the
+            # engine attached at completion (None = within baseline or
+            # sentinel off/cold)
+            "regression": (
+                getattr(self.result, "regression", None)
+                if self.result else None
+            ),
             "speculativeAttempts": cluster_stats.get("speculative_attempts", 0),
             "speculativeWins": cluster_stats.get("speculative_wins", 0),
             "recoveredTasks": cluster_stats.get("recovered_tasks", 0),
@@ -641,6 +722,9 @@ class QueryManager:
             if err is not None:
                 self._reject(q, err)
                 return
+            q._flight_event(
+                "admitted", group=getattr(group, "name", None), queued=True
+            )
             try:
                 self._pool.submit(self._run_admitted, q, group)
             except RuntimeError:  # pool shut down: give the slot back
@@ -671,8 +755,14 @@ class QueryManager:
             self._reject(q, e)
             return
         if admitted:
+            q._flight_event(
+                "admitted", group=getattr(group, "name", None), queued=False
+            )
             self._pool.submit(self._run_admitted, q, group)
         else:
+            q._flight_event(
+                "queued", group=getattr(group, "name", None)
+            )
             # let cancel() free the queue slot if the client abandons the
             # query before a slot opens (resource-group doubles may lack
             # abandon(); getattr keeps them working)
@@ -736,6 +826,9 @@ class QueryManager:
             # their QUERY_REJECTED surface; classified errors — the
             # history HBM gate's EXCEEDED_MEMORY_LIMIT — pass through
             code, name, typ = 3, "QUERY_REJECTED", "USER_ERROR"
+        q._flight_event(
+            "rejected", error=str(e), errorName=name, errorType=typ
+        )
         q.error = ErrorInfo(str(e), code, name, typ)
         q.state.set(QueryState.FAILED)
         q.end_time = time.time()

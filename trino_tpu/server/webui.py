@@ -156,6 +156,43 @@ function renderStages(q) {
     rows.join('') + '</table>';
 }
 
+// per-operator row flow (operatorStats: the in-program op! counter
+// channel, cluster-merged). Sites are restart-stable `kind@stage#ord`
+// names; rows group under their stage so the table reads top-down in
+// the same order as the span waterfall above it.
+function renderOperators(q) {
+  const ops = q.operatorStats || {};
+  const sites = Object.keys(ops);
+  if (!sites.length) return '';
+  const esc = s => String(s).replace(/&/g, '&amp;').replace(/</g, '&lt;');
+  const num = v => (v === null || v === undefined) ? '' :
+      Number(v).toLocaleString();
+  const stageOf = site => {
+    const m = site.match(/@(\\d+)/);
+    return m ? Number(m[1]) : 1e9;
+  };
+  sites.sort((a, b) => stageOf(a) - stageOf(b) || a.localeCompare(b));
+  let lastStage = null;
+  const rows = [];
+  for (const site of sites) {
+    const ent = ops[site] || {};
+    const stage = stageOf(site);
+    const rin = Number(ent.rows_in || 0);
+    const rout = Number(ent.rows_out || 0);
+    const sel = rin > 0 ? (rout / rin).toFixed(3) : '';
+    const stageCell = stage === lastStage ? '' : `stage ${stage}`;
+    lastStage = stage;
+    rows.push(`<tr><td>${esc(stageCell)}</td><td>${esc(site)}</td>` +
+      `<td>${esc(ent.kind || '')}</td>` +
+      `<td class="num">${num(rin)}</td>` +
+      `<td class="num">${num(rout)}</td>` +
+      `<td class="num">${sel}</td></tr>`);
+  }
+  return '<table class="stages"><tr><th>stage</th><th>operator site</th>' +
+    '<th>kind</th><th>rows in</th><th>rows out</th><th>selectivity</th>' +
+    '</tr>' + rows.join('') + '</table>';
+}
+
 async function toggleTimeline(qid) {
   if (open.has(qid)) open.delete(qid); else open.add(qid);
   refresh();
@@ -188,7 +225,8 @@ async function refresh() {
         tl = await (await fetch(
             '/v1/query/' + encodeURIComponent(q.queryId) + '/timeline')).json();
       } catch (e) { /* timeline unavailable */ }
-      rows.push(`<tr><td colspan="5">${renderStages(q)}${renderTimeline(tl)}</td></tr>`);
+      rows.push(`<tr><td colspan="5">${renderStages(q)}` +
+        `${renderOperators(q)}${renderTimeline(tl)}</td></tr>`);
     }
   }
   document.getElementById('qtable').innerHTML =
